@@ -1,0 +1,88 @@
+"""Headline benchmark: ResNet-50 training throughput on one TPU chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``
+
+The BASELINE metric is "ADAG samples/sec/chip (ResNet-50)" with a ≥35% MFU
+north star (BASELINE.json). The reference publishes no absolute numbers
+(BASELINE.md), so ``vs_baseline`` reports achieved-MFU / 0.35 — the ratio
+against the north-star target; >1.0 beats it.
+
+The timed loop is the exact jitted train step the trainers drive
+(make_train_step: fwd+bwd+optax update, donated state), fed with a
+device-resident batch so the measurement is chip throughput, not host IO.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.resnet import resnet50
+    from distkeras_tpu.ops.losses import get_optimizer
+    from distkeras_tpu.tracing import StepTimer, device_peak_flops
+    from distkeras_tpu.training.step import TrainState, make_train_step
+
+    model = resnet50(num_classes=1000, image_size=image)
+    optimizer = get_optimizer("sgd", 0.1)
+    step_fn = make_train_step(model, optimizer, "categorical_crossentropy",
+                              metrics=())
+    state = TrainState.create(model, optimizer, rng=0)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, image, image, 3)), jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, size=(batch,)), jnp.int32)
+    b = {"features": x, "label": y}
+
+    for _ in range(warmup):
+        state, m = step_fn(state, b)
+    jax.block_until_ready(state.params)
+
+    timer = StepTimer()
+    timer.start()
+    for _ in range(steps):
+        state, m = step_fn(state, b)
+        jax.block_until_ready(m["loss"])
+        timer.tick()
+
+    summary = timer.summary(
+        batch_size=batch,
+        flops_per_example=model.flops_per_example,
+        num_chips=1,
+        skip_warmup=1,
+    )
+    sps = summary["samples_per_sec_per_chip"]
+    mfu = summary.get("mfu", 0.0)
+    peak = device_peak_flops() or 0
+    print(json.dumps({
+        "metric": "resnet50_train_samples_per_sec_per_chip",
+        "value": round(sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if mfu else None,
+        "detail": {
+            "mfu": round(mfu, 4),
+            "batch_size": batch,
+            "image_size": image,
+            "step_time_mean_s": round(summary["step_time_mean_s"], 5),
+            "step_time_var_s2": round(summary["step_time_var_s2"], 8),
+            "device": str(jax.devices()[0]),
+            "peak_flops": peak,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""dist-keras-tpu: a TPU-native distributed training framework.
+
+A from-scratch rebuild of the capabilities of ``cerndb/dist-keras``
+(Spark + Keras + socket parameter server) on JAX/XLA for TPUs:
+
+- models are PyTrees of arrays with pure ``apply`` functions (flax-backed
+  model zoo in :mod:`distkeras_tpu.models`);
+- training steps are ``jax.jit``-compiled and run under a GSPMD device mesh
+  (:mod:`distkeras_tpu.parallel`);
+- the reference's asynchronous parameter-server protocols (DOWNPOUR, ADAG,
+  AEASGD, EAMSGD, DynSGD — ``distkeras/trainers.py`` § the protocol classes)
+  are re-expressed as pure update rules (:mod:`distkeras_tpu.parallel.protocols`)
+  applied by a single-owner parameter-server service
+  (:mod:`distkeras_tpu.parallel.ps`);
+- the Spark-DataFrame preprocessing library (``distkeras/transformers.py``)
+  becomes a columnar in-memory dataset + pure-function transformers
+  (:mod:`distkeras_tpu.data`).
+
+The public trainer API mirrors the reference (``SingleTrainer``, ``DOWNPOUR``,
+``ADAG``, ``AEASGD``, ``EAMSGD``, ``DynSGD``, ``EnsembleTrainer``,
+``AveragingTrainer`` — reference ``distkeras/trainers.py``) so that user code
+written against dist-keras maps one-to-one.
+"""
+
+__version__ = "0.1.0"
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+)
+from distkeras_tpu.models.core import Model, TrainedModel
+from distkeras_tpu.training.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    EAMSGD,
+    AveragingTrainer,
+    DynSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    SynchronousDistributedTrainer,
+    Trainer,
+)
+from distkeras_tpu.inference.predictors import ModelPredictor, Predictor
+from distkeras_tpu.inference.evaluators import AccuracyEvaluator
+
+__all__ = [
+    "Dataset",
+    "Model",
+    "TrainedModel",
+    "Trainer",
+    "SingleTrainer",
+    "EnsembleTrainer",
+    "AveragingTrainer",
+    "SynchronousDistributedTrainer",
+    "DOWNPOUR",
+    "ADAG",
+    "AEASGD",
+    "EAMSGD",
+    "DynSGD",
+    "OneHotTransformer",
+    "MinMaxTransformer",
+    "ReshapeTransformer",
+    "DenseTransformer",
+    "LabelIndexTransformer",
+    "Predictor",
+    "ModelPredictor",
+    "AccuracyEvaluator",
+]

@@ -1,0 +1,92 @@
+"""Checkpoint / resume — closing a reference gap (SURVEY §5: dist-keras has
+no checkpointing; training cannot resume mid-run).
+
+Orbax-backed step-level save/restore of the full training position: params,
+optimizer state, RNG, step counter, and — for async protocols — the PS center
+and update counter, so a DynSGD run resumes with correct staleness
+accounting.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with a fixed layout:
+
+    ``{"state": <TrainState-like pytree>, "ps": {"center":..., "num_updates":...},
+    "meta": {...}}`` — any subset may be absent.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(
+        self,
+        step: int,
+        state: Any = None,
+        ps_center: Any = None,
+        ps_num_updates: int | None = None,
+        meta: dict | None = None,
+        wait: bool = True,
+    ) -> None:
+        items: dict[str, Any] = {}
+        if state is not None:
+            items["state"] = ocp.args.StandardSave(jax.device_get(state))
+        if ps_center is not None:
+            items["ps"] = ocp.args.StandardSave(
+                {
+                    "center": jax.device_get(ps_center),
+                    "num_updates": np.int64(ps_num_updates or 0),
+                }
+            )
+        if meta:
+            items["meta"] = ocp.args.JsonSave(meta)
+        self._mgr.save(step, args=ocp.args.Composite(**items))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step: int | None = None, like: Any = None) -> dict:
+        """``like`` mirrors the saved layout: a dict possibly holding
+        ``state`` / ``ps`` pytrees (``meta`` is restored automatically)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        saved = set(self._mgr.item_metadata(step).keys())
+        items: dict[str, Any] = {}
+        for key in ("state", "ps"):
+            if key in saved:
+                template = (like or {}).get(key)
+                items[key] = (
+                    ocp.args.StandardRestore(jax.device_get(template))
+                    if template is not None
+                    else ocp.args.StandardRestore()
+                )
+        if "meta" in saved:
+            items["meta"] = ocp.args.JsonRestore()
+        restored = self._mgr.restore(step, args=ocp.args.Composite(**items))
+        return dict(restored)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,22 @@
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.feed import DeviceFeed, minibatches
+from distkeras_tpu.data.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    Transformer,
+)
+
+__all__ = [
+    "Dataset",
+    "DeviceFeed",
+    "minibatches",
+    "Transformer",
+    "OneHotTransformer",
+    "MinMaxTransformer",
+    "ReshapeTransformer",
+    "DenseTransformer",
+    "LabelIndexTransformer",
+]

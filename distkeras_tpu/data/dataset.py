@@ -1,0 +1,177 @@
+"""Columnar in-memory dataset — the TPU-native stand-in for Spark DataFrames.
+
+The reference keeps training data in a Spark ``DataFrame`` and realizes
+``num_workers`` by repartitioning (``distkeras/trainers.py`` §
+``AsynchronousDistributedTrainer.train`` repartitions to
+``num_workers * parallelism_factor``). Here a :class:`Dataset` is a dict of
+named numpy columns resident on the host; "partitioning" is an index-range
+split, and workers/devices consume host-sharded minibatch feeds
+(:mod:`distkeras_tpu.data.feed`). Transforms are **eager** pure functions —
+deliberately unlike Spark's lazy per-epoch re-execution, a known dist-keras
+performance trap (SURVEY §3.5 note).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+from collections.abc import Iterator, Mapping, Sequence
+
+import numpy as np
+
+__all__ = ["Dataset"]
+
+
+class Dataset:
+    """An immutable named-column table backed by numpy arrays.
+
+    Columns share a leading row dimension; a column may be any rank
+    (e.g. ``features`` of shape ``[N, 784]`` or images ``[N, 28, 28, 1]``).
+    """
+
+    def __init__(self, columns: Mapping[str, np.ndarray]):
+        if not columns:
+            raise ValueError("Dataset requires at least one column")
+        self._columns: dict[str, np.ndarray] = {
+            k: np.asarray(v) for k, v in columns.items()
+        }
+        lengths = {k: v.shape[0] for k, v in self._columns.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"Column length mismatch: {lengths}")
+        self._num_rows = next(iter(lengths.values()))
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_arrays(cls, **columns: np.ndarray) -> "Dataset":
+        return cls(columns)
+
+    @classmethod
+    def from_csv(
+        cls,
+        path: str,
+        features: Sequence[str] | None = None,
+        label: str | None = None,
+        features_col: str = "features",
+        label_col: str = "label",
+        dtype=np.float32,
+    ) -> "Dataset":
+        """Read a headered CSV.
+
+        If ``features`` is given, those columns are stacked into a single
+        vector column ``features_col`` (mirroring Spark's VectorAssembler
+        stage that dist-keras notebooks used before the trainers).
+        """
+        with open(path, newline="") as f:
+            reader = _csv.reader(f)
+            header = next(reader)
+            rows = [r for r in reader if r]
+        table = {
+            name: np.array([row[i] for row in rows]) for i, name in enumerate(header)
+        }
+        out: dict[str, np.ndarray] = {}
+        if features is not None:
+            out[features_col] = np.stack(
+                [table[c].astype(dtype) for c in features], axis=1
+            )
+            if label is not None:
+                out[label_col] = table[label].astype(dtype)
+            for name, col in table.items():
+                if name not in features and name != label:
+                    out[name] = _maybe_numeric(col, dtype)
+        else:
+            out = {name: _maybe_numeric(col, dtype) for name, col in table.items()}
+        return cls(out)
+
+    # -- basic accessors ----------------------------------------------------
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self._columns)
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def __len__(self) -> int:
+        return self._num_rows
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def column(self, name: str) -> np.ndarray:
+        return self._columns[name]
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._columns[name]
+
+    # -- functional updates -------------------------------------------------
+
+    def with_column(self, name: str, values: np.ndarray) -> "Dataset":
+        """Return a new Dataset with ``name`` added/replaced (the analogue of
+        Spark's ``withColumn`` used throughout the reference transformers)."""
+        cols = dict(self._columns)
+        cols[name] = np.asarray(values)
+        return Dataset(cols)
+
+    def select(self, *names: str) -> "Dataset":
+        return Dataset({n: self._columns[n] for n in names})
+
+    def drop(self, *names: str) -> "Dataset":
+        return Dataset({k: v for k, v in self._columns.items() if k not in names})
+
+    def take(self, n: int) -> "Dataset":
+        return Dataset({k: v[:n] for k, v in self._columns.items()})
+
+    def slice(self, start: int, stop: int) -> "Dataset":
+        return Dataset({k: v[start:stop] for k, v in self._columns.items()})
+
+    def gather(self, indices: np.ndarray) -> "Dataset":
+        return Dataset({k: v[indices] for k, v in self._columns.items()})
+
+    def shuffle(self, seed: int = 0) -> "Dataset":
+        """Row shuffle (reference ``distkeras/utils.py`` § ``shuffle``)."""
+        perm = np.random.default_rng(seed).permutation(self._num_rows)
+        return self.gather(perm)
+
+    def repeat(self, n: int) -> "Dataset":
+        return Dataset({k: np.concatenate([v] * n) for k, v in self._columns.items()})
+
+    def concat(self, other: "Dataset") -> "Dataset":
+        return Dataset(
+            {
+                k: np.concatenate([v, other._columns[k]])
+                for k, v in self._columns.items()
+            }
+        )
+
+    # -- partitioning (replaces Spark repartition/mapPartitions) ------------
+
+    def partitions(self, num_partitions: int) -> list["Dataset"]:
+        """Split rows into ``num_partitions`` near-equal contiguous shards —
+        the index-space analogue of ``df.repartition(n)`` +
+        ``mapPartitionsWithIndex`` in the reference trainers."""
+        bounds = np.linspace(0, self._num_rows, num_partitions + 1, dtype=np.int64)
+        return [self.slice(int(bounds[i]), int(bounds[i + 1])) for i in range(num_partitions)]
+
+    def split(self, fraction: float, seed: int = 0) -> tuple["Dataset", "Dataset"]:
+        """Random train/test split (replaces ``df.randomSplit``)."""
+        perm = np.random.default_rng(seed).permutation(self._num_rows)
+        cut = int(self._num_rows * fraction)
+        return self.gather(perm[:cut]), self.gather(perm[cut:])
+
+    def rows(self) -> Iterator[dict[str, np.ndarray]]:
+        for i in range(self._num_rows):
+            yield {k: v[i] for k, v in self._columns.items()}
+
+    def __repr__(self) -> str:
+        spec = ", ".join(
+            f"{k}: {v.dtype}{list(v.shape[1:])}" for k, v in self._columns.items()
+        )
+        return f"Dataset[{self._num_rows} rows; {spec}]"
+
+
+def _maybe_numeric(col: np.ndarray, dtype) -> np.ndarray:
+    try:
+        return col.astype(dtype)
+    except ValueError:
+        return col

@@ -1,0 +1,89 @@
+"""Host→device minibatch feed with prefetch.
+
+Replaces the reference worker's per-row Python batch assembly
+(``distkeras/workers.py`` § ``Worker.train`` iterating Spark partition rows
+into numpy minibatches): batches are cut from contiguous columnar arrays,
+optionally sharded across a mesh's data axis, and moved to device one batch
+ahead of compute (double buffering) so HBM never waits on the host.
+"""
+
+from __future__ import annotations
+
+import collections
+from collections.abc import Iterator
+
+import jax
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+__all__ = ["minibatches", "DeviceFeed"]
+
+Batch = dict[str, np.ndarray]
+
+
+def minibatches(
+    dataset: Dataset,
+    batch_size: int,
+    features_col: str = "features",
+    label_col: str = "label",
+    num_epoch: int = 1,
+    seed: int | None = None,
+    drop_remainder: bool = True,
+) -> Iterator[Batch]:
+    """Yield ``{"features": x, "label": y}`` numpy minibatches.
+
+    ``features_col`` / ``label_col`` follow the reference worker kwargs
+    (``distkeras/workers.py`` § ``Worker``). With ``seed`` set, rows are
+    re-shuffled each epoch; ``drop_remainder`` keeps shapes static for XLA.
+    """
+    x = np.asarray(dataset[features_col])
+    y = np.asarray(dataset[label_col])
+    n = x.shape[0]
+    if n < batch_size and drop_remainder:
+        raise ValueError(f"partition of {n} rows < batch_size {batch_size}")
+    for epoch in range(num_epoch):
+        if seed is not None:
+            perm = np.random.default_rng(seed + epoch).permutation(n)
+            xe, ye = x[perm], y[perm]
+        else:
+            xe, ye = x, y
+        stop = (n // batch_size) * batch_size if drop_remainder else n
+        for lo in range(0, stop, batch_size):
+            hi = min(lo + batch_size, n)
+            yield {"features": xe[lo:hi], "label": ye[lo:hi]}
+
+
+class DeviceFeed:
+    """Prefetching iterator that keeps ``buffer_size`` batches in flight.
+
+    ``sharding`` (a ``jax.sharding.Sharding``) places each batch directly in
+    its distributed layout — for a data-parallel mesh the host array is split
+    across devices on transfer, never materialized whole on any one chip.
+    """
+
+    def __init__(
+        self,
+        batches: Iterator[Batch],
+        sharding: jax.sharding.Sharding | None = None,
+        buffer_size: int = 2,
+    ):
+        self._batches = batches
+        self._sharding = sharding
+        self._buffer: collections.deque = collections.deque()
+        self._buffer_size = max(1, buffer_size)
+
+    def _put(self, batch: Batch):
+        if self._sharding is not None:
+            return {
+                k: jax.device_put(v, self._sharding) for k, v in batch.items()
+            }
+        return {k: jax.device_put(v) for k, v in batch.items()}
+
+    def __iter__(self):
+        for batch in self._batches:
+            self._buffer.append(self._put(batch))
+            if len(self._buffer) >= self._buffer_size:
+                yield self._buffer.popleft()
+        while self._buffer:
+            yield self._buffer.popleft()

@@ -1,0 +1,4 @@
+from distkeras_tpu.inference.evaluators import AccuracyEvaluator
+from distkeras_tpu.inference.predictors import ModelPredictor, Predictor
+
+__all__ = ["Predictor", "ModelPredictor", "AccuracyEvaluator"]

@@ -1,0 +1,36 @@
+"""Evaluation — parity with ``distkeras/evaluators.py``.
+
+The reference's ``AccuracyEvaluator.evaluate(df)`` compares a prediction
+column against a label column over a Spark DataFrame. Here it's one
+vectorized comparison over host columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataset import Dataset
+
+__all__ = ["AccuracyEvaluator"]
+
+
+class AccuracyEvaluator:
+    """Classification accuracy over a Dataset (reference §
+    ``AccuracyEvaluator``): same ``prediction_col``/``label_col`` surface."""
+
+    def __init__(self, prediction_col: str = "prediction_index", label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        preds = np.asarray(dataset[self.prediction_col])
+        labels = np.asarray(dataset[self.label_col])
+        if preds.ndim > 1 and preds.shape[-1] > 1:
+            preds = np.argmax(preds, axis=-1)
+        if labels.ndim > 1 and labels.shape[-1] > 1:
+            labels = np.argmax(labels, axis=-1)
+        preds = preds.reshape(-1).astype(np.int64)
+        labels = labels.reshape(-1).astype(np.int64)
+        if preds.shape[0] != labels.shape[0]:
+            raise ValueError("prediction/label length mismatch")
+        return float(np.mean(preds == labels))

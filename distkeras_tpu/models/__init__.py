@@ -1,0 +1,28 @@
+from distkeras_tpu.models.core import Model, TrainedModel
+from distkeras_tpu.models.mlp import MLP, mnist_mlp, higgs_mlp
+from distkeras_tpu.models.cnn import CNN, cifar10_cnn, mnist_cnn
+
+__all__ = [
+    "Model",
+    "TrainedModel",
+    "MLP",
+    "CNN",
+    "mnist_mlp",
+    "higgs_mlp",
+    "cifar10_cnn",
+    "mnist_cnn",
+]
+
+
+def __getattr__(name):
+    # Heavier model families are imported lazily to keep `import distkeras_tpu`
+    # fast on single-model workloads.
+    if name in ("ResNet", "resnet50", "resnet18"):
+        from distkeras_tpu.models import resnet
+
+        return getattr(resnet, name)
+    if name in ("Bert", "bert_base_mlm", "bert_tiny_mlm"):
+        from distkeras_tpu.models import bert
+
+        return getattr(bert, name)
+    raise AttributeError(name)

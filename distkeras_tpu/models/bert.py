@@ -1,0 +1,189 @@
+"""BERT encoder family for masked-LM — BASELINE config #5
+("BERT-base MLM via DynSGD with GSPMD data+model sharding").
+
+TPU-first design:
+
+- Every weight matrix is annotated with **logical axes**
+  (``nn.with_logical_partitioning``) so one model definition serves 1-chip,
+  data-parallel, tensor-parallel, and sequence-parallel meshes purely by
+  changing the logical→mesh axis rules
+  (:func:`distkeras_tpu.parallel.sharding.logical_axis_rules`) — the GSPMD
+  way, not hand-written per-layout model variants.
+- Attention/MLP matmuls in bfloat16 on the MXU; softmax and layernorm in
+  float32.
+- Long sequences: the attention layer delegates to
+  :mod:`distkeras_tpu.ops.attention`, which provides a blocked/ring-capable
+  implementation for sequence/context parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.ops.attention import dot_product_attention
+
+__all__ = ["BertConfig", "Bert", "bert_base_mlm", "bert_tiny_mlm"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
+    return nn.Dense(
+        features,
+        dtype=dtype,
+        use_bias=use_bias,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), logical_axes
+        ),
+        name=name,
+    )
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        qkv_axes = ("embed", "heads")
+        q = _dense(cfg.hidden_size, qkv_axes, "query", cfg.dtype)(x)
+        k = _dense(cfg.hidden_size, qkv_axes, "key", cfg.dtype)(x)
+        v = _dense(cfg.hidden_size, qkv_axes, "value", cfg.dtype)(x)
+        B, S = x.shape[0], x.shape[1]
+        shape = (B, S, cfg.num_heads, head_dim)
+        out = dot_product_attention(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape), mask=mask
+        )
+        out = out.reshape(B, S, cfg.hidden_size)
+        return _dense(cfg.hidden_size, ("heads", "embed"), "out", cfg.dtype)(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        cfg = self.cfg
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        y = SelfAttention(cfg, name="attention")(y, mask=mask, train=train)
+        y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        y = _dense(cfg.mlp_dim, ("embed", "mlp"), "mlp_in", cfg.dtype)(y)
+        y = nn.gelu(y)
+        y = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_out", cfg.dtype)(y)
+        y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+        return x + y
+
+
+class Bert(nn.Module):
+    """BERT encoder with a tied-embedding MLM head.
+
+    Input: int32 token ids ``[B, S]``. Output: vocab logits ``[B, S, V]``.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, token_ids, train: bool = False):
+        cfg = self.cfg
+        token_ids = token_ids.astype(jnp.int32)
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="token_embed",
+        )
+        pos_embed = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), (None, "seq", "embed")
+            ),
+            (1, cfg.max_seq_len, cfg.hidden_size),
+            jnp.float32,
+        )
+        S = token_ids.shape[1]
+        x = embed(token_ids) + pos_embed[:, :S].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # Tied MLM head: project back through the embedding matrix.
+        logits = embed.attend(x.astype(jnp.float32))
+        bias = self.param(
+            "mlm_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+            (cfg.vocab_size,),
+            jnp.float32,
+        )
+        return logits + bias
+
+
+def _bert_flops(cfg: BertConfig, seq_len: int) -> float:
+    # per-token fwd FLOPs ≈ 2 * (4*h^2 + 2*h*mlp) per layer + attention term
+    per_token = cfg.num_layers * 2 * (4 * cfg.hidden_size**2 + 2 * cfg.hidden_size * cfg.mlp_dim)
+    attn = cfg.num_layers * 2 * 2 * seq_len * cfg.hidden_size  # qk^T + av per token
+    head = 2 * cfg.hidden_size * cfg.vocab_size
+    return float(seq_len * (per_token + attn + head))
+
+
+def _make(cfg: BertConfig, seq_len: int, name: str) -> Model:
+    module = Bert(cfg)
+
+    def init_fn(rng):
+        dummy = jnp.zeros((1, seq_len), jnp.int32)
+        variables = module.init({"params": rng, "dropout": rng}, dummy, train=False)
+        # Strip Partitioned boxes for the plain (non-GSPMD) paths; the
+        # sharded path re-derives specs via eval_shape on boxed_init.
+        return dict(nn.meta.unbox(variables))
+
+    def boxed_init(rng):
+        dummy = jnp.zeros((1, seq_len), jnp.int32)
+        return dict(module.init({"params": rng, "dropout": rng}, dummy, train=False))
+
+    def apply_fn(variables, x, train=False, rngs=None):
+        return module.apply(variables, x, train=train, rngs=rngs), {}
+
+    m = Model(
+        init_fn,
+        apply_fn,
+        name=name,
+        input_shape=(seq_len,),
+        output_dim=cfg.vocab_size,
+        flops_per_example=_bert_flops(cfg, seq_len),
+    )
+    m.config = cfg
+    m.flax_module = module
+    m.boxed_init = boxed_init
+    return m
+
+
+def bert_base_mlm(seq_len: int = 128, vocab_size: int = 30522) -> Model:
+    return _make(BertConfig(vocab_size=vocab_size), seq_len, "bert_base_mlm")
+
+
+def bert_tiny_mlm(seq_len: int = 64, vocab_size: int = 1024) -> Model:
+    cfg = BertConfig(
+        vocab_size=vocab_size, hidden_size=128, num_layers=2, num_heads=4,
+        mlp_dim=512, max_seq_len=max(seq_len, 64),
+    )
+    return _make(cfg, seq_len, "bert_tiny_mlm")

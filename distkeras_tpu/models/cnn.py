@@ -1,0 +1,81 @@
+"""Convolutional model family (MNIST / CIFAR-10 scale).
+
+Covers BASELINE config #2 ("CIFAR-10 CNN via ADAG") and the convolutional
+MNIST variants in the reference notebooks. Convs run in bfloat16 (MXU), with
+float32 logits.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import Model
+
+__all__ = ["CNN", "cifar10_cnn", "mnist_cnn"]
+
+
+class CNN(nn.Module):
+    conv_features: Sequence[int]
+    dense_features: Sequence[int]
+    num_classes: int
+    dropout_rate: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        for width in self.conv_features:
+            x = nn.Conv(width, kernel_size=(3, 3), dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for width in self.dense_features:
+            x = nn.Dense(width, dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def cifar10_cnn(num_classes: int = 10) -> Model:
+    module = CNN(
+        conv_features=(64, 128, 256),
+        dense_features=(256,),
+        num_classes=num_classes,
+        dropout_rate=0.1,
+    )
+    # rough forward FLOPs: convs dominate; 3x3 convs over HxW feature maps
+    flops = 2.0 * (
+        3 * 3 * 3 * 64 * 32 * 32
+        + 3 * 3 * 64 * 128 * 16 * 16
+        + 3 * 3 * 128 * 256 * 8 * 8
+        + 4 * 4 * 256 * 256
+        + 256 * num_classes
+    )
+    return Model.from_flax(
+        module,
+        input_shape=(32, 32, 3),
+        name="cifar10_cnn",
+        output_dim=num_classes,
+        flops_per_example=flops,
+    )
+
+
+def mnist_cnn(num_classes: int = 10) -> Model:
+    module = CNN(conv_features=(32, 64), dense_features=(128,), num_classes=num_classes)
+    flops = 2.0 * (
+        3 * 3 * 1 * 32 * 28 * 28
+        + 3 * 3 * 32 * 64 * 14 * 14
+        + 7 * 7 * 64 * 128
+        + 128 * num_classes
+    )
+    return Model.from_flax(
+        module,
+        input_shape=(28, 28, 1),
+        name="mnist_cnn",
+        output_dim=num_classes,
+        flops_per_example=flops,
+    )

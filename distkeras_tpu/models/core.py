@@ -1,0 +1,180 @@
+"""Model abstraction: a PyTree of variables + a pure apply function.
+
+The reference moves models around as pickled Keras blobs
+(``distkeras/utils.py`` § ``serialize_keras_model``: JSON architecture +
+weight list) and trains via ``model.train_on_batch`` inside Spark executors.
+Here a :class:`Model` is a *specification* (pure ``init``/``apply`` pair —
+flax-backed for the built-in zoo) and the weights are an explicit PyTree that
+flows through jitted step functions; a :class:`TrainedModel` bundles the two
+for inference and persistence.
+
+Variables are a dict with a ``"params"`` subtree (trainable) and optionally
+``"batch_stats"`` etc. (non-trainable collections, e.g. BatchNorm running
+moments in the ResNet family).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.utils.pytree import deserialize_pytree, serialize_pytree
+
+__all__ = ["Model", "TrainedModel"]
+
+Variables = dict[str, Any]
+
+
+class Model:
+    """A pure model specification.
+
+    ``apply(variables, batch_features, train, rngs) -> (outputs, new_state)``
+    where ``new_state`` carries updated non-trainable collections (empty dict
+    when the architecture has none). ``init(rng)`` builds fresh variables.
+    """
+
+    def __init__(
+        self,
+        init_fn: Callable[[jax.Array], Variables],
+        apply_fn: Callable[..., tuple[jax.Array, Variables]],
+        name: str = "model",
+        input_shape: tuple[int, ...] | None = None,
+        output_dim: int | None = None,
+        flops_per_example: float | None = None,
+    ):
+        self._init_fn = init_fn
+        self.apply = apply_fn
+        self.name = name
+        self.input_shape = input_shape
+        self.output_dim = output_dim
+        # Approximate forward-pass FLOPs per example, used for MFU reporting.
+        self.flops_per_example = flops_per_example
+
+    def init(self, rng: jax.Array | int) -> Variables:
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        return self._init_fn(rng)
+
+    # -- flax integration ----------------------------------------------------
+
+    @classmethod
+    def from_flax(
+        cls,
+        module,
+        input_shape: tuple[int, ...],
+        name: str | None = None,
+        output_dim: int | None = None,
+        train_mutable: tuple[str, ...] = ("batch_stats",),
+        flops_per_example: float | None = None,
+        init_dtype=jnp.float32,
+    ) -> "Model":
+        """Wrap a ``flax.linen.Module``.
+
+        ``input_shape`` excludes the batch dimension. ``train_mutable`` names
+        the variable collections updated during training (BatchNorm etc.).
+        """
+
+        def init_fn(rng: jax.Array) -> Variables:
+            dummy = jnp.zeros((1, *input_shape), dtype=init_dtype)
+            variables = module.init({"params": rng, "dropout": rng}, dummy, train=False)
+            return jax.tree.map(lambda x: x, dict(variables))  # unfreeze copy
+
+        def apply_fn(
+            variables: Variables,
+            x: jax.Array,
+            train: bool = False,
+            rngs: dict[str, jax.Array] | None = None,
+        ) -> tuple[jax.Array, Variables]:
+            mutable = [c for c in train_mutable if c in variables] if train else []
+            if mutable:
+                out, new_state = module.apply(
+                    variables, x, train=train, rngs=rngs, mutable=mutable
+                )
+                return out, dict(new_state)
+            out = module.apply(variables, x, train=train, rngs=rngs)
+            return out, {}
+
+        model = cls(
+            init_fn,
+            apply_fn,
+            name=name or type(module).__name__,
+            input_shape=tuple(input_shape),
+            output_dim=output_dim,
+            flops_per_example=flops_per_example,
+        )
+        model.flax_module = module
+        return model
+
+    # -- keras 3 integration -------------------------------------------------
+
+    @classmethod
+    def from_keras(cls, keras_model, name: str | None = None) -> "Model":
+        """Adapt a Keras 3 model (JAX backend) so dist-keras notebooks that
+        build Keras ``Sequential``s keep working (reference trainers accept a
+        ``keras_model`` first argument — ``distkeras/trainers.py`` §
+        ``Trainer.__init__``). Requires ``KERAS_BACKEND=jax``."""
+        import keras
+
+        if keras.backend.backend() != "jax":
+            raise RuntimeError(
+                "Model.from_keras requires the Keras JAX backend "
+                "(set KERAS_BACKEND=jax before importing keras)"
+            )
+
+        def init_fn(rng: jax.Array) -> Variables:
+            trainable = [np.asarray(v) for v in keras_model.trainable_variables]
+            non_trainable = [
+                np.asarray(v) for v in keras_model.non_trainable_variables
+            ]
+            return {
+                "params": {"w": [jnp.asarray(v) for v in trainable]},
+                "keras_state": [jnp.asarray(v) for v in non_trainable],
+            }
+
+        def apply_fn(variables, x, train=False, rngs=None):
+            out, non_trainable = keras_model.stateless_call(
+                variables["params"]["w"],
+                variables.get("keras_state", []),
+                x,
+                training=train,
+            )
+            return out, ({"keras_state": list(non_trainable)} if train else {})
+
+        input_shape = tuple(keras_model.input_shape[1:]) if keras_model.input_shape else None
+        return cls(init_fn, apply_fn, name=name or keras_model.name, input_shape=input_shape)
+
+
+class TrainedModel:
+    """Weights + spec: what a trainer returns (the analogue of the trained
+    Keras model handed back by reference ``Trainer.train``)."""
+
+    def __init__(self, model: Model, variables: Variables):
+        self.model = model
+        self.variables = variables
+        self._jitted_predict = None
+
+    def predict(self, x) -> np.ndarray:
+        if self._jitted_predict is None:
+            self._jitted_predict = jax.jit(
+                lambda v, xx: self.model.apply(v, xx, train=False)[0]
+            )
+        return np.asarray(self._jitted_predict(self.variables, jnp.asarray(x)))
+
+    @property
+    def params(self):
+        return self.variables.get("params", self.variables)
+
+    # -- persistence ---------------------------------------------------------
+
+    def save_weights(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(serialize_pytree(self.variables))
+
+    def load_weights(self, path: str) -> None:
+        with open(path, "rb") as f:
+            self.variables = deserialize_pytree(f.read(), like=self.variables)
+        self._jitted_predict = None

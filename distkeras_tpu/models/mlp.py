@@ -1,0 +1,70 @@
+"""MLP model family.
+
+Covers the reference's tabular/MNIST workloads: the MNIST MLP of
+``examples/mnist.py`` and the ATLAS-Higgs classifier of
+``examples/workflow.ipynb`` (dist-keras' de-facto benchmark models).
+Dense layers map straight onto the TPU MXU; compute runs in bfloat16 with
+float32 parameters/accumulation by default.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import Model
+
+__all__ = ["MLP", "mnist_mlp", "higgs_mlp"]
+
+
+class MLP(nn.Module):
+    features: Sequence[int]
+    num_classes: int
+    dropout_rate: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
+        for width in self.features:
+            x = nn.Dense(width, dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x  # logits, float32 for a stable softmax
+
+
+def _mlp_flops(in_dim: int, features: Sequence[int], num_classes: int) -> float:
+    dims = [in_dim, *features, num_classes]
+    return float(sum(2 * a * b for a, b in zip(dims[:-1], dims[1:])))
+
+
+def mnist_mlp(
+    hidden: Sequence[int] = (500, 300), num_classes: int = 10, dropout: float = 0.0
+) -> Model:
+    """The MNIST MLP configuration used by reference ``examples/mnist.py``."""
+    module = MLP(features=tuple(hidden), num_classes=num_classes, dropout_rate=dropout)
+    return Model.from_flax(
+        module,
+        input_shape=(784,),
+        name="mnist_mlp",
+        output_dim=num_classes,
+        flops_per_example=_mlp_flops(784, hidden, num_classes),
+    )
+
+
+def higgs_mlp(
+    input_dim: int = 28, hidden: Sequence[int] = (500, 500, 500), num_classes: int = 2
+) -> Model:
+    """ATLAS-Higgs tabular classifier (reference ``examples/workflow.ipynb``)."""
+    module = MLP(features=tuple(hidden), num_classes=num_classes)
+    return Model.from_flax(
+        module,
+        input_shape=(input_dim,),
+        name="higgs_mlp",
+        output_dim=num_classes,
+        flops_per_example=_mlp_flops(input_dim, hidden, num_classes),
+    )

@@ -1,0 +1,133 @@
+"""ResNet family (ResNet-18/50) — the BASELINE north-star model.
+
+BASELINE config #4: "ResNet-50 / ImageNet via AEASGD"; the headline metric is
+ADAG samples/sec/chip on ResNet-50 at ≥35% MFU. TPU-first choices:
+
+- NHWC layout (XLA's preferred conv layout on TPU), 3x3/1x1 convs in
+  bfloat16 → MXU; BatchNorm statistics and residual adds in float32.
+- No data-dependent control flow; the whole forward pass is one traceable
+  function XLA can fuse.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distkeras_tpu.models.core import Model
+
+__all__ = ["ResNet", "resnet18", "resnet50"]
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            self.norm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), self.strides)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), self.strides, name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual.astype(y.dtype) + y)
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            self.norm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (3, 3), self.strides)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features, (1, 1), self.strides, name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual.astype(y.dtype) + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: type
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False, dtype=self.dtype,
+                    name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.width * 2**i, strides=strides, dtype=self.dtype
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+# Forward GFLOPs per 224x224x3 example (standard figures).
+_RESNET50_FLOPS = 4.1e9 * 2  # fwd multiply-adds ≈ 4.1 GMACs -> 8.2 GFLOPs
+_RESNET18_FLOPS = 1.8e9 * 2
+
+
+def resnet50(num_classes: int = 1000, image_size: int = 224) -> Model:
+    module = ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                    num_classes=num_classes)
+    scale = (image_size / 224.0) ** 2
+    return Model.from_flax(
+        module,
+        input_shape=(image_size, image_size, 3),
+        name="resnet50",
+        output_dim=num_classes,
+        flops_per_example=_RESNET50_FLOPS * scale,
+    )
+
+
+def resnet18(num_classes: int = 1000, image_size: int = 224) -> Model:
+    module = ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
+                    num_classes=num_classes)
+    scale = (image_size / 224.0) ** 2
+    return Model.from_flax(
+        module,
+        input_shape=(image_size, image_size, 3),
+        name="resnet18",
+        output_dim=num_classes,
+        flops_per_example=_RESNET18_FLOPS * scale,
+    )

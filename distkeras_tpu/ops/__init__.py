@@ -1,0 +1,4 @@
+from distkeras_tpu.ops.losses import get_loss, get_optimizer
+from distkeras_tpu.ops.metrics import accuracy
+
+__all__ = ["get_loss", "get_optimizer", "accuracy"]

@@ -1,0 +1,116 @@
+"""Attention kernels: blocked softmax attention + ring attention for
+sequence/context parallelism.
+
+The reference framework predates attention entirely (2016-era MLPs/CNNs —
+SURVEY §5 "long-context: absent"), but long-context is first-class here:
+:func:`ring_attention` shards the sequence axis across a mesh axis and
+streams K/V blocks around the ring with ``lax.ppermute``, overlapping each
+hop with the local block's FLOPs — attention over sequences far larger than
+one chip's HBM, with online (flash-style) softmax so nothing materializes an
+``S×S`` matrix.
+
+All matmuls run in the input dtype (bfloat16 on TPU); softmax statistics are
+kept in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["dot_product_attention", "ring_attention", "ring_self_attention"]
+
+
+def dot_product_attention(q, k, v, mask=None, causal: bool = False):
+    """Standard attention. ``q/k/v: [B, S, H, D]`` -> ``[B, S, H, D]``.
+
+    Softmax in float32; einsums stay in the input dtype for the MXU.
+    """
+    dtype = q.dtype
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        S_q, S_k = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((S_q, S_k), bool), k=S_k - S_q)
+        scores = jnp.where(causal_mask, scores, -1e30)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _block_attn_update(q, k_blk, v_blk, acc, m, denom, scale):
+    """One online-softmax accumulation step against a K/V block.
+
+    ``acc``: running numerator [B,S,H,D] (f32); ``m``: running max [B,H,S,1];
+    ``denom``: running sum of exp [B,H,S,1].
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    blk_max = jnp.max(scores, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, blk_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m)
+    new_denom = denom * correction + jnp.sum(p, axis=-1, keepdims=True)
+    p_v = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk).astype(
+        jnp.float32
+    )
+    # correction is [B,H,S,1] -> align to [B,S,H,1] for the accumulator
+    corr_t = jnp.transpose(correction, (0, 2, 1, 3))
+    new_acc = acc * corr_t + p_v
+    return new_acc, new_m, new_denom
+
+
+def ring_attention(q, k, v, axis_name: str):
+    """Ring attention over a sharded sequence axis.
+
+    To be called **inside** ``shard_map`` (or an equivalent SPMD context)
+    where ``q/k/v`` are the per-device sequence shards ``[B, S/p, H, D]`` and
+    ``axis_name`` names the mesh axis carrying the sequence dimension. Each
+    of the ``p`` steps computes the local block's contribution with online
+    softmax, then rotates K/V one hop around the ring (``lax.ppermute`` over
+    ICI); compute and the next hop's communication overlap under XLA async
+    collectives.
+    """
+    p = lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    # Derive the accumulators from q so they carry q's device-varying axes
+    # (a plain jnp.zeros would be axis-invariant and reject the scan carry
+    # under shard_map's varying-axes check).
+    acc = (q * 0.0).astype(jnp.float32)
+    stat = jnp.transpose((q[..., :1] * 0.0).astype(jnp.float32), (0, 2, 1, 3))
+    m = stat - jnp.inf  # [B, H, S, 1]
+    denom = stat
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(carry, _):
+        acc, m, denom, k_cur, v_cur = carry
+        acc, m, denom = _block_attn_update(q, k_cur, v_cur, acc, m, denom, scale)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m, denom, k_nxt, v_nxt), None
+
+    (acc, m, denom, _, _), _ = lax.scan(body, (acc, m, denom, k, v), None, length=p)
+    denom_t = jnp.transpose(denom, (0, 2, 1, 3))  # [B,S,H,1]
+    return (acc / denom_t).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp"):
+    """Convenience wrapper: run :func:`ring_attention` under ``shard_map`` on
+    ``mesh``, sharding the sequence dimension of ``[B, S, H, D]`` inputs over
+    ``seq_axis`` and the batch over ``dp`` if present."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    spec = P(batch_axis, seq_axis, None, None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

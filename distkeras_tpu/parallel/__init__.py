@@ -1,0 +1,28 @@
+from distkeras_tpu.parallel.mesh import (
+    best_mesh,
+    data_parallel_shardings,
+    make_mesh,
+)
+from distkeras_tpu.parallel.protocols import (
+    ADAGProtocol,
+    AEASGDProtocol,
+    AsyncProtocol,
+    DOWNPOURProtocol,
+    DynSGDProtocol,
+    EAMSGDProtocol,
+)
+from distkeras_tpu.parallel.ps import InProcessClient, ParameterServerService
+
+__all__ = [
+    "make_mesh",
+    "best_mesh",
+    "data_parallel_shardings",
+    "AsyncProtocol",
+    "DOWNPOURProtocol",
+    "ADAGProtocol",
+    "AEASGDProtocol",
+    "EAMSGDProtocol",
+    "DynSGDProtocol",
+    "ParameterServerService",
+    "InProcessClient",
+]

@@ -1,0 +1,129 @@
+"""GSPMD training: one model definition, any mesh.
+
+This is the data+model-sharding path BASELINE config #5 requires
+("BERT-base MLM via DynSGD with GSPMD data+model sharding") and the engine
+behind ``SynchronousDistributedTrainer`` when the mesh has model axes:
+parameters are laid out according to their logical-axis annotations
+(:mod:`distkeras_tpu.parallel.sharding`), the batch is sharded over
+``dp`` (and the sequence over ``sp`` when present), and every collective —
+gradient psum over ``dp``, activation all-reduces over ``tp`` — is inserted
+by XLA from the sharding constraints. No hand-written collectives in the
+step function.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.parallel.sharding import infer_variable_shardings
+from distkeras_tpu.training.step import TrainState
+
+__all__ = ["sharded_train_state", "make_sharded_train_step", "batch_sharding"]
+
+
+def batch_sharding(mesh: Mesh, batch_rank: int = 2, seq_dim: int | None = 1):
+    """Sharding for a ``[B, ...]`` batch: B over dp, seq dim over sp."""
+    spec = [None] * batch_rank
+    if "dp" in mesh.axis_names:
+        spec[0] = "dp"
+    if seq_dim is not None and "sp" in mesh.axis_names and seq_dim < batch_rank:
+        spec[seq_dim] = "sp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def sharded_train_state(
+    model: Model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rng: int | jax.Array = 0,
+):
+    """Initialize a TrainState with every parameter placed per its logical
+    axes — parameters materialize directly in their distributed layout
+    (never whole on one device)."""
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    # Same key split as TrainState.create so a sharded and an unsharded
+    # init from the same seed produce identical parameters.
+    init_rng, step_rng = jax.random.split(rng)
+    rng = init_rng
+    boxed_init = getattr(model, "boxed_init", None)
+
+    if boxed_init is not None:
+        abstract = jax.eval_shape(boxed_init, rng)
+        var_shardings = infer_variable_shardings(mesh, abstract)
+
+        def init_fn(r):
+            from flax import linen as nn
+
+            return nn.meta.unbox(boxed_init(r))
+
+        variables = jax.jit(init_fn, out_shardings=var_shardings)(rng)
+    else:
+        # Un-annotated model: replicate everything (pure DP).
+        replicated = NamedSharding(mesh, P())
+        variables = jax.jit(model.init, out_shardings=replicated)(rng)
+        var_shardings = jax.tree.map(lambda _: replicated, variables)
+
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    param_shardings = var_shardings["params"]
+    opt_state = jax.jit(
+        optimizer.init, in_shardings=(param_shardings,), out_shardings=None
+    )(params)
+    state = TrainState(
+        params=params,
+        model_state=model_state,
+        opt_state=opt_state,
+        step=jax.device_put(np.int32(0), NamedSharding(mesh, P())),
+        rng=jax.device_put(step_rng, NamedSharding(mesh, P())),
+    )
+    return state, var_shardings
+
+
+def make_sharded_train_step(
+    model: Model,
+    optimizer: optax.GradientTransformation,
+    loss: str | Callable,
+    mesh: Mesh,
+    donate: bool = True,
+):
+    """Jitted ``(state, batch) -> (state, metrics)`` under GSPMD.
+
+    The step body is identical to the single-chip engine — shardings on the
+    inputs are the only distribution mechanism. XLA turns the params'
+    layouts into tp collectives and the batch layout into a dp gradient
+    all-reduce over ICI.
+    """
+    loss_fn = get_loss(loss)
+
+    def step(state: TrainState, batch: dict):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def compute_loss(params):
+            variables = {"params": params, **state.model_state}
+            outputs, new_model_state = model.apply(
+                variables, batch["features"], train=True, rngs={"dropout": step_rng}
+            )
+            return loss_fn(outputs, batch["label"]), new_model_state
+
+        (loss_value, new_model_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            params=new_params,
+            model_state=new_model_state if new_model_state else state.model_state,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss_value}
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

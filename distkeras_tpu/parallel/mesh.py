@@ -1,0 +1,89 @@
+"""Device mesh and sharding utilities.
+
+The reference has no notion of device topology — "parallelism" is Spark
+partition count (``distkeras/trainers.py`` § ``DistributedTrainer.num_workers``).
+Here the unit of scale is a ``jax.sharding.Mesh`` over TPU chips with named
+axes, and parallelism strategies are sharding annotations:
+
+- ``dp``   data parallel (batch split; gradient psum over ICI)
+- ``fsdp`` fully-sharded data parallel (params sharded over the data axis)
+- ``tp``   tensor parallel (weight matrices split; activation collectives)
+- ``sp``   sequence/context parallel (long-context attention)
+- ``pp``   pipeline stages
+
+Sync data-parallel training (the reference's ``SynchronousDistributedTrainer``
+/ ``AveragingTrainer`` use case) needs only ``dp``: shard the batch, let XLA
+insert the gradient all-reduce over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "AXES",
+    "make_mesh",
+    "best_mesh",
+    "data_parallel_shardings",
+    "shard_batch_spec",
+]
+
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+
+def make_mesh(
+    axis_sizes: dict[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh with the given named axis sizes.
+
+    Unnamed remainder devices fold into ``dp``. Example:
+    ``make_mesh({"dp": 2, "tp": 4})`` on 8 devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axis_sizes or {})
+    specified = math.prod(sizes.values()) if sizes else 1
+    if n % specified != 0:
+        raise ValueError(f"{n} devices not divisible by axis product {specified}")
+    if "dp" not in sizes:
+        sizes = {"dp": n // specified, **sizes}
+    names = [a for a in AXES if a in sizes] + [a for a in sizes if a not in AXES]
+    shape = [sizes[a] for a in names]
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh {dict(zip(names, shape))} != {n} devices")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def best_mesh(num_devices: int | None = None) -> Mesh:
+    """Default mesh: pure data-parallel over all local devices."""
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} "
+                f"are attached; reduce num_workers or run on more chips"
+            )
+        devices = devices[:num_devices]
+    return make_mesh({"dp": len(devices)}, devices=devices)
+
+
+def shard_batch_spec(mesh: Mesh) -> P:
+    """Batch dimension sharded over every data-like axis present."""
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    if not batch_axes:
+        return P()
+    return P(batch_axes if len(batch_axes) > 1 else batch_axes[0])
+
+
+def data_parallel_shardings(mesh: Mesh):
+    """(batch_sharding, replicated_sharding) for classic DP training."""
+    batch = NamedSharding(mesh, shard_batch_spec(mesh))
+    replicated = NamedSharding(mesh, P())
+    return batch, replicated

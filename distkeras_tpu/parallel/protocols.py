@@ -1,0 +1,195 @@
+"""Async optimization protocols as pure update rules.
+
+The heart of dist-keras parity. The reference implements each protocol as a
+(Worker subclass, ParameterServer subclass) pair exchanging pickled weights
+over TCP (``distkeras/workers.py`` § ``DOWNPOURWorker``/``ADAGWorker``/
+``AEASGDWorker``/``EAMSGDWorker``/``DynSGDWorker`` +
+``distkeras/parameter_servers.py`` § ``DeltaParameterServer``/
+``ADAGParameterServer``/``DynSGDParameterServer``). Here each protocol is a
+small strategy object made of **pure PyTree functions**:
+
+- ``server_commit(center, num_updates, payload) -> (center, num_updates)``
+  — the single-owner PS state transition (no locks needed by construction);
+- ``worker_begin(client, params)`` / ``worker_window(params, carry, client)``
+  — the per-``communication_window`` exchange run by each worker between
+  stretches of jitted local train steps.
+
+Protocol semantics preserved from the reference:
+
+DOWNPOUR   worker pushes the weight delta accumulated over the window, then
+           pulls the fresh center; server applies ``center += delta``.
+ADAG       same worker; server normalizes: ``center += delta / num_workers``
+           (accumulated-gradient normalization — the reference author's own
+           protocol; the 1/n scaling tames asynchronous staleness).
+AEASGD     elastic averaging: worker computes the elastic force
+           ``e = rho * lr * (local - center)``, applies ``local -= e`` and
+           commits ``e``; server applies ``center += e``.
+EAMSGD     AEASGD plus Nesterov-style momentum on the local update.
+DynSGD     staleness-aware: pull returns ``(center, num_updates)``; commit
+           carries the puller's ``last_update``; server applies
+           ``center += delta / (staleness + 1)`` with
+           ``staleness = num_updates - last_update`` and bumps the counter
+           (reference ``DynSGDParameterServer.handle_commit`` semantics,
+           SURVEY §3.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import optax
+
+from distkeras_tpu.utils.pytree import pytree_add, pytree_scale, pytree_sub
+
+__all__ = [
+    "AsyncProtocol",
+    "DOWNPOURProtocol",
+    "ADAGProtocol",
+    "AEASGDProtocol",
+    "EAMSGDProtocol",
+    "DynSGDProtocol",
+]
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class WorkerCarry:
+    """Per-worker protocol bookkeeping between windows."""
+
+    window_start: PyTree | None = None  # params snapshot at window start
+    last_update: int = 0  # DynSGD: server counter seen at last pull
+
+
+class AsyncProtocol:
+    """Base strategy. Subclasses override the three hooks below."""
+
+    name = "async"
+
+    def __init__(self, communication_window: int = 5):
+        self.communication_window = int(communication_window)
+
+    # -- server side (runs inside the single-owner PS loop) ------------------
+
+    def server_commit(
+        self, center: PyTree, num_updates: int, payload: dict, num_workers: int
+    ) -> tuple[PyTree, int]:
+        raise NotImplementedError
+
+    # -- worker side ---------------------------------------------------------
+
+    def local_optimizer(
+        self, base: optax.GradientTransformation
+    ) -> optax.GradientTransformation:
+        """Hook for protocols that modify the local update rule (EAMSGD)."""
+        return base
+
+    def worker_begin(self, client, params: PyTree) -> tuple[PyTree, WorkerCarry]:
+        """Initial pull: start every worker from the shared center."""
+        center, num_updates = client.pull()
+        return center, WorkerCarry(window_start=center, last_update=num_updates)
+
+    def worker_window(
+        self, params: PyTree, carry: WorkerCarry, client
+    ) -> tuple[PyTree, WorkerCarry]:
+        raise NotImplementedError
+
+
+class _DeltaWindowMixin:
+    """Commit accumulated window delta, then pull fresh center and rebase —
+    the DOWNPOUR/ADAG/DynSGD worker cadence (SURVEY §3.1 hot loop)."""
+
+    def worker_window(self, params, carry, client):
+        delta = pytree_sub(params, carry.window_start)
+        client.commit({"delta": delta, "last_update": carry.last_update})
+        center, num_updates = client.pull()
+        return center, WorkerCarry(window_start=center, last_update=num_updates)
+
+
+class DOWNPOURProtocol(_DeltaWindowMixin, AsyncProtocol):
+    """Dean et al. Downpour SGD (reference ``DOWNPOUR`` trainer +
+    ``DeltaParameterServer``)."""
+
+    name = "downpour"
+
+    def server_commit(self, center, num_updates, payload, num_workers):
+        return pytree_add(center, payload["delta"]), num_updates + 1
+
+
+class ADAGProtocol(_DeltaWindowMixin, AsyncProtocol):
+    """Accumulated-gradient normalization (reference ``ADAG`` trainer +
+    ``ADAGParameterServer``): commit scaled by 1/num_workers."""
+
+    name = "adag"
+
+    def __init__(self, communication_window: int = 12):
+        super().__init__(communication_window)
+
+    def server_commit(self, center, num_updates, payload, num_workers):
+        scaled = pytree_scale(payload["delta"], 1.0 / max(1, num_workers))
+        return pytree_add(center, scaled), num_updates + 1
+
+
+class AEASGDProtocol(AsyncProtocol):
+    """Asynchronous Elastic Averaging SGD (Zhang et al.; reference ``AEASGD``
+    trainer). ``rho`` and ``learning_rate`` follow the reference kwargs."""
+
+    name = "aeasgd"
+
+    def __init__(
+        self,
+        communication_window: int = 32,
+        rho: float = 5.0,
+        learning_rate: float = 0.1,
+    ):
+        super().__init__(communication_window)
+        self.rho = float(rho)
+        self.learning_rate = float(learning_rate)
+
+    def server_commit(self, center, num_updates, payload, num_workers):
+        return pytree_add(center, payload["delta"]), num_updates + 1
+
+    def worker_window(self, params, carry, client):
+        center, num_updates = client.pull()
+        alpha = self.rho * self.learning_rate
+        elastic = pytree_scale(pytree_sub(params, center), alpha)
+        new_params = pytree_sub(params, elastic)
+        client.commit({"delta": elastic, "last_update": num_updates})
+        return new_params, WorkerCarry(window_start=new_params, last_update=num_updates)
+
+
+class EAMSGDProtocol(AEASGDProtocol):
+    """Elastic Averaging with Momentum SGD (reference ``EAMSGD`` trainer):
+    AEASGD elastic exchange + Nesterov momentum on the local update."""
+
+    name = "eamsgd"
+
+    def __init__(
+        self,
+        communication_window: int = 32,
+        rho: float = 5.0,
+        learning_rate: float = 0.1,
+        momentum: float = 0.9,
+    ):
+        super().__init__(communication_window, rho, learning_rate)
+        self.momentum = float(momentum)
+
+    def local_optimizer(self, base):
+        return optax.chain(base, optax.trace(decay=self.momentum, nesterov=True))
+
+
+class DynSGDProtocol(_DeltaWindowMixin, AsyncProtocol):
+    """Staleness-aware dynamic SGD (reference ``DynSGD`` trainer +
+    ``DynSGDParameterServer``): each committed delta is damped by the
+    committer's staleness. The PS update counter is load-bearing state —
+    it is owned exclusively by the PS loop, making the
+    read-modify-write race-free by construction (vs the reference's
+    GIL-protected handler threads)."""
+
+    name = "dynsgd"
+
+    def server_commit(self, center, num_updates, payload, num_workers):
+        staleness = max(0, num_updates - int(payload["last_update"]))
+        damped = pytree_scale(payload["delta"], 1.0 / (staleness + 1))
+        return pytree_add(center, damped), num_updates + 1

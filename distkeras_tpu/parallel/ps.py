@@ -1,0 +1,154 @@
+"""Single-owner parameter-server service.
+
+Replaces the reference's socket parameter server
+(``distkeras/parameter_servers.py`` § ``SocketParameterServer``: TCP accept
+loop, thread-per-connection, handlers mutating center weights under the GIL).
+Design differences, deliberate (SURVEY §5 race-detection note):
+
+- **Single-owner state.** One service loop owns the center PyTree and the
+  update counter; pulls and commits are messages consumed sequentially from
+  one queue. Data races on PS state are impossible by construction — no
+  locks, no GIL reliance.
+- **Transport-agnostic.** :class:`InProcessClient` (queue-based, zero-copy)
+  serves workers in the same process — the common case on a TPU host where
+  workers are threads driving devices. The cross-host transport over DCN
+  (standing in for the reference's ``distkeras/networking.py``
+  pickle-over-TCP framing, without pickle) plugs in behind the same
+  pull/commit client interface.
+- Center lives as host numpy arrays; commit math is vectorized numpy on the
+  PS loop, off the device hot path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+from distkeras_tpu.parallel.protocols import AsyncProtocol
+
+__all__ = ["ParameterServerService", "InProcessClient"]
+
+PyTree = Any
+
+_PULL = "pull"
+_COMMIT = "commit"
+_STOP = "stop"
+
+
+def _to_host(tree: PyTree) -> PyTree:
+    """Materialize a PyTree as host numpy arrays, preserving leaf dtypes
+    (param dtype must round-trip unchanged or worker step functions would
+    retrace every window)."""
+    return jax.tree.map(np.asarray, tree)
+
+
+class ParameterServerService:
+    """The PS loop. Mirrors the reference lifecycle API
+    (``ParameterServer.{initialize,run,stop}``, ``get_model`` —
+    ``distkeras/parameter_servers.py`` § ``ParameterServer``)."""
+
+    def __init__(
+        self,
+        protocol: AsyncProtocol,
+        center: PyTree,
+        num_workers: int,
+    ):
+        self.protocol = protocol
+        self.num_workers = int(num_workers)
+        self._center = _to_host(center)
+        self._num_updates = 0
+        self._num_commits = 0
+        self._queue: queue.Queue = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self.running = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def initialize(self) -> None:  # reference API parity; state set in __init__
+        pass
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.running = True
+        self._thread = threading.Thread(target=self._run, name="ps-loop", daemon=True)
+        self._thread.start()
+
+    run = start  # reference calls it `run` on a thread; we manage the thread
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self.running = False
+        self._queue.put((_STOP, None, None))
+        self._thread.join()
+        self._thread = None
+
+    # -- service loop (sole owner of _center/_num_updates) -------------------
+
+    def _run(self) -> None:
+        while True:
+            action, payload, reply = self._queue.get()
+            if action == _STOP:
+                break
+            if action == _PULL:
+                # Snapshot: copy so the worker can't observe later mutation.
+                snap = jax.tree.map(np.copy, self._center)
+                reply.put((snap, self._num_updates))
+            elif action == _COMMIT:
+                self._center, self._num_updates = self.protocol.server_commit(
+                    self._center, self._num_updates, payload, self.num_workers
+                )
+                self._num_commits += 1
+                if reply is not None:
+                    reply.put(True)
+
+    # -- introspection -------------------------------------------------------
+
+    def get_model(self) -> PyTree:
+        """Final center weights (reference ``ParameterServer.get_model``).
+        Only call after workers have stopped committing, or accept a
+        point-in-time snapshot."""
+        if self._thread is not None:
+            reply: queue.Queue = queue.Queue()
+            self._queue.put((_PULL, None, reply))
+            center, _ = reply.get()
+            return center
+        return self._center
+
+    @property
+    def num_updates(self) -> int:
+        return self._num_updates
+
+    @property
+    def num_commits(self) -> int:
+        return self._num_commits
+
+    def client(self) -> "InProcessClient":
+        return InProcessClient(self)
+
+
+class InProcessClient:
+    """Worker-side handle (reference ``distkeras/workers.py`` §
+    ``NetworkWorker.pull``/``commit`` round-trips, minus the socket)."""
+
+    def __init__(self, service: ParameterServerService):
+        self._service = service
+
+    def pull(self) -> tuple[PyTree, int]:
+        reply: queue.Queue = queue.Queue()
+        self._service._queue.put((_PULL, None, reply))
+        return reply.get()
+
+    def commit(self, payload: dict) -> None:
+        # Fire-and-forget, like the reference's one-way commit send; device
+        # arrays are materialized to host numpy before enqueue so the PS
+        # never touches device buffers.
+        host_payload = {
+            k: (_to_host(v) if k == "delta" else v) for k, v in payload.items()
+        }
+        self._service._queue.put((_COMMIT, host_payload, None))

@@ -1,0 +1,72 @@
+"""Logical-axis → mesh-axis sharding rules (GSPMD).
+
+One model definition (annotated with ``nn.with_logical_partitioning``,
+see :mod:`distkeras_tpu.models.bert`) maps onto any mesh by resolving its
+logical axes against these rules — the "pick a mesh, annotate shardings,
+let XLA insert collectives" recipe. The reference has no analogue: its only
+notion of placement is "which Spark partition" (SURVEY §2 parallelism table:
+TP/SP absent from dist-keras; provided here because BASELINE config #5
+requires data+model sharding).
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DEFAULT_RULES",
+    "logical_axis_rules",
+    "infer_variable_shardings",
+    "replicated",
+]
+
+# logical axis -> mesh axis (None = replicate)
+DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
+    ("batch", "dp"),
+    ("seq", "sp"),
+    ("embed", None),     # keep the residual stream replicated
+    ("heads", "tp"),
+    ("mlp", "tp"),
+    ("kv", None),
+    ("vocab", "tp"),
+)
+
+
+def logical_axis_rules(mesh: Mesh, overrides=None):
+    """Filter DEFAULT_RULES down to axes the mesh actually has."""
+    rules = []
+    seen = set()
+    for logical, phys in tuple(overrides or ()) + DEFAULT_RULES:
+        if logical in seen:
+            continue
+        seen.add(logical)
+        rules.append((logical, phys if phys in mesh.axis_names else None))
+    return tuple(rules)
+
+
+def infer_variable_shardings(mesh: Mesh, abstract_variables, overrides=None):
+    """Resolve a variables PyTree (possibly containing
+    ``nn.Partitioned`` leaves from logical annotations) to NamedShardings.
+
+    Un-annotated leaves are replicated. Returns a PyTree of NamedSharding
+    matching the *unboxed* variables structure.
+    """
+    rules = logical_axis_rules(mesh, overrides)
+    logical_specs = nn.get_partition_spec(abstract_variables)
+    mesh_specs = nn.logical_to_mesh(logical_specs, rules)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        mesh_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def unbox(variables):
+    """Strip ``nn.Partitioned`` boxes, leaving raw arrays."""
+    return nn.meta.unbox(variables)

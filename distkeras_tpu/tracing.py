@@ -1,0 +1,146 @@
+"""Tracing, profiling, and structured metrics.
+
+The reference's observability is wall-clock getters plus the Spark web UI
+(SURVEY §5). Here:
+
+- :class:`StepTimer` — per-step wall times with the derived metrics the
+  BASELINE cares about (samples/sec/chip, step-time variance, MFU);
+- :class:`MetricStream` — structured per-step metric records with pluggable
+  sinks (in-memory, JSONL file, stdout);
+- :func:`trace` — context manager around ``jax.profiler`` for
+  TensorBoard/Perfetto traces of the XLA timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import statistics
+import time
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["StepTimer", "MetricStream", "trace", "device_peak_flops"]
+
+
+# Peak bf16 FLOPs/s per chip by TPU generation (public figures).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> float | None:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops in _PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return None
+
+
+class StepTimer:
+    """Wall-clock per step; call ``tick()`` after each (blocked-on) step."""
+
+    def __init__(self):
+        self._times: list[float] = []
+        self._last: float | None = None
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def tick(self) -> float:
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return 0.0
+        dt = now - self._last
+        self._last = now
+        self._times.append(dt)
+        return dt
+
+    @property
+    def step_times(self) -> list[float]:
+        return self._times
+
+    def summary(
+        self,
+        batch_size: int | None = None,
+        flops_per_example: float | None = None,
+        num_chips: int = 1,
+        skip_warmup: int = 1,
+    ) -> dict[str, float]:
+        times = self._times[skip_warmup:] if len(self._times) > skip_warmup else self._times
+        if not times:
+            return {}
+        mean = statistics.fmean(times)
+        out = {
+            "steps": float(len(times)),
+            "step_time_mean_s": mean,
+            "step_time_p50_s": statistics.median(times),
+            "step_time_var_s2": statistics.pvariance(times) if len(times) > 1 else 0.0,
+            "step_time_min_s": min(times),
+        }
+        if batch_size:
+            out["samples_per_sec"] = batch_size / mean
+            out["samples_per_sec_per_chip"] = batch_size / mean / max(1, num_chips)
+        if batch_size and flops_per_example:
+            # train step ≈ 3x forward FLOPs (fwd + bwd)
+            achieved = 3.0 * flops_per_example * batch_size / mean
+            out["train_tflops_per_sec"] = achieved / 1e12
+            peak = device_peak_flops()
+            if peak:
+                out["mfu"] = achieved / (peak * max(1, num_chips))
+        return out
+
+
+class MetricStream:
+    """Structured metric records: ``emit(step, {...})`` fans out to sinks."""
+
+    def __init__(self, sinks: list[Callable[[dict], None]] | None = None):
+        self.records: list[dict] = []
+        self._sinks = sinks or []
+
+    @classmethod
+    def to_jsonl(cls, path: str) -> "MetricStream":
+        f = open(path, "a")
+
+        def sink(rec: dict):
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+
+        return cls([sink])
+
+    def emit(self, step: int, metrics: dict[str, Any]) -> None:
+        rec = {"step": int(step), "ts": time.time(), **_floats(metrics)}
+        self.records.append(rec)
+        for sink in self._sinks:
+            sink(rec)
+
+    def last(self) -> dict | None:
+        return self.records[-1] if self.records else None
+
+
+def _floats(metrics: dict) -> dict:
+    out = {}
+    for k, v in metrics.items():
+        try:
+            out[k] = float(v)
+        except (TypeError, ValueError):
+            out[k] = v
+    return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace (view in TensorBoard/Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,29 @@
+from distkeras_tpu.training.step import TrainState, make_train_step, make_eval_step
+from distkeras_tpu.training.trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    EAMSGD,
+    AveragingTrainer,
+    DynSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    SynchronousDistributedTrainer,
+    Trainer,
+)
+
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+    "SingleTrainer",
+    "EnsembleTrainer",
+    "AveragingTrainer",
+    "SynchronousDistributedTrainer",
+    "DOWNPOUR",
+    "ADAG",
+    "AEASGD",
+    "EAMSGD",
+    "DynSGD",
+]

@@ -1,0 +1,127 @@
+"""The jitted train-step engine.
+
+TPU-native replacement for the reference's per-batch
+``model.train_on_batch`` call inside Spark executors
+(``distkeras/workers.py`` § ``Worker.train`` hot loop): one pure function
+``(TrainState, batch) -> (TrainState, metrics)``, compiled once by XLA and
+re-used for every minibatch. All protocol trainers (sync and async) drive
+this same engine; distribution is layered on via shardings
+(:mod:`distkeras_tpu.parallel`), not by changing the step.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.metrics import accuracy as accuracy_metric
+
+__all__ = ["TrainState", "make_train_step", "make_eval_step"]
+
+
+@struct.dataclass
+class TrainState:
+    """Everything a training step needs, as one PyTree.
+
+    ``params`` is the trainable subtree; ``model_state`` holds non-trainable
+    collections (BatchNorm stats, ...); ``rng`` seeds dropout for this step.
+    """
+
+    params: Any
+    model_state: Any
+    opt_state: Any
+    step: jnp.ndarray
+    rng: jax.Array
+
+    @property
+    def variables(self) -> dict:
+        return {"params": self.params, **self.model_state}
+
+    @classmethod
+    def create(
+        cls,
+        model: Model,
+        optimizer: optax.GradientTransformation,
+        rng: jax.Array | int = 0,
+    ) -> "TrainState":
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        init_rng, step_rng = jax.random.split(rng)
+        variables = model.init(init_rng)
+        params = variables["params"]
+        model_state = {k: v for k, v in variables.items() if k != "params"}
+        return cls(
+            params=params,
+            model_state=model_state,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+            rng=step_rng,
+        )
+
+
+def make_train_step(
+    model: Model,
+    optimizer: optax.GradientTransformation,
+    loss: str | Callable,
+    metrics: tuple[str, ...] = ("accuracy",),
+    jit: bool = True,
+    donate: bool = True,
+):
+    """Build ``step(state, batch) -> (state, metrics_dict)``.
+
+    ``batch`` is ``{"features": [B, ...], "label": [B, ...]}``. The returned
+    function is jit-compiled with the state donated (params are updated
+    in-place in HBM, halving peak memory vs copy-on-update).
+    """
+    loss_fn = get_loss(loss)
+
+    def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def compute_loss(params):
+            variables = {"params": params, **state.model_state}
+            outputs, new_model_state = model.apply(
+                variables, batch["features"], train=True, rngs={"dropout": step_rng}
+            )
+            return loss_fn(outputs, batch["label"]), (outputs, new_model_state)
+
+        (loss_value, (outputs, new_model_state)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            params=new_params,
+            model_state=new_model_state if new_model_state else state.model_state,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        out_metrics = {"loss": loss_value}
+        if "accuracy" in metrics:
+            out_metrics["accuracy"] = accuracy_metric(outputs, batch["label"])
+        return new_state, out_metrics
+
+    if jit:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return step
+
+
+def make_eval_step(model: Model, loss: str | Callable | None = None, jit: bool = True):
+    """Build ``eval_step(variables, batch) -> metrics_dict`` (no grad)."""
+    loss_fn = get_loss(loss) if loss is not None else None
+
+    def eval_step(variables: dict, batch: dict) -> dict:
+        outputs, _ = model.apply(variables, batch["features"], train=False)
+        out = {"accuracy": accuracy_metric(outputs, batch["label"])}
+        if loss_fn is not None:
+            out["loss"] = loss_fn(outputs, batch["label"])
+        return out
+
+    return jax.jit(eval_step) if jit else eval_step

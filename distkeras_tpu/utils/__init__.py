@@ -1,0 +1,21 @@
+from distkeras_tpu.utils.pytree import (
+    deserialize_pytree,
+    pytree_add,
+    pytree_mean,
+    pytree_scale,
+    pytree_sub,
+    pytree_zeros_like,
+    serialize_pytree,
+)
+from distkeras_tpu.utils.rng import rng_stream
+
+__all__ = [
+    "serialize_pytree",
+    "deserialize_pytree",
+    "pytree_add",
+    "pytree_sub",
+    "pytree_scale",
+    "pytree_mean",
+    "pytree_zeros_like",
+    "rng_stream",
+]

@@ -1,0 +1,132 @@
+"""PyTree arithmetic and (de)serialization helpers.
+
+TPU-native replacement for the reference's model/weight plumbing
+(``distkeras/utils.py`` § ``serialize_keras_model`` /
+``deserialize_keras_model`` / ``pickle_object`` / ``unpickle_object``):
+instead of pickled Keras JSON + weight lists we move PyTrees of ndarrays.
+Serialization uses a self-describing, pickle-free npz container so frames
+can cross process boundaries (the async PS transport) safely.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# PyTree arithmetic (the building blocks of every PS protocol update rule).
+# ---------------------------------------------------------------------------
+
+
+def pytree_add(a: Any, b: Any) -> Any:
+    """``a + b`` leaf-wise."""
+    return jax.tree.map(jnp.add, a, b)
+
+
+def pytree_sub(a: Any, b: Any) -> Any:
+    """``a - b`` leaf-wise (e.g. weight deltas: ``w_after - w_before``)."""
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def pytree_scale(a: Any, s) -> Any:
+    """``s * a`` leaf-wise."""
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def pytree_zeros_like(a: Any) -> Any:
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+def pytree_mean(trees: list[Any]) -> Any:
+    """Arithmetic mean of a list of PyTrees (reference
+    ``distkeras/trainers.py`` § ``AveragingTrainer`` semantics)."""
+    if not trees:
+        raise ValueError("pytree_mean of empty list")
+    acc = trees[0]
+    for t in trees[1:]:
+        acc = pytree_add(acc, t)
+    return pytree_scale(acc, 1.0 / len(trees))
+
+
+# ---------------------------------------------------------------------------
+# Serialization: PyTree -> bytes without pickle.
+#
+# Format: npz archive whose member names are "<index>" plus a JSON "treedef"
+# member recording the tree structure via jax.tree.flatten key-paths.
+# ---------------------------------------------------------------------------
+
+
+def _treedef_to_json(tree: Any) -> str:
+    paths = [
+        "/".join(_key_str(k) for k in path)
+        for path, _ in jax.tree.flatten_with_path(tree)[0]
+    ]
+    return json.dumps(paths)
+
+
+def _key_str(key) -> str:
+    # DictKey('a') -> "d:a", SequenceKey(0) -> "s:0", GetAttrKey -> "a:name"
+    if isinstance(key, jax.tree_util.DictKey):
+        return f"d:{key.key}"
+    if isinstance(key, jax.tree_util.SequenceKey):
+        return f"s:{key.idx}"
+    if isinstance(key, jax.tree_util.GetAttrKey):
+        return f"a:{key.name}"
+    if isinstance(key, jax.tree_util.FlattenedIndexKey):
+        return f"i:{key.key}"
+    return f"r:{key!r}"
+
+
+def serialize_pytree(tree: Any) -> bytes:
+    """Serialize a PyTree of arrays to bytes (no pickle)."""
+    leaves, _ = jax.tree.flatten(tree)
+    buf = io.BytesIO()
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    arrays["__treedef__"] = np.frombuffer(
+        _treedef_to_json(tree).encode("utf-8"), dtype=np.uint8
+    )
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def deserialize_pytree(data: bytes, like: Any | None = None) -> Any:
+    """Inverse of :func:`serialize_pytree`.
+
+    If ``like`` (a PyTree with the same structure) is given, the result is
+    unflattened into that exact structure; otherwise a nested-dict tree is
+    rebuilt from the recorded key paths.
+    """
+    with np.load(io.BytesIO(data)) as npz:
+        n = sum(1 for k in npz.files if k.startswith("leaf_"))
+        leaves = [npz[f"leaf_{i}"] for i in range(n)]
+        paths = json.loads(bytes(npz["__treedef__"]).decode("utf-8"))
+    if like is not None:
+        treedef = jax.tree.structure(like)
+        return jax.tree.unflatten(treedef, leaves)
+    # Rebuild nested dicts/lists from tagged paths ("d:name" dict key,
+    # "s:idx" sequence index). The tag travels with the key so a dict whose
+    # keys happen to be digits is never mistaken for a list.
+    root: dict = {}
+    for path_str, leaf in zip(paths, leaves):
+        keys = path_str.split("/") if path_str else []
+        node = root
+        for j, ks in enumerate(keys):
+            tag, name = ks[0], ks[2:]
+            if j == len(keys) - 1:
+                node[(tag, name)] = leaf
+            else:
+                node = node.setdefault((tag, name), {})
+
+    def _fix(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(t == "s" for t, _ in node):
+            return [_fix(node[("s", str(i))]) for i in range(len(node))]
+        return {name: _fix(v) for (_, name), v in node.items()}
+
+    return _fix(root)

@@ -1,0 +1,25 @@
+"""Deterministic RNG streams.
+
+The reference relied on Keras/numpy global seeding; here every consumer of
+randomness receives an explicit ``jax.random`` key, split from one root seed,
+so runs are reproducible across any number of workers and hosts.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterator
+
+import jax
+
+
+def rng_stream(seed: int, salt: int = 0) -> Iterator[jax.Array]:
+    """Infinite stream of independent PRNG keys derived from ``seed``."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), salt)
+    while True:
+        key, sub = jax.random.split(key)
+        yield sub
+
+
+def worker_seed(seed: int, worker_index: int) -> int:
+    """A distinct, deterministic integer seed per worker."""
+    return (seed * 1_000_003 + worker_index * 7919) % (2**31 - 1)

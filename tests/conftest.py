@@ -1,0 +1,55 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+Multi-chip hardware is not available in CI; all mesh/pjit/collective code
+paths are exercised on 8 virtual CPU devices (SURVEY §4 test-strategy note).
+Env vars must be set before jax initializes, hence this file's import-time
+side effects.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The container's axon sitecustomize force-selects the TPU platform even
+# when JAX_PLATFORMS=cpu is in the environment; the config update below is
+# what actually pins tests to the 8 virtual CPU devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def toy_classification(rng):
+    """Linearly separable 2-class problem: fast convergence sanity checks."""
+    from distkeras_tpu.data.dataset import Dataset
+
+    n, d = 512, 16
+    w = rng.normal(size=(d,))
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    return Dataset.from_arrays(features=x, label=y)
+
+
+@pytest.fixture
+def toy_multiclass(rng):
+    from distkeras_tpu.data.dataset import Dataset
+
+    n, d, c = 768, 20, 4
+    centers = rng.normal(size=(c, d)) * 3.0
+    labels = rng.integers(0, c, size=n)
+    x = (centers[labels] + rng.normal(size=(n, d))).astype(np.float32)
+    return Dataset.from_arrays(features=x, label=labels.astype(np.float32))

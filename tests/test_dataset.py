@@ -1,0 +1,70 @@
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+
+
+def test_construct_and_accessors():
+    ds = Dataset.from_arrays(
+        features=np.zeros((10, 4), np.float32), label=np.arange(10)
+    )
+    assert ds.num_rows == 10
+    assert len(ds) == 10
+    assert set(ds.columns) == {"features", "label"}
+    assert "features" in ds
+    assert ds["features"].shape == (10, 4)
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        Dataset.from_arrays(a=np.zeros(3), b=np.zeros(4))
+
+
+def test_with_column_is_functional():
+    ds = Dataset.from_arrays(a=np.arange(5))
+    ds2 = ds.with_column("b", np.arange(5) * 2)
+    assert "b" not in ds
+    assert np.array_equal(ds2["b"], np.arange(5) * 2)
+
+
+def test_partitions_cover_all_rows():
+    ds = Dataset.from_arrays(a=np.arange(103))
+    parts = ds.partitions(8)
+    assert len(parts) == 8
+    total = np.concatenate([p["a"] for p in parts])
+    assert np.array_equal(np.sort(total), np.arange(103))
+    sizes = [len(p) for p in parts]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_shuffle_is_permutation_and_deterministic():
+    ds = Dataset.from_arrays(a=np.arange(50), b=np.arange(50) * 10)
+    s1, s2 = ds.shuffle(seed=7), ds.shuffle(seed=7)
+    assert np.array_equal(s1["a"], s2["a"])
+    assert not np.array_equal(s1["a"], ds["a"])
+    assert np.array_equal(np.sort(s1["a"]), np.arange(50))
+    # row alignment preserved across columns
+    assert np.array_equal(s1["b"], s1["a"] * 10)
+
+
+def test_split():
+    ds = Dataset.from_arrays(a=np.arange(100))
+    tr, te = ds.split(0.8, seed=1)
+    assert len(tr) == 80 and len(te) == 20
+    assert np.array_equal(np.sort(np.concatenate([tr["a"], te["a"]])), np.arange(100))
+
+
+def test_gather_select_drop_slice():
+    ds = Dataset.from_arrays(a=np.arange(10), b=np.arange(10) + 100)
+    assert np.array_equal(ds.gather(np.array([3, 1]))["a"], [3, 1])
+    assert ds.select("a").columns == ["a"]
+    assert ds.drop("a").columns == ["b"]
+    assert np.array_equal(ds.slice(2, 5)["a"], [2, 3, 4])
+
+
+def test_from_csv(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("x1,x2,y\n1,2,0\n3,4,1\n5,6,0\n")
+    ds = Dataset.from_csv(str(p), features=["x1", "x2"], label="y")
+    assert ds["features"].shape == (3, 2)
+    assert np.array_equal(ds["label"], [0, 1, 0])

@@ -1,0 +1,68 @@
+import numpy as np
+
+from distkeras_tpu.utils.pytree import (
+    deserialize_pytree,
+    pytree_add,
+    pytree_mean,
+    pytree_scale,
+    pytree_sub,
+    serialize_pytree,
+)
+
+
+def _tree():
+    return {
+        "dense": {"kernel": np.ones((3, 2), np.float32), "bias": np.zeros(2, np.float32)},
+        "out": {"kernel": np.full((2, 1), 2.0, np.float32)},
+    }
+
+
+def test_arithmetic():
+    t = _tree()
+    two = pytree_add(t, t)
+    assert np.allclose(two["dense"]["kernel"], 2.0)
+    zero = pytree_sub(t, t)
+    assert np.allclose(zero["out"]["kernel"], 0.0)
+    half = pytree_scale(t, 0.5)
+    assert np.allclose(half["dense"]["kernel"], 0.5)
+
+
+def test_mean():
+    a, b = _tree(), pytree_scale(_tree(), 3.0)
+    m = pytree_mean([a, b])
+    assert np.allclose(m["dense"]["kernel"], 2.0)
+
+
+def test_serialize_roundtrip_with_like():
+    t = _tree()
+    data = serialize_pytree(t)
+    assert isinstance(data, bytes)
+    back = deserialize_pytree(data, like=t)
+    ft, fb = _flatten(t), _flatten(back)
+    assert set(ft) == set(fb)
+    for k in ft:
+        assert np.array_equal(ft[k], fb[k]), k
+
+
+def test_serialize_roundtrip_structural():
+    t = _tree()
+    back = deserialize_pytree(serialize_pytree(t))
+    assert np.array_equal(back["dense"]["kernel"], t["dense"]["kernel"])
+    assert np.array_equal(back["out"]["kernel"], t["out"]["kernel"])
+
+
+def test_serialize_list_structure():
+    t = {"layers": [np.arange(3), np.arange(4)]}
+    back = deserialize_pytree(serialize_pytree(t))
+    assert np.array_equal(back["layers"][0], np.arange(3))
+    assert np.array_equal(back["layers"][1], np.arange(4))
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix + k + "/"))
+        else:
+            out[prefix + k] = v
+    return out

@@ -1,0 +1,65 @@
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.transformers import (
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+)
+
+
+def test_one_hot():
+    ds = Dataset.from_arrays(label=np.array([0, 2, 1, 2]))
+    out = OneHotTransformer(3).transform(ds)
+    enc = out["label_encoded"]
+    assert enc.shape == (4, 3)
+    assert np.array_equal(np.argmax(enc, -1), [0, 2, 1, 2])
+    assert np.allclose(enc.sum(-1), 1.0)
+
+
+def test_one_hot_out_of_range():
+    ds = Dataset.from_arrays(label=np.array([0, 5]))
+    with pytest.raises(ValueError):
+        OneHotTransformer(3).transform(ds)
+
+
+def test_min_max_explicit_range():
+    # Reference semantics: user supplies the data range (e.g. 0..255 images).
+    ds = Dataset.from_arrays(features=np.array([[0.0, 127.5, 255.0]]))
+    out = MinMaxTransformer(new_min=0.0, new_max=1.0, min=0.0, max=255.0).transform(ds)
+    assert np.allclose(out["features_normalized"], [[0.0, 0.5, 1.0]])
+
+
+def test_min_max_fitted_range_and_custom_target():
+    ds = Dataset.from_arrays(features=np.array([[1.0], [3.0], [5.0]]))
+    out = MinMaxTransformer(new_min=-1.0, new_max=1.0).transform(ds)
+    assert np.allclose(out["features_normalized"], [[-1.0], [0.0], [1.0]])
+
+
+def test_reshape():
+    ds = Dataset.from_arrays(features=np.arange(2 * 784).reshape(2, 784))
+    out = ReshapeTransformer("features", "matrix", (28, 28, 1)).transform(ds)
+    assert out["matrix"].shape == (2, 28, 28, 1)
+    assert np.array_equal(out["matrix"].reshape(2, -1), ds["features"])
+
+
+def test_dense():
+    ds = Dataset.from_arrays(features=np.array([[1, 0], [0, 2]], dtype=np.int64))
+    out = DenseTransformer().transform(ds)
+    assert out["features_dense"].dtype == np.float32
+    assert out["features_dense"].flags["C_CONTIGUOUS"]
+
+
+def test_label_index_vector():
+    ds = Dataset.from_arrays(prediction=np.array([[0.1, 0.7, 0.2], [0.9, 0.05, 0.05]]))
+    out = LabelIndexTransformer(3).transform(ds)
+    assert np.array_equal(out["prediction_index"], [1.0, 0.0])
+
+
+def test_label_index_scalar_threshold():
+    ds = Dataset.from_arrays(prediction=np.array([0.3, 0.8]))
+    out = LabelIndexTransformer().transform(ds)
+    assert np.array_equal(out["prediction_index"], [0.0, 1.0])
