@@ -29,6 +29,16 @@ def main() -> None:
     image = int(os.environ.get("BENCH_IMAGE", "224"))
 
     import jax
+
+    # Persistent compile cache: the first ResNet-50 compile through the
+    # remote-compile tunnel is slow (minutes); cached reruns start in seconds.
+    cache_dir = os.environ.get("JAX_CACHE_DIR", "/root/repo/.jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     import jax.numpy as jnp
 
     from distkeras_tpu.models.resnet import resnet50

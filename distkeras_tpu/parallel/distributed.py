@@ -1,0 +1,95 @@
+"""Multi-host process-group bootstrap and async-island topology.
+
+The reference's control plane is Spark: the driver pickles worker closures
+into executors and discovers itself with ``determine_host_address()``
+(SURVEY §1 "control plane = Spark driver"). The TPU-native control plane is
+``jax.distributed``: every host runs the same SPMD program, the coordinator
+address plays the driver's role, and data/gradient traffic never touches
+the control plane.
+
+Two usage patterns:
+
+- **Sync (the default path):** ``initialize()`` on every host, build one
+  global mesh with :func:`global_mesh`, train with
+  ``SynchronousDistributedTrainer``/GSPMD — XLA collectives ride ICI.
+- **Async islands (the Downpour-family path at multi-pod scale):** each
+  island (pod slice) trains sync internally; one process per island speaks
+  to the PS over DCN via :mod:`distkeras_tpu.parallel.ps_grpc`.
+  :class:`IslandSpec` carries that wiring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+from distkeras_tpu.parallel.mesh import make_mesh
+
+__all__ = ["initialize", "global_mesh", "IslandSpec", "local_island"]
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize the JAX process group (no-op on a single host).
+
+    Arguments default from the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``)
+    or the TPU metadata when running on a real pod.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None and num_processes is None:
+        return  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis_sizes: dict[str, int] | None = None):
+    """A mesh over every device in the process group (all hosts)."""
+    return make_mesh(axis_sizes, devices=jax.devices())
+
+
+@dataclasses.dataclass(frozen=True)
+class IslandSpec:
+    """One async island: a sync SPMD group that talks to a remote PS.
+
+    ``island_id``/``num_islands`` index this island among its peers (the
+    trainer's ``num_workers`` at island granularity); ``ps_host``/``ps_port``
+    locate the gRPC PS (DCN). Within the island, training is ordinary
+    GSPMD over ``mesh_axes``.
+    """
+
+    island_id: int
+    num_islands: int
+    ps_host: str
+    ps_port: int
+    mesh_axes: tuple[tuple[str, int], ...] = ()
+
+    def mesh(self):
+        return global_mesh(dict(self.mesh_axes) or None)
+
+    def client(self, like=None):
+        from distkeras_tpu.parallel.ps_grpc import GrpcClient
+
+        return GrpcClient(self.ps_host, self.ps_port, like=like)
+
+
+def local_island(ps_host: str, ps_port: int, num_islands: int = 1) -> IslandSpec:
+    """IslandSpec for this process group, numbering islands by the JAX
+    process index (island 0 conventionally co-hosts the PS)."""
+    pid = jax.process_index() if jax.process_count() > 1 else 0
+    return IslandSpec(
+        island_id=pid % num_islands,
+        num_islands=num_islands,
+        ps_host=ps_host,
+        ps_port=ps_port,
+    )

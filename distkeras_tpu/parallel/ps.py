@@ -11,10 +11,10 @@ Design differences, deliberate (SURVEY §5 race-detection note):
   locks, no GIL reliance.
 - **Transport-agnostic.** :class:`InProcessClient` (queue-based, zero-copy)
   serves workers in the same process — the common case on a TPU host where
-  workers are threads driving devices. The cross-host transport over DCN
-  (standing in for the reference's ``distkeras/networking.py``
-  pickle-over-TCP framing, without pickle) plugs in behind the same
-  pull/commit client interface.
+  workers are threads driving devices. The cross-host gRPC transport over
+  DCN (:mod:`distkeras_tpu.parallel.ps_grpc`, standing in for the
+  reference's ``distkeras/networking.py`` pickle-over-TCP framing, without
+  pickle) plugs in behind the same pull/commit client interface.
 - Center lives as host numpy arrays; commit math is vectorized numpy on the
   PS loop, off the device hot path.
 """

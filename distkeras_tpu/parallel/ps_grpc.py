@@ -1,0 +1,196 @@
+"""Cross-host parameter-server transport over gRPC (DCN plane).
+
+The reference's wire layer is ``distkeras/networking.py``: raw TCP sockets
+carrying pickled, length-prefixed weight dicts to a driver-side PS thread,
+plus ``determine_host_address()`` for discovery. This module is its
+TPU-cluster equivalent:
+
+- frames are the pickle-free npz PyTree encoding
+  (:func:`distkeras_tpu.utils.pytree.serialize_pytree`) — safe to accept
+  from the network, unlike pickle;
+- the server is a thin gRPC front that forwards pull/commit messages into
+  the same single-owner :class:`ParameterServerService` loop used
+  in-process, so protocol semantics (incl. DynSGD's update counter) are
+  identical regardless of transport;
+- async-protocol traffic rides DCN between worker islands while each
+  island's sync all-reduce rides ICI — the two-plane design from SURVEY §5.
+
+grpcio is used without generated stubs (GenericRpcHandler + raw method
+handlers) so no protoc step is needed at build or run time.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from concurrent import futures
+from typing import Any
+
+import numpy as np
+
+from distkeras_tpu.parallel.ps import ParameterServerService
+from distkeras_tpu.utils.pytree import deserialize_pytree, serialize_pytree
+
+__all__ = [
+    "determine_host_address",
+    "GrpcParameterServer",
+    "GrpcClient",
+    "DEFAULT_PORT",
+]
+
+DEFAULT_PORT = 50515
+_SERVICE = "distkeras_tpu.ParameterServer"
+
+
+def determine_host_address() -> str:
+    """Best-effort routable address of this host (reference
+    ``distkeras/networking.py`` § ``determine_host_address``)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # No packets are sent; connect() on UDP just resolves the route.
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+# -- wire format -------------------------------------------------------------
+# pull request:   b""            -> reply: u64 num_updates | npz(center)
+# commit request: u64 last_update | npz(delta)  -> reply: b"\x01"
+
+
+def _encode_pull_reply(center: Any, num_updates: int) -> bytes:
+    return struct.pack("<Q", num_updates) + serialize_pytree(center)
+
+
+def _decode_pull_reply(data: bytes, like: Any = None) -> tuple[Any, int]:
+    (num_updates,) = struct.unpack("<Q", data[:8])
+    return deserialize_pytree(data[8:], like=like), num_updates
+
+
+def _encode_commit(delta: Any, last_update: int) -> bytes:
+    return struct.pack("<Q", last_update) + serialize_pytree(delta)
+
+
+def _decode_commit(data: bytes) -> dict:
+    (last_update,) = struct.unpack("<Q", data[:8])
+    return {"delta": deserialize_pytree(data[8:]), "last_update": int(last_update)}
+
+
+class GrpcParameterServer:
+    """gRPC front-end around a :class:`ParameterServerService`.
+
+    Lifecycle mirrors the reference PS (``initialize``/``run``/``stop``):
+
+        ps = GrpcParameterServer(protocol, center, num_workers, port=0)
+        port = ps.start()          # also starts the single-owner loop
+        ...
+        final = ps.get_model(); ps.stop()
+    """
+
+    def __init__(self, protocol, center, num_workers, host="0.0.0.0", port=DEFAULT_PORT):
+        import grpc
+
+        self._grpc = grpc
+        self.service = ParameterServerService(protocol, center, num_workers)
+        self._host = host
+        self._port = port
+        self._server = None
+
+    def _handle(self, method: str):
+        grpc = self._grpc
+        inproc = self.service.client()
+
+        def pull(request: bytes, context) -> bytes:
+            center, num_updates = inproc.pull()
+            return _encode_pull_reply(center, num_updates)
+
+        def commit(request: bytes, context) -> bytes:
+            inproc.commit(_decode_commit(request))
+            return b"\x01"
+
+        fn = {"pull": pull, "commit": commit}.get(method)
+        if fn is None:
+            return None
+        return grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
+    def start(self) -> int:
+        grpc = self._grpc
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                name = handler_call_details.method.rsplit("/", 1)[-1]
+                return outer._handle(name)
+
+        self.service.start()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=[
+                ("grpc.max_receive_message_length", -1),
+                ("grpc.max_send_message_length", -1),
+            ],
+        )
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self._port = self._server.add_insecure_port(f"{self._host}:{self._port}")
+        self._server.start()
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1).wait()
+            self._server = None
+        self.service.stop()
+
+    def get_model(self):
+        return self.service.get_model()
+
+
+class GrpcClient:
+    """Worker-side client with the same ``pull``/``commit`` surface as
+    :class:`distkeras_tpu.parallel.ps.InProcessClient` — trainers are
+    transport-agnostic."""
+
+    def __init__(self, host: str, port: int = DEFAULT_PORT, like: Any = None):
+        import grpc
+
+        self._channel = grpc.insecure_channel(
+            f"{host}:{port}",
+            options=[
+                ("grpc.max_receive_message_length", -1),
+                ("grpc.max_send_message_length", -1),
+            ],
+        )
+        self._pull = self._channel.unary_unary(
+            f"/{_SERVICE}/pull",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        self._commit = self._channel.unary_unary(
+            f"/{_SERVICE}/commit",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        self._like = like
+
+    def pull(self) -> tuple[Any, int]:
+        return _decode_pull_reply(self._pull(b""), like=self._like)
+
+    def commit(self, payload: dict) -> None:
+        import jax
+
+        delta = jax.tree.map(np.asarray, payload["delta"])
+        self._commit(_encode_commit(delta, int(payload.get("last_update", 0))))
+
+    def close(self) -> None:
+        self._channel.close()

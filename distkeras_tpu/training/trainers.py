@@ -382,7 +382,8 @@ class AsynchronousDistributedTrainer(Trainer):
         communication_window: int | None = None,
         learning_rate: float | None = None,
         seed: int = 0,
-        master_host: str | None = None,  # accepted for reference API parity
+        transport: str = "inprocess",  # "inprocess" | "grpc"
+        master_host: str | None = None,  # remote PS address (grpc transport)
         master_port: int | None = None,
         **protocol_kwargs,
     ):
@@ -393,6 +394,9 @@ class AsynchronousDistributedTrainer(Trainer):
         self.label_col = label_col
         self.num_epoch = int(num_epoch)
         self.parallelism_factor = int(parallelism_factor)
+        if transport not in ("inprocess", "grpc"):
+            raise ValueError(f"unknown transport {transport!r}")
+        self.transport = transport
         self.master_host = master_host
         self.master_port = master_port
         if communication_window is not None:
@@ -405,15 +409,41 @@ class AsynchronousDistributedTrainer(Trainer):
         return self.protocol_cls(**kwargs)
 
     # reference API parity: DistributedTrainer.service()/stop_service()
-    def service(self, center_params) -> ParameterServerService:
+    def service(self, center_params):
+        if self.transport == "grpc":
+            from distkeras_tpu.parallel.ps_grpc import GrpcParameterServer
+
+            grpc_ps = GrpcParameterServer(
+                self.protocol,
+                center_params,
+                self.num_workers,
+                port=self.master_port or 0,
+            )
+            self.master_port = grpc_ps.start()
+            if self.master_host is None:
+                self.master_host = "127.0.0.1"
+            self._grpc_ps = grpc_ps
+            self.parameter_server = grpc_ps.service
+            return grpc_ps
+        self._grpc_ps = None
         self.parameter_server = ParameterServerService(
             self.protocol, center_params, self.num_workers
         )
         self.parameter_server.start()
         return self.parameter_server
 
+    def _make_client(self):
+        if self.transport == "grpc":
+            from distkeras_tpu.parallel.ps_grpc import GrpcClient
+
+            return GrpcClient(self.master_host, self.master_port)
+        return self.parameter_server.client()
+
     def stop_service(self) -> None:
-        if self.parameter_server is not None:
+        if getattr(self, "_grpc_ps", None) is not None:
+            self._grpc_ps.stop()
+            self._grpc_ps = None
+        elif self.parameter_server is not None:
             self.parameter_server.stop()
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
@@ -437,7 +467,7 @@ class AsynchronousDistributedTrainer(Trainer):
         def worker_loop(widx: int):
             try:
                 device = devices[widx % len(devices)]
-                client = ps.client()
+                client = self._make_client()
                 center, carry = self.protocol.worker_begin(client, None)
                 params = jax.device_put(center, device)
                 state = TrainState.create(

@@ -1,0 +1,56 @@
+"""Job/Punchcard tests (local-execution mode; ssh paths need a cluster)."""
+
+import json
+
+from distkeras_tpu.deployment import Job, Punchcard
+
+
+def test_job_local_execute(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text("print('hello from job'); open('out.txt','w').write('done')\n")
+    job = Job(
+        "j1", address=None, script_path=str(script),
+        remote_dir=str(tmp_path / "jobs"), fetch=("out.txt",),
+    )
+    code = job.run(local_artifact_dir=str(tmp_path / "artifacts"))
+    assert code == 0
+    assert "hello from job" in job.output
+    assert (tmp_path / "artifacts" / "out.txt").read_text() == "done"
+
+
+def test_job_failure_code(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("raise SystemExit(3)\n")
+    job = Job("j2", address=None, script_path=str(script),
+              remote_dir=str(tmp_path / "jobs"))
+    assert job.run() == 3
+
+
+def test_punchcard(tmp_path):
+    s1 = tmp_path / "a.py"; s1.write_text("print('a')\n")
+    s2 = tmp_path / "b.py"; s2.write_text("print('b')\n")
+    spec = {
+        "jobs": [
+            {"job_name": "a", "address": None, "script_path": str(s1),
+             "remote_dir": str(tmp_path / "jobs")},
+            {"job_name": "b", "address": None, "script_path": str(s2),
+             "remote_dir": str(tmp_path / "jobs")},
+        ]
+    }
+    p = tmp_path / "card.json"
+    p.write_text(json.dumps(spec))
+    codes = Punchcard(str(p)).run()
+    assert codes == [0, 0]
+
+
+def test_punchcard_stops_on_failure(tmp_path):
+    bad = tmp_path / "bad.py"; bad.write_text("raise SystemExit(1)\n")
+    ok = tmp_path / "ok.py"; ok.write_text("print('ok')\n")
+    spec = {"jobs": [
+        {"job_name": "bad", "address": None, "script_path": str(bad),
+         "remote_dir": str(tmp_path / "jobs")},
+        {"job_name": "ok", "address": None, "script_path": str(ok),
+         "remote_dir": str(tmp_path / "jobs")},
+    ]}
+    p = tmp_path / "card.json"; p.write_text(json.dumps(spec))
+    assert Punchcard(str(p)).run() == [1]

@@ -1,0 +1,50 @@
+"""Keras 3 model façade: dist-keras notebooks hand trainers a Keras model
+(reference ``distkeras/trainers.py`` § ``Trainer.__init__(keras_model, ...)``);
+Model.from_keras adapts one onto the PyTree engine via the JAX backend."""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+import distkeras_tpu as dk  # noqa: E402
+from distkeras_tpu.models.core import Model  # noqa: E402
+
+
+@pytest.fixture
+def keras_mlp():
+    if keras.backend.backend() != "jax":
+        pytest.skip("keras JAX backend not active")
+    model = keras.Sequential(
+        [
+            keras.layers.Input(shape=(12,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(2),
+        ]
+    )
+    return model
+
+
+def test_from_keras_init_and_apply(keras_mlp):
+    m = Model.from_keras(keras_mlp)
+    variables = m.init(0)
+    x = np.random.default_rng(0).normal(size=(4, 12)).astype(np.float32)
+    out, state = m.apply(variables, x, train=False)
+    assert out.shape == (4, 2)
+    assert state == {}
+
+
+def test_keras_model_trains_with_single_trainer(keras_mlp):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 12)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    ds = dk.Dataset.from_arrays(features=x, label=y)
+    # the reference pattern: pass the Keras model straight to the trainer
+    trainer = dk.SingleTrainer(
+        keras_mlp, worker_optimizer="adam", learning_rate=0.01,
+        loss="categorical_crossentropy", batch_size=32, num_epoch=6,
+    )
+    trained = trainer.train(ds)
+    preds = trained.predict(x)
+    acc = float(np.mean(np.argmax(preds, -1) == y))
+    assert acc > 0.85, acc
