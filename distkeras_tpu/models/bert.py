@@ -40,6 +40,9 @@ class BertConfig:
     max_seq_len: int = 512
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
+    # Use the Pallas flash-attention kernel (ops/pallas/flash_attention.py)
+    # instead of dense attention. Unmasked attention only.
+    use_flash_attention: bool = False
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -67,9 +70,16 @@ class SelfAttention(nn.Module):
         v = _dense(cfg.hidden_size, qkv_axes, "value", cfg.dtype)(x)
         B, S = x.shape[0], x.shape[1]
         shape = (B, S, cfg.num_heads, head_dim)
-        out = dot_product_attention(
-            q.reshape(shape), k.reshape(shape), v.reshape(shape), mask=mask
-        )
+        if cfg.use_flash_attention and mask is None:
+            from distkeras_tpu.ops.pallas.flash_attention import flash_attention
+
+            out = flash_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape)
+            )
+        else:
+            out = dot_product_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape), mask=mask
+            )
         out = out.reshape(B, S, cfg.hidden_size)
         return _dense(cfg.hidden_size, ("heads", "embed"), "out", cfg.dtype)(out)
 

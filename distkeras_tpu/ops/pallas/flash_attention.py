@@ -1,0 +1,164 @@
+"""Flash attention — Pallas TPU kernel for the attention hot op.
+
+Blocked online-softmax attention: Q tiles stream through VMEM against K/V
+blocks with float32 running max/denominator, so the ``S×S`` score matrix is
+never materialized in HBM. QK^T and PV matmuls hit the MXU in the input
+dtype (bfloat16 end-to-end on TPU) with float32 accumulation
+(``preferred_element_type``), softmax statistics stay float32 on the VPU.
+
+The reference framework has no attention at all (2016-era MLPs/CNNs,
+SURVEY §5); this kernel serves the BERT family and the long-context path —
+composing with ring attention (:mod:`distkeras_tpu.ops.attention`): ring
+hops move K/V shards between chips, this kernel computes each local block.
+
+Training: exposed through ``jax.custom_vjp``. The backward pass recomputes
+attention with the dense jnp path under ``jax.vjp`` (flash-style fused
+backward is future work) — forward memory stays O(S·D), backward costs the
+dense O(S²) scores transiently.
+
+Tests run the same kernel with ``interpret=True`` on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+                  causal: bool, q_block: int, seq_len: int):
+    q = q_ref[0]  # [block_q, D]
+    num_k_blocks = seq_len // block_k
+    block_q = q.shape[0]
+    d = q.shape[1]
+    q_start = pl.program_id(1) * q_block
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :]  # [block_k, D]
+        v = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+    """q/k/v: [BH, S, D] -> [BH, S, D]."""
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq_len {s} must divide block sizes ({block_q},{block_k})")
+    scale = d**-0.5
+    kernel = functools.partial(
+        _flash_kernel,
+        block_k=block_k,
+        scale=scale,
+        causal=causal,
+        q_block=block_q,
+        seq_len=s,
+    )
+    grid = (bh, s // block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense_reference(q, k, v, causal):
+    scale = q.shape[-1] ** -0.5
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    ) * scale  # [BH, Sq, Sk]
+    if causal:
+        S_q, S_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((S_q, S_k), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jax.lax.dot_general(
+        w, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Flash attention over ``[B, S, H, D]`` inputs (same convention as
+    :func:`distkeras_tpu.ops.attention.dot_product_attention`).
+
+    ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
+    interpreter elsewhere (CPU tests).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, S, H, D = q.shape
+    fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(B * H, S, D)
+    unfold = lambda x: jnp.moveaxis(x.reshape(B, H, S, D), 1, 2)
+    out = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k, interpret)
+    return unfold(out)

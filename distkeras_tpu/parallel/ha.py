@@ -1,0 +1,128 @@
+"""High-availability wrappers for PS clients.
+
+The reference had no failure handling: a PS crash hung every worker, and a
+Spark task retry silently re-applied a partition's updates (at-least-once
+skew — SURVEY §5). Here:
+
+- :class:`RetryingClient` retries pull/commit with exponential backoff and
+  surfaces a :class:`ParameterServerUnavailable` only after the budget is
+  exhausted — transient DCN blips don't kill a training run;
+- :class:`StampingClient` attaches a unique ``commit_id`` to every commit so
+  the PS's dedupe window (``ParameterServerService.dedupe_window``) makes
+  retried commits exactly-once;
+- :func:`watchdog` polls a client's ``health`` and invokes a callback when
+  the PS stops making progress.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+__all__ = [
+    "ParameterServerUnavailable",
+    "RetryingClient",
+    "StampingClient",
+    "watchdog",
+]
+
+
+class ParameterServerUnavailable(RuntimeError):
+    pass
+
+
+class RetryingClient:
+    """Wrap any pull/commit client with retry + backoff."""
+
+    def __init__(
+        self,
+        client,
+        max_retries: int = 5,
+        base_delay: float = 0.2,
+        max_delay: float = 10.0,
+    ):
+        self._client = client
+        self.max_retries = int(max_retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+
+    def _with_retries(self, fn: Callable, what: str):
+        delay = self.base_delay
+        last_exc: BaseException | None = None
+        for _ in range(self.max_retries + 1):
+            try:
+                return fn()
+            except Exception as e:  # transport-level failure
+                last_exc = e
+                time.sleep(delay)
+                delay = min(delay * 2, self.max_delay)
+        raise ParameterServerUnavailable(
+            f"{what} failed after {self.max_retries + 1} attempts"
+        ) from last_exc
+
+    def pull(self):
+        return self._with_retries(self._client.pull, "pull")
+
+    def commit(self, payload: dict) -> None:
+        # Safe to retry only when the commit is idempotent (stamped).
+        self._with_retries(lambda: self._client.commit(payload), "commit")
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._client, name)
+
+
+class StampingClient:
+    """Attach monotonically-unique commit_ids for exactly-once application."""
+
+    def __init__(self, client, worker_id: int):
+        self._client = client
+        self._worker_id = int(worker_id)
+        self._counter = 0
+
+    def pull(self):
+        return self._client.pull()
+
+    def commit(self, payload: dict) -> None:
+        self._counter += 1
+        self._client.commit(
+            {**payload, "commit_id": f"w{self._worker_id}:{self._counter}"}
+        )
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._client, name)
+
+
+def watchdog(
+    health_fn: Callable[[], dict],
+    on_stall: Callable[[dict], None],
+    interval: float = 5.0,
+    stall_after: int = 3,
+    stop_event: threading.Event | None = None,
+) -> threading.Thread:
+    """Background thread: calls ``health_fn`` every ``interval`` seconds and
+    fires ``on_stall(last_health)`` after ``stall_after`` consecutive checks
+    with no commit progress (or failed health calls)."""
+    stop_event = stop_event or threading.Event()
+
+    def run():
+        last_commits = -1
+        stalls = 0
+        while not stop_event.wait(interval):
+            try:
+                h = health_fn()
+            except Exception:
+                h = {"running": False, "num_commits": last_commits}
+            if not h.get("running", False) or h.get("num_commits", 0) == last_commits:
+                stalls += 1
+                if stalls >= stall_after:
+                    on_stall(h)
+                    stalls = 0
+            else:
+                stalls = 0
+            last_commits = h.get("num_commits", last_commits)
+
+    t = threading.Thread(target=run, name="ps-watchdog", daemon=True)
+    t.stop_event = stop_event
+    t.start()
+    return t

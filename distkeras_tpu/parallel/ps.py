@@ -21,6 +21,7 @@ Design differences, deliberate (SURVEY §5 race-detection note):
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 from typing import Any
@@ -56,12 +57,20 @@ class ParameterServerService:
         protocol: AsyncProtocol,
         center: PyTree,
         num_workers: int,
+        dedupe_window: int = 8192,
     ):
         self.protocol = protocol
         self.num_workers = int(num_workers)
         self._center = _to_host(center)
         self._num_updates = 0
         self._num_commits = 0
+        self._num_duplicates = 0
+        # Idempotent commits: a retried/replayed commit (worker retry after a
+        # transport error, task re-execution) is applied at most once. The
+        # reference had at-least-once semantics here — Spark task retry
+        # silently re-applied a partition's updates (SURVEY §5 failure notes).
+        self._seen_ids: collections.OrderedDict = collections.OrderedDict()
+        self._dedupe_window = int(dedupe_window)
         self._queue: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self.running = False
@@ -100,6 +109,16 @@ class ParameterServerService:
                 snap = jax.tree.map(np.copy, self._center)
                 reply.put((snap, self._num_updates))
             elif action == _COMMIT:
+                cid = payload.get("commit_id")
+                if cid is not None and cid in self._seen_ids:
+                    self._num_duplicates += 1
+                    if reply is not None:
+                        reply.put(False)
+                    continue
+                if cid is not None:
+                    self._seen_ids[cid] = None
+                    while len(self._seen_ids) > self._dedupe_window:
+                        self._seen_ids.popitem(last=False)
                 self._center, self._num_updates = self.protocol.server_commit(
                     self._center, self._num_updates, payload, self.num_workers
                 )
@@ -127,6 +146,21 @@ class ParameterServerService:
     @property
     def num_commits(self) -> int:
         return self._num_commits
+
+    @property
+    def num_duplicates(self) -> int:
+        return self._num_duplicates
+
+    def health(self) -> dict:
+        """Liveness + progress snapshot (reference PS had none; a wedged PS
+        simply hung every worker — SURVEY §5)."""
+        return {
+            "running": self._thread is not None and self._thread.is_alive(),
+            "num_updates": self._num_updates,
+            "num_commits": self._num_commits,
+            "num_duplicates": self._num_duplicates,
+            "queue_depth": self._queue.qsize(),
+        }
 
     def client(self) -> "InProcessClient":
         return InProcessClient(self)

@@ -76,7 +76,14 @@ def _encode_commit(delta: Any, last_update: int) -> bytes:
 
 def _decode_commit(data: bytes) -> dict:
     (last_update,) = struct.unpack("<Q", data[:8])
-    return {"delta": deserialize_pytree(data[8:]), "last_update": int(last_update)}
+    tree = deserialize_pytree(data[8:])
+    out = {"last_update": int(last_update)}
+    if isinstance(tree, dict) and "__commit_id__" in tree:
+        out["commit_id"] = _array_to_id(tree["__commit_id__"])
+        out["delta"] = tree["d"]
+    else:
+        out["delta"] = tree
+    return out
 
 
 class GrpcParameterServer:
@@ -111,7 +118,12 @@ class GrpcParameterServer:
             inproc.commit(_decode_commit(request))
             return b"\x01"
 
-        fn = {"pull": pull, "commit": commit}.get(method)
+        def health(request: bytes, context) -> bytes:
+            import json
+
+            return json.dumps(self.service.health()).encode()
+
+        fn = {"pull": pull, "commit": commit, "health": health}.get(method)
         if fn is None:
             return None
         return grpc.unary_unary_rpc_method_handler(
@@ -181,6 +193,11 @@ class GrpcClient:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
+        self._health = self._channel.unary_unary(
+            f"/{_SERVICE}/health",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
         self._like = like
 
     def pull(self) -> tuple[Any, int]:
@@ -190,7 +207,23 @@ class GrpcClient:
         import jax
 
         delta = jax.tree.map(np.asarray, payload["delta"])
+        # commit_id rides as an extra npz leaf so the frame format is stable
+        if "commit_id" in payload:
+            delta = {"__commit_id__": _id_to_array(payload["commit_id"]), "d": delta}
         self._commit(_encode_commit(delta, int(payload.get("last_update", 0))))
+
+    def health(self, timeout: float = 5.0) -> dict:
+        import json
+
+        return json.loads(self._health(b"", timeout=timeout).decode())
 
     def close(self) -> None:
         self._channel.close()
+
+
+def _id_to_array(cid: str) -> np.ndarray:
+    return np.frombuffer(str(cid).encode("utf-8"), dtype=np.uint8).copy()
+
+
+def _array_to_id(arr: np.ndarray) -> str:
+    return bytes(np.asarray(arr, dtype=np.uint8)).decode("utf-8")

@@ -385,6 +385,9 @@ class AsynchronousDistributedTrainer(Trainer):
         transport: str = "inprocess",  # "inprocess" | "grpc"
         master_host: str | None = None,  # remote PS address (grpc transport)
         master_port: int | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_interval_s: float = 60.0,
+        resume: bool = False,
         **protocol_kwargs,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics, learning_rate, seed)
@@ -399,6 +402,9 @@ class AsynchronousDistributedTrainer(Trainer):
         self.transport = transport
         self.master_host = master_host
         self.master_port = master_port
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_interval_s = float(checkpoint_interval_s)
+        self.resume = bool(resume)
         if communication_window is not None:
             protocol_kwargs["communication_window"] = communication_window
         self.protocol = self._allocate_protocol(**protocol_kwargs)
@@ -453,7 +459,37 @@ class AsynchronousDistributedTrainer(Trainer):
             self.model, optimizer, self.loss, self.metrics, donate=False
         )
         init_state = TrainState.create(self.model, optimizer, rng=self.seed)
-        ps = self.service(init_state.params)
+        center_init = init_state.params
+        ckpt_mgr = None
+        if self.checkpoint_dir is not None:
+            from distkeras_tpu.checkpoint import CheckpointManager
+
+            ckpt_mgr = CheckpointManager(self.checkpoint_dir)
+            if self.resume and ckpt_mgr.latest_step() is not None:
+                restored = ckpt_mgr.restore(
+                    like={"ps": {"center": center_init, "num_updates": 0}}
+                )
+                center_init = restored["ps"]["center"]
+        ps = self.service(center_init)
+        if ckpt_mgr is not None:
+            svc = self.parameter_server
+            stop_ckpt = threading.Event()
+
+            def _periodic_checkpoint():
+                while not stop_ckpt.wait(self.checkpoint_interval_s):
+                    try:
+                        ckpt_mgr.save(
+                            svc.num_commits,
+                            ps_center=svc.get_model(),
+                            ps_num_updates=svc.num_updates,
+                        )
+                    except Exception:
+                        pass  # snapshotting must never take down training
+
+            ckpt_thread = threading.Thread(
+                target=_periodic_checkpoint, name="ps-checkpoint", daemon=True
+            )
+            ckpt_thread.start()
 
         devices = jax.local_devices()
         num_partitions = self.num_workers * self.parallelism_factor
@@ -467,7 +503,15 @@ class AsynchronousDistributedTrainer(Trainer):
         def worker_loop(widx: int):
             try:
                 device = devices[widx % len(devices)]
+                from distkeras_tpu.parallel.ha import RetryingClient, StampingClient
+
                 client = self._make_client()
+                if self.transport == "grpc":
+                    client = RetryingClient(client)
+                # Stamped commit ids + PS dedupe = exactly-once commits even
+                # through retries (the reference's Spark-retry path was
+                # silently at-least-once; SURVEY §5).
+                client = StampingClient(client, widx)
                 center, carry = self.protocol.worker_begin(client, None)
                 params = jax.device_put(center, device)
                 state = TrainState.create(
@@ -518,6 +562,15 @@ class AsynchronousDistributedTrainer(Trainer):
             t.join()
 
         center = ps.get_model()
+        if ckpt_mgr is not None:
+            stop_ckpt.set()
+            ckpt_thread.join(timeout=10)
+            ckpt_mgr.save(
+                self.parameter_server.num_commits,
+                ps_center=center,
+                ps_num_updates=self.parameter_server.num_updates,
+            )
+            ckpt_mgr.close()
         self.stop_service()
         for e in errors:
             if e is not None:

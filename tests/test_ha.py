@@ -1,0 +1,160 @@
+"""Failure-handling tests: retries, exactly-once commits, watchdog,
+PS checkpoint/resume through the trainer."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.parallel.ha import (
+    ParameterServerUnavailable,
+    RetryingClient,
+    StampingClient,
+    watchdog,
+)
+from distkeras_tpu.parallel.protocols import DOWNPOURProtocol
+from distkeras_tpu.parallel.ps import ParameterServerService
+
+
+class FlakyClient:
+    """Fails the first N calls of each method, then succeeds."""
+
+    def __init__(self, inner, fail_first=2):
+        self.inner = inner
+        self.fails = {"pull": fail_first, "commit": fail_first}
+
+    def pull(self):
+        if self.fails["pull"] > 0:
+            self.fails["pull"] -= 1
+            raise ConnectionError("flaky")
+        return self.inner.pull()
+
+    def commit(self, payload):
+        if self.fails["commit"] > 0:
+            self.fails["commit"] -= 1
+            raise ConnectionError("flaky")
+        return self.inner.commit(payload)
+
+
+def _service():
+    ps = ParameterServerService(
+        DOWNPOURProtocol(), {"w": np.zeros(2, np.float32)}, 2
+    )
+    ps.start()
+    return ps
+
+
+def test_retrying_client_recovers():
+    ps = _service()
+    try:
+        client = RetryingClient(FlakyClient(ps.client()), base_delay=0.01)
+        center, n = client.pull()
+        assert n == 0
+        client.commit({"delta": {"w": np.ones(2, np.float32)}})
+        center, n = client.pull()
+        assert np.allclose(center["w"], 1.0)
+    finally:
+        ps.stop()
+
+
+def test_retrying_client_gives_up():
+    class AlwaysDown:
+        def pull(self):
+            raise ConnectionError("down")
+
+    client = RetryingClient(AlwaysDown(), max_retries=2, base_delay=0.01)
+    with pytest.raises(ParameterServerUnavailable):
+        client.pull()
+
+
+def test_duplicate_commits_applied_once():
+    ps = _service()
+    try:
+        c = ps.client()
+        payload = {"delta": {"w": np.ones(2, np.float32)}, "commit_id": "w0:1"}
+        c.commit(payload)
+        c.commit(payload)  # replay (e.g. retry after timeout)
+        c.pull()  # barrier
+        assert ps.num_commits == 1
+        assert ps.num_duplicates == 1
+        assert np.allclose(ps.get_model()["w"], 1.0)
+    finally:
+        ps.stop()
+
+
+def test_stamping_client_ids_unique():
+    seen = []
+
+    class Capture:
+        def commit(self, payload):
+            seen.append(payload["commit_id"])
+
+        def pull(self):
+            return None, 0
+
+    c = StampingClient(Capture(), worker_id=3)
+    for _ in range(5):
+        c.commit({"delta": {}})
+    assert len(set(seen)) == 5
+    assert all(s.startswith("w3:") for s in seen)
+
+
+def test_health_snapshot():
+    ps = _service()
+    try:
+        h = ps.health()
+        assert h["running"] is True
+        assert h["num_commits"] == 0
+    finally:
+        ps.stop()
+    assert ps.health()["running"] is False
+
+
+def test_watchdog_fires_on_stall():
+    stalls = []
+    ev = threading.Event()
+    t = watchdog(
+        lambda: {"running": True, "num_commits": 0},
+        on_stall=lambda h: (stalls.append(h), ev.set()),
+        interval=0.05,
+        stall_after=2,
+    )
+    assert ev.wait(timeout=2.0)
+    t.stop_event.set()
+    assert stalls
+
+
+def test_trainer_ps_checkpoint_and_resume(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 6)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = dk.Dataset.from_arrays(features=x, label=y)
+    model = Model.from_flax(MLP(features=(8,), num_classes=2), input_shape=(6,))
+
+    t1 = dk.DOWNPOUR(
+        model, worker_optimizer="adam", learning_rate=0.01, num_workers=2,
+        batch_size=16, num_epoch=2, communication_window=2,
+        checkpoint_dir=str(tmp_path / "ps_ckpt"),
+    )
+    trained1 = t1.train(ds)
+    # a final checkpoint exists
+    from distkeras_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ps_ckpt"))
+    assert mgr.latest_step() is not None
+    mgr.close()
+
+    # resume: center starts from the checkpoint, not from fresh init
+    t2 = dk.DOWNPOUR(
+        model, worker_optimizer="adam", learning_rate=0.01, num_workers=2,
+        batch_size=16, num_epoch=1, communication_window=2,
+        checkpoint_dir=str(tmp_path / "ps_ckpt"), resume=True,
+    )
+    trained2 = t2.train(ds)
+    preds = trained2.predict(x)
+    acc = float(np.mean(np.argmax(preds, -1) == y))
+    assert acc > 0.8, acc
