@@ -61,13 +61,30 @@ class Dataset:
         vector column ``features_col`` (mirroring Spark's VectorAssembler
         stage that dist-keras notebooks used before the trainers).
         """
-        with open(path, newline="") as f:
-            reader = _csv.reader(f)
-            header = next(reader)
+        from distkeras_tpu.data import native
+
+        with open(path, "rb") as fb:
+            raw = fb.read()
+        nl = raw.index(b"\n")
+        header = raw[:nl].decode().strip().split(",")
+        body = raw[nl + 1 :]
+        table: dict[str, np.ndarray] = {}
+        if native.available():
+            # Native columnar parse for all-numeric tables (the common case:
+            # the reference's ATLAS-Higgs CSV is numeric throughout).
+            nrows = body.count(b"\n") + (0 if body.endswith(b"\n") or not body else 1)
+            try:
+                mat = native.parse_csv(body, rows=nrows, cols=len(header))
+                table = {name: mat[:, i] for i, name in enumerate(header)}
+            except ValueError:
+                table = {}
+        if not table:
+            reader = _csv.reader(body.decode().splitlines())
             rows = [r for r in reader if r]
-        table = {
-            name: np.array([row[i] for row in rows]) for i, name in enumerate(header)
-        }
+            table = {
+                name: np.array([row[i] for row in rows])
+                for i, name in enumerate(header)
+            }
         out: dict[str, np.ndarray] = {}
         if features is not None:
             out[features_col] = np.stack(
@@ -126,7 +143,15 @@ class Dataset:
         return Dataset({k: v[start:stop] for k, v in self._columns.items()})
 
     def gather(self, indices: np.ndarray) -> "Dataset":
-        return Dataset({k: v[indices] for k, v in self._columns.items()})
+        from distkeras_tpu.data import native
+
+        def _one(v: np.ndarray) -> np.ndarray:
+            # Native memcpy gather for the float32 hot path; numpy otherwise.
+            if native.available() and v.dtype == np.float32 and v.flags["C_CONTIGUOUS"]:
+                return native.gather_rows(v, indices)
+            return v[indices]
+
+        return Dataset({k: _one(v) for k, v in self._columns.items()})
 
     def shuffle(self, seed: int = 0) -> "Dataset":
         """Row shuffle (reference ``distkeras/utils.py`` § ``shuffle``)."""

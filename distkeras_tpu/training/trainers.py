@@ -84,6 +84,7 @@ class Trainer:
         metrics: tuple[str, ...] = ("accuracy",),
         learning_rate: float | None = None,
         seed: int = 0,
+        metric_stream=None,
     ):
         self.model = _as_model(keras_model)
         self.loss = loss
@@ -91,6 +92,9 @@ class Trainer:
         self.metrics = tuple(metrics)
         self.learning_rate = learning_rate
         self.seed = seed
+        # Optional distkeras_tpu.tracing.MetricStream receiving per-step
+        # records (loss/accuracy/worker) as training runs.
+        self.metric_stream = metric_stream
         self.history: list[dict] = []
         self._training_start: float | None = None
         self._training_stop: float | None = None
@@ -128,6 +132,12 @@ class Trainer:
                 continue
         return out
 
+    def _emit_history(self) -> None:
+        if self.metric_stream is None:
+            return
+        for i, h in enumerate(self.history):
+            self.metric_stream.emit(i, h)
+
     def _optimizer(self):
         return get_optimizer(self.worker_optimizer, self.learning_rate)
 
@@ -151,8 +161,10 @@ class SingleTrainer(Trainer):
         num_epoch: int = 1,
         learning_rate: float | None = None,
         seed: int = 0,
+        metric_stream=None,
     ):
-        super().__init__(keras_model, worker_optimizer, loss, metrics, learning_rate, seed)
+        super().__init__(keras_model, worker_optimizer, loss, metrics,
+                         learning_rate, seed, metric_stream)
         self.features_col = features_col
         self.label_col = label_col
         self.batch_size = int(batch_size)
@@ -179,6 +191,7 @@ class SingleTrainer(Trainer):
         self.history = [
             {k: float(v) for k, v in h.items()} for h in self.history
         ]
+        self._emit_history()
         self.record_training_stop()
         return TrainedModel(self.model, jax.device_get(state.variables))
 
@@ -201,8 +214,10 @@ class _VmappedReplicasTrainer(Trainer):
         num_epoch: int = 1,
         learning_rate: float | None = None,
         seed: int = 0,
+        metric_stream=None,
     ):
-        super().__init__(keras_model, worker_optimizer, loss, metrics, learning_rate, seed)
+        super().__init__(keras_model, worker_optimizer, loss, metrics,
+                         learning_rate, seed, metric_stream)
         self.num_models = int(num_models)
         self.features_col = features_col
         self.label_col = label_col
@@ -222,6 +237,18 @@ class _VmappedReplicasTrainer(Trainer):
             for i in range(self.num_models)
         ]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+        # Shard the replica axis over devices when it divides evenly: N
+        # models train on N chips as one XLA program (the TPU-first form of
+        # the reference's N-executor fan-out).
+        replica_sharding = None
+        devices = jax.devices()
+        if len(devices) > 1 and self.num_models % len(devices) == 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = best_mesh()
+            replica_sharding = NamedSharding(mesh, P("dp"))
+            stacked = jax.device_put(stacked, replica_sharding)
 
         parts = dataset.partitions(self.num_models)
         iters = [
@@ -246,6 +273,10 @@ class _VmappedReplicasTrainer(Trainer):
             batch = {
                 k: np.stack([b[k] for b in batch_group]) for k in batch_group[0]
             }
+            if replica_sharding is not None:
+                batch = {
+                    k: jax.device_put(v, replica_sharding) for k, v in batch.items()
+                }
             stacked, m = vstep(stacked, batch)
             self.history.append(m)
         self.history = [
@@ -316,8 +347,10 @@ class SynchronousDistributedTrainer(Trainer):
         learning_rate: float | None = None,
         seed: int = 0,
         mesh=None,
+        metric_stream=None,
     ):
-        super().__init__(keras_model, worker_optimizer, loss, metrics, learning_rate, seed)
+        super().__init__(keras_model, worker_optimizer, loss, metrics,
+                         learning_rate, seed, metric_stream)
         self.num_workers = num_workers
         self.batch_size = int(batch_size)
         self.features_col = features_col
@@ -329,13 +362,32 @@ class SynchronousDistributedTrainer(Trainer):
         self.record_training_start()
         mesh = self.mesh if self.mesh is not None else best_mesh(self.num_workers)
         ndev = mesh.devices.size
-        global_batch = self.batch_size * ndev
-        batch_sharding, replicated = data_parallel_shardings(mesh)
+        # batch_size is per-worker (reference semantics); dp-like axes carry
+        # the data parallelism.
+        dp_size = 1
+        for ax in ("dp", "fsdp"):
+            if ax in mesh.axis_names:
+                dp_size *= mesh.shape[ax]
+        global_batch = self.batch_size * dp_size
 
         optimizer = self._optimizer()
-        step_fn = make_train_step(self.model, optimizer, self.loss, self.metrics)
-        state = TrainState.create(self.model, optimizer, rng=self.seed)
-        state = jax.device_put(state, replicated)
+        model_axes = any(a in mesh.axis_names and mesh.shape[a] > 1 for a in ("tp", "sp"))
+        if model_axes and hasattr(self.model, "boxed_init"):
+            # GSPMD data+model sharding (logical-axis-annotated model).
+            from distkeras_tpu.parallel.gspmd import (
+                batch_sharding as make_batch_sharding,
+                make_sharded_train_step,
+                sharded_train_state,
+            )
+
+            state, _ = sharded_train_state(self.model, optimizer, mesh, rng=self.seed)
+            step_fn = make_sharded_train_step(self.model, optimizer, self.loss, mesh)
+            batch_sharding = make_batch_sharding(mesh, 2, seq_dim=None)
+        else:
+            batch_sharding, replicated = data_parallel_shardings(mesh)
+            step_fn = make_train_step(self.model, optimizer, self.loss, self.metrics)
+            state = TrainState.create(self.model, optimizer, rng=self.seed)
+            state = jax.device_put(state, replicated)
 
         self.history = []
         for batch in minibatches(
@@ -350,6 +402,7 @@ class SynchronousDistributedTrainer(Trainer):
             state, m = step_fn(state, sharded)
             self.history.append(m)
         self.history = [{k: float(v) for k, v in h.items()} for h in self.history]
+        self._emit_history()
         self.record_training_stop()
         return TrainedModel(self.model, jax.device_get(state.variables))
 
@@ -388,9 +441,11 @@ class AsynchronousDistributedTrainer(Trainer):
         checkpoint_dir: str | None = None,
         checkpoint_interval_s: float = 60.0,
         resume: bool = False,
+        metric_stream=None,
         **protocol_kwargs,
     ):
-        super().__init__(keras_model, worker_optimizer, loss, metrics, learning_rate, seed)
+        super().__init__(keras_model, worker_optimizer, loss, metrics,
+                         learning_rate, seed, metric_stream)
         self.num_workers = int(num_workers)
         self.batch_size = int(batch_size)
         self.features_col = features_col
@@ -583,6 +638,7 @@ class AsynchronousDistributedTrainer(Trainer):
         ]
         model_state = next((s for s in final_states if s), {}) or {}
         variables = {"params": center, **model_state}
+        self._emit_history()
         self.record_training_stop()
         return TrainedModel(self.model, variables)
 

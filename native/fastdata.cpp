@@ -1,0 +1,100 @@
+// fastdata: native data-plane helpers for dist-keras-tpu.
+//
+// The reference framework assembled minibatches row-by-row in Python inside
+// Spark executors (its data-path bottleneck; SURVEY §3.1 hot loop). Here the
+// host-side data plane is native: CSV parsing into columnar float32 buffers,
+// permutation gather for shuffled epochs, and strided minibatch packing —
+// all operating on raw buffers shared with numpy through ctypes (no copies
+// besides the output writes, no Python objects per row).
+//
+// Build: make -C native   (produces libfastdata.so; loaded via ctypes by
+// distkeras_tpu/data/native.py, with a pure-numpy fallback when absent).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <cmath>
+
+extern "C" {
+
+// Parse a headerless CSV byte buffer of `rows` x `cols` numeric fields into
+// a pre-allocated float32 column-major-by-row (C-order [rows, cols]) array.
+// Returns the number of rows parsed, or -1 on malformed input.
+int64_t fd_parse_csv_f32(const char* buf, int64_t len, float* out,
+                         int64_t rows, int64_t cols) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t r = 0;
+  while (r < rows && p < end) {
+    for (int64_t c = 0; c < cols; ++c) {
+      // strtof skips leading whitespace; it stops at ',' or '\n'.
+      char* next = nullptr;
+      float v = strtof(p, &next);
+      if (next == p) return -1;  // no progress: malformed field
+      out[r * cols + c] = v;
+      p = next;
+      if (c + 1 < cols) {
+        if (p < end && *p == ',') ++p;
+        else return -1;
+      }
+    }
+    while (p < end && (*p == '\r' || *p == '\n' || *p == ',')) ++p;
+    ++r;
+  }
+  return r;
+}
+
+// Gather rows: out[i, :] = src[idx[i], :]  (the shuffle/epoch permutation).
+void fd_gather_f32(const float* src, const int64_t* idx, float* out,
+                   int64_t n_out, int64_t row_elems) {
+  for (int64_t i = 0; i < n_out; ++i) {
+    std::memcpy(out + i * row_elems, src + idx[i] * row_elems,
+                sizeof(float) * (size_t)row_elems);
+  }
+}
+
+// Pack a [batch, ...] minibatch from contiguous rows starting at `start`,
+// applying an optional affine transform (scale/shift — fused min-max
+// normalization so the feed doesn't need a second pass over the data).
+void fd_pack_batch_f32(const float* src, float* out, int64_t start,
+                       int64_t batch, int64_t row_elems, float scale,
+                       float shift) {
+  const float* s = src + start * row_elems;
+  int64_t n = batch * row_elems;
+  if (scale == 1.0f && shift == 0.0f) {
+    std::memcpy(out, s, sizeof(float) * (size_t)n);
+  } else {
+    for (int64_t i = 0; i < n; ++i) out[i] = s[i] * scale + shift;
+  }
+}
+
+// Fisher-Yates permutation with SplitMix64 — deterministic given seed.
+void fd_permutation(int64_t* out, int64_t n, uint64_t seed) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t x = seed + 0x9E3779B97F4A7C15ull;
+  for (int64_t i = n - 1; i > 0; --i) {
+    // splitmix64 step
+    uint64_t z = (x += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    z = z ^ (z >> 31);
+    int64_t j = (int64_t)(z % (uint64_t)(i + 1));
+    int64_t t = out[i]; out[i] = out[j]; out[j] = t;
+  }
+}
+
+// Column min/max in one pass (for MinMaxTransformer's fitted mode).
+void fd_minmax_f32(const float* src, int64_t n, float* out_min,
+                   float* out_max) {
+  float lo = INFINITY, hi = -INFINITY;
+  for (int64_t i = 0; i < n; ++i) {
+    float v = src[i];
+    if (v < lo) lo = v;
+    if (v > hi) hi = v;
+  }
+  *out_min = lo;
+  *out_max = hi;
+}
+
+}  // extern "C"

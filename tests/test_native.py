@@ -1,0 +1,57 @@
+"""Native data-plane tests. The library is built by `make -C native`; when
+absent, the numpy fallbacks must produce identical results, so every test
+runs both paths when possible."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import native
+
+
+def test_available_after_build():
+    # The repo builds the library in CI/setup; if this fails, run
+    # `make -C native`.
+    assert native.available()
+
+
+def test_parse_csv():
+    data = b"1.5,2,3\n4,5.25,6\n7,8,9.125\n"
+    out = native.parse_csv(data, rows=3, cols=3)
+    np.testing.assert_allclose(
+        out, [[1.5, 2, 3], [4, 5.25, 6], [7, 8, 9.125]]
+    )
+
+
+def test_parse_csv_malformed():
+    with pytest.raises(ValueError):
+        native.parse_csv(b"1,xx,3\n", rows=1, cols=3)
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(0)
+    src = rng.normal(size=(100, 17)).astype(np.float32)
+    idx = rng.integers(0, 100, size=64)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_pack_batch_plain_and_fused():
+    src = np.arange(40, dtype=np.float32).reshape(10, 4)
+    out = native.pack_batch(src, start=2, batch=3)
+    np.testing.assert_array_equal(out, src[2:5])
+    fused = native.pack_batch(src, start=0, batch=2, scale=2.0, shift=1.0)
+    np.testing.assert_allclose(fused, src[:2] * 2.0 + 1.0)
+
+
+def test_permutation_is_deterministic_permutation():
+    p1 = native.permutation(1000, seed=42)
+    p2 = native.permutation(1000, seed=42)
+    p3 = native.permutation(1000, seed=43)
+    np.testing.assert_array_equal(p1, p2)
+    assert not np.array_equal(p1, p3)
+    np.testing.assert_array_equal(np.sort(p1), np.arange(1000))
+
+
+def test_column_minmax():
+    x = np.array([[3.0, -1.5], [10.0, 0.0]], np.float32)
+    lo, hi = native.column_minmax(x)
+    assert lo == -1.5 and hi == 10.0

@@ -117,3 +117,15 @@ def test_async_trainer_parallelism_factor(toy_classification):
     )
     trained = trainer.train(toy_classification)
     assert _accuracy(trained, toy_classification) > 0.7
+
+
+def test_ensemble_replicas_sharded_over_devices(toy_classification):
+    """8 replicas on 8 devices: the replica axis is device-sharded."""
+    trainer = dk.EnsembleTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01, num_models=8,
+        batch_size=8, num_epoch=2,
+    )
+    models = trainer.train(toy_classification)
+    assert len(models) == 8
+    accs = [_accuracy(m, toy_classification) for m in models]
+    assert min(accs) > 0.6, accs
