@@ -1,0 +1,116 @@
+"""Pipeline parallelism over a ``pp`` mesh axis (GPipe-style microbatching).
+
+Absent from the reference (SURVEY §2 parallelism table) but a first-class
+axis here. The design is SPMD, not a scheduler: every device runs the same
+program under ``shard_map``; stage identity comes from ``lax.axis_index``.
+Per tick, each device applies *its* stage to its current activation and
+rotates activations one hop forward with ``lax.ppermute`` (ICI neighbor
+traffic only). A pipeline of P stages fed M microbatches drains in
+``M + P - 1`` ticks — the classic GPipe bubble of (P-1)/(M+P-1).
+
+Constraints (by construction of the rotation): every stage maps activations
+of one shape to the same shape — the transformer-block case. Embedding/head
+layers stay outside the pipelined trunk.
+
+The whole schedule is a ``lax.scan``, so it differentiates: gradients flow
+back through the ppermutes (reverse hops) and the per-stage applications,
+giving pipeline-parallel *training*, not just inference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params", "pipeline_shardings"]
+
+
+def stack_stage_params(stage_params_list):
+    """Stack per-stage parameter PyTrees on a leading 'stage' axis
+    ([P, ...] leaves) — shard that axis over ``pp``."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
+
+
+def pipeline_shardings(mesh: Mesh):
+    """(stacked_params_sharding, io_sharding) for :func:`pipeline_apply`."""
+    params = NamedSharding(mesh, P("pp"))
+    io = NamedSharding(mesh, P())  # microbatches replicated; refine as needed
+    return params, io
+
+
+def _pipeline_local(stage_fn, stacked_params, microbatches, axis_name: str):
+    """Per-device body (inside shard_map).
+
+    ``stacked_params``: this device's stage params ([1, ...] leaves —
+    the 'pp'-sharded stack). ``microbatches``: [M, B, D] (replicated).
+    Returns [M, B, D]: outputs of the final stage (valid on every device:
+    results are rotated full-circle so the scan output lands everywhere).
+    """
+    p = lax.axis_index(axis_name)
+    num_stages = lax.axis_size(axis_name)
+    my_params = jax.tree.map(lambda x: x[0], stacked_params)
+    M, B = microbatches.shape[0], microbatches.shape[1]
+    feat_shape = microbatches.shape[2:]
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    # pvary: the carry must be device-varying over the pp axis from the
+    # start (ppermute outputs are varying; scan carries must type-match).
+    state = lax.pvary(jnp.zeros((B, *feat_shape), microbatches.dtype), (axis_name,))
+
+    def tick(carry, t):
+        state = carry
+        # stage 0 ingests microbatch t (clamped; masked when t >= M)
+        x_in = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+        )
+        state = jnp.where(p == 0, jnp.where(t < M, x_in, state), state)
+        y = stage_fn(my_params, state)
+        # after the last stage computes microbatch (t - P + 1), its result
+        # rotates back to stage 0's slot; emit from the last stage.
+        emitted = jnp.where(p == num_stages - 1, y, jnp.zeros_like(y))
+        # sum over the axis so every device carries the emitted value
+        emitted = lax.psum(emitted, axis_name)
+        state = lax.ppermute(y, axis_name, perm)
+        return state, emitted
+
+    _, emitted_seq = lax.scan(tick, state, jnp.arange(M + num_stages - 1))
+    # microbatch m is emitted at tick m + P - 1
+    return emitted_seq[num_stages - 1 :]
+
+
+def pipeline_apply(
+    stage_fn,
+    stacked_params,
+    microbatches,
+    mesh: Mesh,
+    axis_name: str = "pp",
+):
+    """Run a P-stage pipeline over ``mesh[axis_name]``.
+
+    - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``;
+    - ``stacked_params``: PyTree with leading stage axis (see
+      :func:`stack_stage_params`), sharded over ``axis_name``;
+    - ``microbatches``: ``[M, B, ...]`` array.
+
+    Returns ``[M, B, ...]`` — the final stage's outputs, replicated.
+    Differentiable end-to-end.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    num_stages = mesh.shape[axis_name]
+    spec_params = P(axis_name)
+    fn = shard_map(
+        partial(_pipeline_local, stage_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: spec_params, stacked_params), P()),
+        out_specs=P(),
+    )
+    M = microbatches.shape[0]
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+    del num_stages
+    return fn(stacked_params, microbatches)

@@ -31,6 +31,7 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("mlp", "tp"),
     ("kv", None),
     ("vocab", "tp"),
+    ("expert", "ep"),
 )
 
 

@@ -1,0 +1,70 @@
+"""MoE expert-parallel tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.ops.moe import MoEMLP
+from distkeras_tpu.parallel.mesh import make_mesh
+
+
+def _build(rng, E=4, D=16, M=32, factor=8.0):
+    module = MoEMLP(num_experts=E, mlp_dim=M, capacity_factor=factor,
+                    dtype=jnp.float32)
+    x = jnp.asarray(rng.normal(size=(2, 8, D)), jnp.float32)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    import flax.linen as nn
+
+    return module, nn.meta.unbox(dict(variables)), x
+
+
+def test_moe_matches_per_token_reference(rng):
+    # capacity_factor large enough that nothing is dropped
+    module, variables, x = _build(rng)
+    out = module.apply(variables, x)
+    ref = MoEMLP.reference_forward(variables, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_moe_capacity_drops_pass_through(rng):
+    # capacity 1 slot per expert: overflowing tokens keep their residual
+    module, variables, x = _build(rng, factor=0.0001)
+    out = module.apply(variables, x)
+    assert np.isfinite(np.asarray(out)).all()
+    # dropped tokens equal input exactly (residual passthrough)
+    diff = np.abs(np.asarray(out) - np.asarray(x)).reshape(-1, x.shape[-1]).sum(-1)
+    assert (diff < 1e-6).sum() > 0  # at least some tokens dropped
+
+
+def test_moe_expert_sharded_over_ep(rng):
+    from distkeras_tpu.parallel.sharding import infer_variable_shardings
+
+    module = MoEMLP(num_experts=8, mlp_dim=16, dtype=jnp.float32)
+    x = jnp.zeros((2, 4, 16), jnp.float32)
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    abstract = jax.eval_shape(
+        lambda r: dict(module.init(r, x)), jax.random.PRNGKey(0)
+    )
+    shardings = infer_variable_shardings(mesh, abstract)
+    import flax.linen as nn
+
+    variables = jax.jit(
+        lambda r: nn.meta.unbox(dict(module.init(r, x))), out_shardings=shardings
+    )(jax.random.PRNGKey(0))
+    w_in = variables["params"]["w_in"]
+    # [E=8, D=16, M=16] sharded over ep=4 -> 2 experts per device
+    assert {s.data.shape for s in w_in.addressable_shards} == {(2, 16, 16)}
+    # forward under jit with sharded experts runs and is finite
+    out = jax.jit(module.apply)(variables, x)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_gradients_flow(rng):
+    module, variables, x = _build(rng)
+
+    def loss(v):
+        return jnp.mean(module.apply(v, x) ** 2)
+
+    g = jax.grad(loss)(variables)
+    gn = np.asarray(jnp.linalg.norm(g["params"]["w_in"].reshape(-1)))
+    assert np.isfinite(gn) and gn > 0

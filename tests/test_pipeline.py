@@ -1,0 +1,78 @@
+"""Pipeline-parallel tests: pipelined == sequential, and it differentiates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_shardings,
+    stack_stage_params,
+)
+
+
+def _stage_fn(params, x):
+    # a residual MLP block: x + tanh(x @ W + b)
+    return x + jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stages(rng, P, D):
+    return [
+        {
+            "w": rng.normal(size=(D, D)).astype(np.float32) * 0.3,
+            "b": rng.normal(size=(D,)).astype(np.float32) * 0.1,
+        }
+        for _ in range(P)
+    ]
+
+
+def _sequential(stages, x):
+    for s in stages:
+        x = _stage_fn(s, x)
+    return x
+
+
+@pytest.mark.parametrize("P,M", [(4, 4), (4, 8), (2, 3), (8, 2)])
+def test_pipeline_matches_sequential(rng, P, M):
+    D, B = 16, 4
+    mesh = make_mesh({"pp": P})
+    stages = _stages(rng, P, D)
+    stacked = stack_stage_params(stages)
+    x = rng.normal(size=(M, B, D)).astype(np.float32)
+    out = pipeline_apply(_stage_fn, stacked, x, mesh)
+    ref = np.stack([_sequential(stages, x[m]) for m in range(M)])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential(rng):
+    P, M, D, B = 4, 4, 8, 2
+    mesh = make_mesh({"pp": P})
+    stages = _stages(rng, P, D)
+    stacked = stack_stage_params(stages)
+    x = rng.normal(size=(M, B, D)).astype(np.float32)
+    target = rng.normal(size=(M, B, D)).astype(np.float32)
+
+    def loss_pipe(sp):
+        return jnp.mean((pipeline_apply(_stage_fn, sp, x, mesh) - target) ** 2)
+
+    def loss_seq(sp):
+        stages_ = [jax.tree.map(lambda a: a[i], sp) for i in range(P)]
+        out = jnp.stack([_sequential(stages_, x[m]) for m in range(M)])
+        return jnp.mean((out - target) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4)
+
+
+def test_pipeline_sharded_params_layout(rng):
+    P, D = 8, 8
+    mesh = make_mesh({"pp": P})
+    stacked = stack_stage_params(_stages(rng, P, D))
+    p_sh, io_sh = pipeline_shardings(mesh)
+    placed = jax.device_put(stacked, p_sh)
+    # each device holds exactly one stage's weights
+    assert {s.data.shape for s in placed["w"].addressable_shards} == {(1, D, D)}
