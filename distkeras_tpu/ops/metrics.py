@@ -10,16 +10,20 @@ __all__ = ["accuracy"]
 def accuracy(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """Classification accuracy.
 
-    ``preds`` may be logits/probability vectors ([B, C]) or already-argmaxed
-    indices ([B]); ``targets`` may be one-hot ([B, C]) or indices ([B]).
+    ``preds``: logits/probability vectors ([..., C]) or already-argmaxed
+    indices; ``targets``: one-hot ([..., C]) or integer indices ([...]).
+    Works for per-example ([B, C] vs [B]) and per-position sequence outputs
+    ([B, S, C] vs [B, S]) alike.
     """
     if preds.ndim > 1 and preds.shape[-1] > 1:
         pred_idx = jnp.argmax(preds, axis=-1)
     else:
         # Single-unit head: models emit logits, so the decision boundary is 0.
         pred_idx = (preds.reshape(preds.shape[0], -1)[:, 0] > 0).astype(jnp.float32)
-    if targets.ndim > 1 and targets.shape[-1] > 1:
-        true_idx = jnp.argmax(targets, axis=-1)
+    if targets.shape == pred_idx.shape:
+        true_idx = targets
+    elif targets.ndim == pred_idx.ndim + 1 and targets.shape[-1] > 1:
+        true_idx = jnp.argmax(targets, axis=-1)  # one-hot
     else:
-        true_idx = targets.reshape(targets.shape[0], -1)[:, 0]
+        true_idx = targets.reshape(pred_idx.shape)
     return jnp.mean((pred_idx == true_idx.astype(pred_idx.dtype)).astype(jnp.float32))

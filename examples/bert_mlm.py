@@ -1,0 +1,76 @@
+"""BERT MLM via DynSGD with GSPMD data+model sharding — BASELINE config #5.
+
+Two modes:
+- --mode sync: SynchronousDistributedTrainer on a dp×tp mesh; BERT's
+  logical-axis annotations shard heads/mlp/vocab over tp (GSPMD).
+- --mode dynsgd: the DynSGD async protocol with staleness-damped commits
+  (workers on devices, single-owner PS).
+
+Masked-LM objective on synthetic token streams (no egress): 15% of tokens
+masked; the label is the original token id (loss computed over all
+positions for simplicity — masked-position-only weighting is a
+loss-function choice, not a framework capability).
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import distkeras_tpu as dk
+from distkeras_tpu.models.bert import bert_tiny_mlm
+from distkeras_tpu.parallel.mesh import make_mesh
+
+MASK_ID = 0
+
+
+def make_mlm_data(n=2048, seq=64, vocab=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, vocab, size=(n, seq))
+    mask = rng.random((n, seq)) < 0.15
+    corrupted = np.where(mask, MASK_ID, tokens)
+    return dk.Dataset.from_arrays(
+        features=corrupted.astype(np.int32), label=tokens.astype(np.int32)
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="sync", choices=["sync", "dynsgd"])
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=8)
+    args = ap.parse_args()
+
+    ds = make_mlm_data(seq=args.seq, vocab=args.vocab)
+    model = bert_tiny_mlm(seq_len=args.seq, vocab_size=args.vocab)
+    common = dict(
+        worker_optimizer="adam", learning_rate=1e-3,
+        loss="categorical_crossentropy",
+        batch_size=args.batch_size, num_epoch=args.epochs,
+    )
+
+    t0 = time.time()
+    if args.mode == "sync":
+        import jax
+
+        ndev = len(jax.devices())
+        tp = args.tp if ndev % args.tp == 0 else 1
+        mesh = make_mesh({"dp": ndev // tp, "tp": tp})
+        trainer = dk.SynchronousDistributedTrainer(model, mesh=mesh, **common)
+    else:
+        trainer = dk.DynSGD(
+            model, num_workers=args.workers, communication_window=5, **common
+        )
+    trainer.train(ds)
+    hist = trainer.get_history()
+    print(f"bert-mlm {args.mode}: steps={len(hist)} "
+          f"loss {hist[0]['loss']:.3f} -> {hist[-1]['loss']:.3f} "
+          f"wall={time.time()-t0:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""CIFAR-10 CNN via ADAG — BASELINE config #2.
+
+Async data-parallel training of the convolutional model with the ADAG
+protocol (the reference author's accumulated-gradient-normalization).
+Synthetic CIFAR-shaped data stands in when the real dataset isn't on disk
+(no egress in this container); pass --npz with arrays x (N,32,32,3 uint8)
+and y (N,) to use real CIFAR-10.
+
+Run: python examples/cifar10.py [--workers 8] [--epochs 2]
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import distkeras_tpu as dk
+from distkeras_tpu.models import cifar10_cnn
+
+
+def load_cifar(npz: str | None, n=4096, seed=0):
+    if npz:
+        with np.load(npz) as d:
+            x, y = d["x"], d["y"]
+    else:
+        rng = np.random.default_rng(seed)
+        protos = rng.uniform(0, 255, size=(10, 32, 32, 3))
+        y = rng.integers(0, 10, size=n)
+        x = np.clip(protos[y] + rng.normal(0, 48, size=(n, 32, 32, 3)), 0, 255)
+    return dk.Dataset.from_arrays(
+        features=x.astype(np.float32), label=y.astype(np.float32)
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--npz", default=None)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    ds = load_cifar(args.npz)
+    ds = dk.MinMaxTransformer(min=0.0, max=255.0, input_col="features",
+                              output_col="features_normalized").transform(ds)
+    ds = dk.OneHotTransformer(10, input_col="label",
+                              output_col="label_encoded").transform(ds)
+    train, test = ds.split(0.9, seed=1)
+
+    trainer = dk.ADAG(
+        cifar10_cnn(), worker_optimizer="adam", learning_rate=1e-3,
+        loss="categorical_crossentropy",
+        num_workers=args.workers, batch_size=args.batch_size,
+        num_epoch=args.epochs, communication_window=12,
+        features_col="features_normalized", label_col="label_encoded",
+    )
+    t0 = time.time()
+    trained = trainer.train(train, shuffle=True)
+    out = dk.ModelPredictor(trained, features_col="features_normalized").predict(test)
+    out = dk.LabelIndexTransformer(input_col="prediction").transform(out)
+    acc = dk.AccuracyEvaluator(prediction_col="prediction_index",
+                               label_col="label").evaluate(out)
+    print(f"adag cifar10: accuracy={acc:.4f} wall={time.time()-t0:.1f}s "
+          f"commits={trainer.parameter_server.num_commits}")
+
+
+if __name__ == "__main__":
+    main()
