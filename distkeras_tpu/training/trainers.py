@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
-from distkeras_tpu.data.feed import minibatches
+from distkeras_tpu.data.feed import DeviceFeed, minibatches
 from distkeras_tpu.models.core import Model, TrainedModel
 from distkeras_tpu.ops.losses import get_optimizer
 from distkeras_tpu.parallel.mesh import best_mesh, data_parallel_shardings
@@ -183,8 +183,11 @@ class SingleTrainer(Trainer):
             num_epoch=self.num_epoch,
             seed=self.seed if shuffle else None,
         )
+        # Double-buffered host->HBM feed: the next batch's transfer overlaps
+        # the current step's compute.
+        feed = DeviceFeed(batches, buffer_size=2)
         self.history = []
-        for batch in batches:
+        for batch in feed:
             state, m = step_fn(state, batch)
             self.history.append(m)
         # Materialize metrics (they were async device scalars).

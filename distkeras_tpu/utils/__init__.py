@@ -18,4 +18,30 @@ __all__ = [
     "pytree_mean",
     "pytree_zeros_like",
     "rng_stream",
+    "serialize_keras_model",
+    "deserialize_keras_model",
 ]
+
+
+def serialize_keras_model(model) -> bytes:
+    """Reference-parity helper (``distkeras/utils.py`` §
+    ``serialize_keras_model``): serialize a trained model's weights to
+    bytes. Accepts a :class:`~distkeras_tpu.models.core.TrainedModel` or a
+    raw variables PyTree; the format is the pickle-free npz container."""
+    from distkeras_tpu.models.core import TrainedModel
+
+    if isinstance(model, TrainedModel):
+        return serialize_pytree(model.variables)
+    return serialize_pytree(model)
+
+
+def deserialize_keras_model(data: bytes, model=None):
+    """Inverse of :func:`serialize_keras_model`. With ``model`` (a
+    :class:`~distkeras_tpu.models.core.Model`), returns a ``TrainedModel``;
+    otherwise returns the raw variables PyTree."""
+    from distkeras_tpu.models.core import Model, TrainedModel
+
+    if isinstance(model, Model):
+        like = model.init(0)
+        return TrainedModel(model, deserialize_pytree(data, like=like))
+    return deserialize_pytree(data)

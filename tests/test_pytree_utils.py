@@ -66,3 +66,16 @@ def _flatten(tree, prefix=""):
         else:
             out[prefix + k] = v
     return out
+
+
+def test_serialize_keras_model_parity_helpers():
+    from distkeras_tpu.utils import deserialize_keras_model, serialize_keras_model
+    from distkeras_tpu.models.core import Model, TrainedModel
+    from distkeras_tpu.models.mlp import MLP
+
+    model = Model.from_flax(MLP(features=(4,), num_classes=2), input_shape=(3,))
+    trained = TrainedModel(model, model.init(7))
+    blob = serialize_keras_model(trained)
+    back = deserialize_keras_model(blob, model)
+    x = np.zeros((2, 3), np.float32)
+    np.testing.assert_allclose(trained.predict(x), back.predict(x), atol=1e-7)
