@@ -73,22 +73,31 @@ def make_train_step(
     metrics: tuple[str, ...] = ("accuracy",),
     jit: bool = True,
     donate: bool = True,
+    remat: bool = False,
 ):
     """Build ``step(state, batch) -> (state, metrics_dict)``.
 
     ``batch`` is ``{"features": [B, ...], "label": [B, ...]}``. The returned
     function is jit-compiled with the state donated (params are updated
-    in-place in HBM, halving peak memory vs copy-on-update).
+    in-place in HBM, halving peak memory vs copy-on-update). ``remat=True``
+    wraps the forward pass in ``jax.checkpoint`` — activations are
+    recomputed in the backward pass instead of held in HBM, trading FLOPs
+    for memory (long sequences / deep models on one chip).
     """
     loss_fn = get_loss(loss)
+    apply_fn = model.apply
+    if remat:
+        apply_fn = jax.checkpoint(
+            model.apply, static_argnums=(2,), policy=None
+        )
 
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
 
         def compute_loss(params):
             variables = {"params": params, **state.model_state}
-            outputs, new_model_state = model.apply(
-                variables, batch["features"], train=True, rngs={"dropout": step_rng}
+            outputs, new_model_state = apply_fn(
+                variables, batch["features"], True, rngs={"dropout": step_rng}
             )
             return loss_fn(outputs, batch["label"]), (outputs, new_model_state)
 

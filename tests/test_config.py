@@ -1,0 +1,42 @@
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.utils.config import TrainerConfig
+
+
+def _model():
+    return Model.from_flax(MLP(features=(8,), num_classes=2), input_shape=(4,))
+
+
+def test_roundtrip_json():
+    cfg = TrainerConfig(trainer="ADAG", num_workers=4, communication_window=8)
+    back = TrainerConfig.from_json(cfg.to_json())
+    assert back == cfg
+
+
+def test_unknown_trainer_rejected():
+    with pytest.raises(ValueError):
+        TrainerConfig(trainer="Nope")
+
+
+def test_build_and_train():
+    cfg = TrainerConfig(
+        trainer="DOWNPOUR", worker_optimizer="adam", learning_rate=0.01,
+        num_workers=2, batch_size=16, num_epoch=2, communication_window=4,
+    )
+    trainer = cfg.build(_model())
+    assert isinstance(trainer, dk.DOWNPOUR)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    trainer.train(dk.Dataset.from_arrays(features=x, label=y))
+    assert trainer.parameter_server.num_commits > 0
+
+
+def test_build_rejects_inapplicable_kwargs():
+    cfg = TrainerConfig(trainer="SingleTrainer", num_workers=4)
+    with pytest.raises(ValueError, match="num_workers"):
+        cfg.build(_model())

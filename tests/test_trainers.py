@@ -129,3 +129,27 @@ def test_ensemble_replicas_sharded_over_devices(toy_classification):
     assert len(models) == 8
     accs = [_accuracy(m, toy_classification) for m in models]
     assert min(accs) > 0.6, accs
+
+
+def test_remat_step_matches_plain(toy_classification):
+    """remat=True recomputes activations but must be numerically identical."""
+    import optax
+    from distkeras_tpu.training.step import TrainState, make_train_step
+
+    model = _model()
+    opt = optax.sgd(0.05)
+    s0 = TrainState.create(model, opt, rng=0)
+    batch = {
+        "features": toy_classification["features"][:32],
+        "label": toy_classification["label"][:32],
+    }
+    plain = make_train_step(model, opt, "categorical_crossentropy", donate=False)
+    remat = make_train_step(model, opt, "categorical_crossentropy", donate=False, remat=True)
+    s1, m1 = plain(s0, batch)
+    s2, m2 = remat(s0, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(s1.params["Dense_0"]["kernel"]),
+        np.asarray(s2.params["Dense_0"]["kernel"]),
+        atol=1e-6,
+    )
