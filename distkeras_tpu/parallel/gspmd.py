@@ -25,17 +25,54 @@ from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.parallel.sharding import infer_variable_shardings
 from distkeras_tpu.training.step import TrainState
 
-__all__ = ["sharded_train_state", "make_sharded_train_step", "batch_sharding"]
+__all__ = [
+    "sharded_train_state",
+    "make_sharded_train_step",
+    "batch_sharding",
+    "shard_batch",
+]
 
 
 def batch_sharding(mesh: Mesh, batch_rank: int = 2, seq_dim: int | None = 1):
-    """Sharding for a ``[B, ...]`` batch: B over dp, seq dim over sp."""
-    spec = [None] * batch_rank
-    if "dp" in mesh.axis_names:
-        spec[0] = "dp"
+    """Sharding for a ``[B, ...]`` batch: B over the data axes (dp and, when
+    present, fsdp — both carry data parallelism), seq dim over sp."""
+    spec: list = [None] * batch_rank
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    if batch_axes:
+        spec[0] = batch_axes if len(batch_axes) > 1 else batch_axes[0]
     if seq_dim is not None and "sp" in mesh.axis_names and seq_dim < batch_rank:
         spec[seq_dim] = "sp"
     return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(mesh: Mesh, batch: dict, seq_dim: int | None = None) -> dict:
+    """device_put every array in ``batch`` with a rank-matched batch
+    sharding (features may be [B, ...] of any rank; labels are often [B])."""
+    return {
+        k: jax.device_put(
+            v, batch_sharding(mesh, max(1, np.ndim(v)), seq_dim=seq_dim)
+        )
+        for k, v in batch.items()
+    }
+
+
+def fsdp_sharding_for(mesh: Mesh, shape: tuple[int, ...], dtype=None) -> NamedSharding:
+    """FSDP heuristic for an un-annotated parameter: shard the largest
+    dimension divisible by the ``fsdp`` axis size; replicate otherwise.
+    Small tensors (< 2^14 elements) stay replicated — the all-gather would
+    cost more than the memory saved."""
+    if "fsdp" not in mesh.axis_names:
+        return NamedSharding(mesh, P())
+    n = mesh.shape["fsdp"]
+    if int(np.prod(shape or (1,))) < (1 << 14):
+        return NamedSharding(mesh, P())
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in dims:
+        if shape[d] % n == 0:
+            spec = [None] * len(shape)
+            spec[d] = "fsdp"
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
 
 
 def sharded_train_state(
@@ -46,7 +83,10 @@ def sharded_train_state(
 ):
     """Initialize a TrainState with every parameter placed per its logical
     axes — parameters materialize directly in their distributed layout
-    (never whole on one device)."""
+    (never whole on one device). Un-annotated models on an ``fsdp`` mesh get
+    the heuristic of :func:`fsdp_sharding_for` (ZeRO-3-style: params live
+    sharded; XLA all-gathers each layer's weights just-in-time and
+    reduce-scatters its gradients)."""
     if isinstance(rng, int):
         rng = jax.random.PRNGKey(rng)
     # Same key split as TrainState.create so a sharded and an unsharded
@@ -65,6 +105,12 @@ def sharded_train_state(
             return nn.meta.unbox(boxed_init(r))
 
         variables = jax.jit(init_fn, out_shardings=var_shardings)(rng)
+    elif "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1:
+        abstract = jax.eval_shape(model.init, rng)
+        var_shardings = jax.tree.map(
+            lambda a: fsdp_sharding_for(mesh, a.shape, a.dtype), abstract
+        )
+        variables = jax.jit(model.init, out_shardings=var_shardings)(rng)
     else:
         # Un-annotated model: replicate everything (pure DP).
         replicated = NamedSharding(mesh, P())

@@ -374,23 +374,30 @@ class SynchronousDistributedTrainer(Trainer):
         global_batch = self.batch_size * dp_size
 
         optimizer = self._optimizer()
-        model_axes = any(a in mesh.axis_names and mesh.shape[a] > 1 for a in ("tp", "sp"))
-        if model_axes and hasattr(self.model, "boxed_init"):
+        model_axes = any(
+            a in mesh.axis_names and mesh.shape[a] > 1 for a in ("tp", "sp", "fsdp")
+        )
+        if model_axes and (
+            hasattr(self.model, "boxed_init") or "fsdp" in mesh.axis_names
+        ):
             # GSPMD data+model sharding (logical-axis-annotated model).
             from distkeras_tpu.parallel.gspmd import (
-                batch_sharding as make_batch_sharding,
                 make_sharded_train_step,
+                shard_batch,
                 sharded_train_state,
             )
 
             state, _ = sharded_train_state(self.model, optimizer, mesh, rng=self.seed)
             step_fn = make_sharded_train_step(self.model, optimizer, self.loss, mesh)
-            batch_sharding = make_batch_sharding(mesh, 2, seq_dim=None)
+            shard_fn = lambda b: shard_batch(mesh, b)
         else:
             batch_sharding, replicated = data_parallel_shardings(mesh)
             step_fn = make_train_step(self.model, optimizer, self.loss, self.metrics)
             state = TrainState.create(self.model, optimizer, rng=self.seed)
             state = jax.device_put(state, replicated)
+            shard_fn = lambda b: {
+                k: jax.device_put(v, batch_sharding) for k, v in b.items()
+            }
 
         self.history = []
         for batch in minibatches(
@@ -401,8 +408,7 @@ class SynchronousDistributedTrainer(Trainer):
             num_epoch=self.num_epoch,
             seed=self.seed if shuffle else None,
         ):
-            sharded = {k: jax.device_put(v, batch_sharding) for k, v in batch.items()}
-            state, m = step_fn(state, sharded)
+            state, m = step_fn(state, shard_fn(batch))
             self.history.append(m)
         self.history = [{k: float(v) for k, v in h.items()} for h in self.history]
         self._emit_history()

@@ -153,3 +153,16 @@ def test_remat_step_matches_plain(toy_classification):
         np.asarray(s2.params["Dense_0"]["kernel"]),
         atol=1e-6,
     )
+
+
+def test_sync_trainer_fsdp_mesh(toy_classification):
+    """SynchronousDistributedTrainer on a pure-fsdp mesh (ZeRO-3-style)."""
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"fsdp": 8})
+    trainer = dk.SynchronousDistributedTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        batch_size=8, num_epoch=6, mesh=mesh,
+    )
+    trained = trainer.train(toy_classification)
+    assert _accuracy(trained, toy_classification) > 0.85
