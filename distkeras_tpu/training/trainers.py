@@ -436,6 +436,7 @@ class AsynchronousDistributedTrainer(Trainer):
         loss="categorical_crossentropy",
         metrics=("accuracy",),
         num_workers: int = 2,
+        devices_per_worker: int = 1,
         batch_size: int = 32,
         features_col: str = "features",
         label_col: str = "label",
@@ -456,6 +457,12 @@ class AsynchronousDistributedTrainer(Trainer):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate, seed, metric_stream)
         self.num_workers = int(num_workers)
+        # devices_per_worker > 1 turns each worker into an *island*: a sync
+        # data-parallel sub-mesh (gradient all-reduce over ICI inside the
+        # island) that speaks to the PS as one async participant — the
+        # hybrid SURVEY §7 calls for (asynchrony between islands, lock-step
+        # within).
+        self.devices_per_worker = int(devices_per_worker)
         self.batch_size = int(batch_size)
         self.features_col = features_col
         self.label_col = label_col
@@ -564,9 +571,32 @@ class AsynchronousDistributedTrainer(Trainer):
         final_states: list[Any] = [None] * self.num_workers
         errors: list[BaseException | None] = [None] * self.num_workers
 
+        dpw = self.devices_per_worker
+        if dpw > 1 and self.num_workers * dpw > len(devices):
+            raise ValueError(
+                f"{self.num_workers} workers x {dpw} devices_per_worker "
+                f"> {len(devices)} attached devices"
+            )
+
         def worker_loop(widx: int):
             try:
-                device = devices[widx % len(devices)]
+                if dpw > 1:
+                    # island: sync dp sub-mesh; batch sharded, state replicated
+                    from distkeras_tpu.parallel.mesh import make_mesh
+
+                    island_devices = devices[widx * dpw : (widx + 1) * dpw]
+                    island_mesh = make_mesh({"dp": dpw}, devices=island_devices)
+                    batch_sh, repl_sh = data_parallel_shardings(island_mesh)
+                    put_state = lambda tree: jax.device_put(tree, repl_sh)
+                    put_batch = lambda b: {
+                        k: jax.device_put(v, batch_sh) for k, v in b.items()
+                    }
+                else:
+                    device = devices[widx % len(devices)]
+                    put_state = lambda tree: jax.device_put(tree, device)
+                    put_batch = lambda b: {
+                        k: jax.device_put(v, device) for k, v in b.items()
+                    }
                 from distkeras_tpu.parallel.ha import RetryingClient, StampingClient
 
                 client = self._make_client()
@@ -577,26 +607,24 @@ class AsynchronousDistributedTrainer(Trainer):
                 # silently at-least-once; SURVEY §5).
                 client = StampingClient(client, widx)
                 center, carry = self.protocol.worker_begin(client, None)
-                params = jax.device_put(center, device)
+                params = put_state(center)
                 state = TrainState.create(
                     self.model, optimizer, rng=worker_seed(self.seed, widx)
                 )
-                state = jax.device_put(state, device)
+                state = put_state(state)
                 state = state.replace(params=params, opt_state=optimizer.init(params))
                 my_parts = partitions[widx :: self.num_workers]
                 i = 0
                 for part in my_parts:
                     for batch in minibatches(
                         part,
-                        self.batch_size,
+                        self.batch_size * dpw,
                         self.features_col,
                         self.label_col,
                         num_epoch=self.num_epoch,
                         seed=worker_seed(self.seed, widx) if shuffle else None,
                     ):
-                        batch = {
-                            k: jax.device_put(v, device) for k, v in batch.items()
-                        }
+                        batch = put_batch(batch)
                         state, m = step_fn(state, batch)
                         histories[widx].append(m)
                         i += 1
@@ -605,7 +633,7 @@ class AsynchronousDistributedTrainer(Trainer):
                                 state.params, carry, client
                             )
                             state = state.replace(
-                                params=jax.device_put(new_params, device)
+                                params=put_state(new_params)
                             )
                 # Flush the final partial window so trailing work reaches
                 # the center (the reference commits only full windows; this

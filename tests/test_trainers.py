@@ -166,3 +166,15 @@ def test_sync_trainer_fsdp_mesh(toy_classification):
     )
     trained = trainer.train(toy_classification)
     assert _accuracy(trained, toy_classification) > 0.85
+
+
+def test_async_islands_sync_submesh(toy_classification):
+    """2 async islands x 4-device sync sub-meshes (the SURVEY §7 hybrid)."""
+    trainer = dk.ADAG(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        num_workers=2, devices_per_worker=4, batch_size=8, num_epoch=6,
+        communication_window=3,
+    )
+    trained = trainer.train(toy_classification)
+    assert _accuracy(trained, toy_classification) > 0.85
+    assert trainer.parameter_server.num_commits > 0
