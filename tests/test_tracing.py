@@ -1,0 +1,61 @@
+"""Tracing/metrics subsystem tests."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.tracing import MetricStream, StepTimer, trace
+
+
+def test_step_timer_summary():
+    t = StepTimer()
+    t.start()
+    import time
+
+    for _ in range(5):
+        time.sleep(0.01)
+        t.tick()
+    s = t.summary(batch_size=32)
+    assert s["steps"] == 4  # skip_warmup=1
+    assert s["step_time_mean_s"] > 0.005
+    assert "samples_per_sec" in s
+    assert s["step_time_var_s2"] >= 0
+
+
+def test_step_timer_mfu_with_flops():
+    t = StepTimer()
+    t.start()
+    t.tick()
+    import time
+
+    time.sleep(0.01)
+    t.tick()
+    s = t.summary(batch_size=8, flops_per_example=1e9, skip_warmup=1)
+    assert "train_tflops_per_sec" in s
+    # mfu present only when the device generation is known (not on CPU)
+    assert ("mfu" in s) == (jax.devices()[0].platform == "tpu")
+
+
+def test_metric_stream_records_and_jsonl(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    ms = MetricStream.to_jsonl(path)
+    ms.emit(0, {"loss": 1.5, "accuracy": np.float32(0.5)})
+    ms.emit(1, {"loss": 1.2})
+    assert len(ms.records) == 2
+    assert ms.last()["loss"] == 1.2
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["step"] == 0 and lines[0]["loss"] == 1.5
+
+
+def test_profiler_trace_writes(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with trace(log_dir):
+        _ = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    # jax profiler writes a plugins/profile subtree
+    found = []
+    for root, _, files in os.walk(log_dir):
+        found += files
+    assert found, "no trace files written"
