@@ -48,3 +48,20 @@ def test_keras_model_trains_with_single_trainer(keras_mlp):
     preds = trained.predict(x)
     acc = float(np.mean(np.argmax(preds, -1) == y))
     assert acc > 0.85, acc
+
+
+def test_keras_model_trains_with_async_trainer(keras_mlp):
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, 12)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    ds = dk.Dataset.from_arrays(features=x, label=y)
+    trainer = dk.DOWNPOUR(
+        keras_mlp, worker_optimizer="adam", learning_rate=0.01,
+        loss="categorical_crossentropy", num_workers=2, batch_size=16,
+        num_epoch=4, communication_window=4,
+    )
+    trained = trainer.train(ds)
+    assert trainer.parameter_server.num_commits > 0
+    preds = trained.predict(x)
+    acc = float(np.mean(np.argmax(preds, -1) == y))
+    assert acc > 0.8, acc

@@ -89,3 +89,28 @@ def test_eamsgd_local_optimizer_adds_momentum():
     u2, state = opt.update(g, state, params)
     # with nesterov trace, second update is larger in magnitude than first
     assert abs(u2["w"][0]) > abs(u1["w"][0])
+
+
+def test_dynsgd_damps_stale_worker_end_to_end():
+    """Behavioral: a worker committing with an old last_update moves the
+    center less than a fresh worker committing the same delta."""
+    from distkeras_tpu.parallel.ps import ParameterServerService
+
+    ps = ParameterServerService(DynSGDProtocol(), {"w": np.zeros(1)}, 2)
+    ps.start()
+    try:
+        fresh, stale = ps.client(), ps.client()
+        # advance the server 5 updates with fresh pulls each time
+        for _ in range(5):
+            _, last = fresh.pull()
+            fresh.commit({"delta": {"w": np.ones(1)}, "last_update": last})
+        _, n = fresh.pull()
+        assert n == 5
+        before = ps.get_model()["w"][0]
+        # stale worker pulled long ago (last_update=0): staleness 5 -> /6
+        stale.commit({"delta": {"w": np.full(1, 6.0)}, "last_update": 0})
+        stale.pull()
+        after = ps.get_model()["w"][0]
+        assert np.isclose(after - before, 1.0)  # 6 / (5+1)
+    finally:
+        ps.stop()
