@@ -1,0 +1,102 @@
+"""CLI runner: ``python -m distkeras_tpu.run --config job.json --data d.npz``.
+
+The executable form of a ``TrainerConfig`` — what a ``Job``/``Punchcard``
+ships to a TPU host. The config JSON carries the trainer spec (see
+:mod:`distkeras_tpu.utils.config`); data arrives as an ``.npz`` with
+``features``/``label`` arrays or a headered CSV; the model comes from the
+built-in zoo by name.
+
+Example config:
+    {"trainer": "ADAG", "worker_optimizer": "adam", "learning_rate": 1e-3,
+     "num_workers": 4, "batch_size": 64, "num_epoch": 2,
+     "communication_window": 12}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+MODEL_ZOO = {
+    "mnist_mlp": ("distkeras_tpu.models.mlp", "mnist_mlp"),
+    "higgs_mlp": ("distkeras_tpu.models.mlp", "higgs_mlp"),
+    "mnist_cnn": ("distkeras_tpu.models.cnn", "mnist_cnn"),
+    "cifar10_cnn": ("distkeras_tpu.models.cnn", "cifar10_cnn"),
+    "resnet18": ("distkeras_tpu.models.resnet", "resnet18"),
+    "resnet50": ("distkeras_tpu.models.resnet", "resnet50"),
+    "bert_tiny_mlm": ("distkeras_tpu.models.bert", "bert_tiny_mlm"),
+    "bert_base_mlm": ("distkeras_tpu.models.bert", "bert_base_mlm"),
+}
+
+
+def load_model(name: str, kwargs: dict):
+    import importlib
+
+    if name not in MODEL_ZOO:
+        raise SystemExit(f"unknown model {name!r}; known: {sorted(MODEL_ZOO)}")
+    mod, fn = MODEL_ZOO[name]
+    return getattr(importlib.import_module(mod), fn)(**kwargs)
+
+
+def load_data(path: str, features_col: str, label_col: str):
+    from distkeras_tpu.data.dataset import Dataset
+
+    if path.endswith(".npz"):
+        with np.load(path) as d:
+            return Dataset.from_arrays(
+                **{features_col: d["features"], label_col: d["label"]}
+            )
+    header = open(path).readline().strip().split(",")
+    return Dataset.from_csv(
+        path, features=[c for c in header if c != label_col], label=label_col,
+        features_col=features_col, label_col=label_col,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="distkeras_tpu.run")
+    ap.add_argument("--config", required=True, help="TrainerConfig JSON file")
+    ap.add_argument("--data", required=True, help=".npz (features/label) or CSV")
+    ap.add_argument("--model", default="mnist_mlp", help=f"one of {sorted(MODEL_ZOO)}")
+    ap.add_argument("--model-args", default="{}", help="JSON kwargs for the model fn")
+    ap.add_argument("--out", default=None, help="path to save trained weights")
+    ap.add_argument("--metrics-out", default=None, help="JSONL per-step metrics")
+    ap.add_argument("--shuffle", action="store_true")
+    args = ap.parse_args(argv)
+
+    from distkeras_tpu.tracing import MetricStream
+    from distkeras_tpu.utils.config import TrainerConfig
+
+    cfg = TrainerConfig.from_json(open(args.config).read())
+    model = load_model(args.model, json.loads(args.model_args))
+    ds = load_data(args.data, cfg.features_col, cfg.label_col)
+    trainer = cfg.build(model)
+    if args.metrics_out:
+        trainer.metric_stream = MetricStream.to_jsonl(args.metrics_out)
+
+    trained = trainer.train(ds, shuffle=args.shuffle)
+    summary = {
+        "trainer": cfg.trainer,
+        "steps": len(trainer.get_history()),
+        "training_time_s": round(trainer.get_training_time(), 3),
+        "averaged_history": {
+            k: round(v, 5) for k, v in trainer.get_averaged_history().items()
+        },
+    }
+    if args.out:
+        if isinstance(trained, list):  # EnsembleTrainer
+            for i, t in enumerate(trained):
+                t.save_weights(f"{args.out}.{i}")
+            summary["saved"] = [f"{args.out}.{i}" for i in range(len(trained))]
+        else:
+            trained.save_weights(args.out)
+            summary["saved"] = args.out
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

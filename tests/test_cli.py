@@ -1,0 +1,56 @@
+"""CLI runner tests (the Job/Punchcard payload format)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def job(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 28)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    data = tmp_path / "d.npz"
+    np.savez(data, features=x, label=y)
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({
+        "trainer": "DOWNPOUR", "worker_optimizer": "adam",
+        "learning_rate": 0.01, "num_workers": 2, "batch_size": 16,
+        "num_epoch": 2, "communication_window": 4,
+    }))
+    return data, cfg, tmp_path
+
+
+def test_cli_end_to_end(job):
+    data, cfg, tmp = job
+    out = tmp / "weights.bin"
+    metrics = tmp / "metrics.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from distkeras_tpu.run import main; import sys; sys.exit(main())",
+         "--config", str(cfg), "--data", str(data), "--model", "higgs_mlp",
+         "--out", str(out), "--metrics-out", str(metrics), "--shuffle"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["trainer"] == "DOWNPOUR"
+    assert summary["steps"] > 0
+    assert out.exists()
+    lines = [json.loads(l) for l in open(metrics)]
+    assert len(lines) == summary["steps"]
+
+
+def test_cli_unknown_model(job):
+    data, cfg, _ = job
+    r = subprocess.run(
+        [sys.executable, "-m", "distkeras_tpu.run", "--config", str(cfg),
+         "--data", str(data), "--model", "nope"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode != 0
+    assert "unknown model" in r.stderr
