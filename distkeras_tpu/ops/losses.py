@@ -41,8 +41,18 @@ def mean_absolute_error(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray
     return jnp.mean(jnp.abs(preds - targets.reshape(preds.shape)))
 
 
+def fused_categorical_crossentropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Pallas fused softmax-CE (integer labels; large-vocab heads)."""
+    from distkeras_tpu.ops.pallas.fused_xent import fused_softmax_xent
+
+    if targets.ndim == logits.ndim:  # one-hot fed in: fall back
+        return categorical_crossentropy(logits, targets)
+    return fused_softmax_xent(logits, targets)
+
+
 LOSSES: dict[str, LossFn] = {
     "categorical_crossentropy": categorical_crossentropy,
+    "fused_categorical_crossentropy": fused_categorical_crossentropy,
     "sparse_categorical_crossentropy": categorical_crossentropy,
     "binary_crossentropy": binary_crossentropy,
     "mse": mean_squared_error,

@@ -1,0 +1,161 @@
+"""Fused softmax cross-entropy — Pallas TPU kernel.
+
+For large-vocabulary heads (BERT MLM: ``[tokens, 30k+]`` logits), the naive
+``softmax -> log -> gather`` chain materializes full probability tensors in
+HBM. This kernel streams vocabulary chunks through VMEM with an online
+logsumexp, producing per-token loss directly; the backward kernel
+regenerates ``softmax - onehot`` chunk-by-chunk the same way. Nothing of
+shape ``[T, V]`` is allocated beyond the logits themselves.
+
+float32 statistics throughout (logits may be bf16); label gathering uses
+``broadcasted_iota`` comparison (no 1-D iota on TPU — pallas guide pitfall
+#4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["fused_softmax_xent"]
+
+
+def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, block_v: int, vocab: int):
+    """One block of tokens: online logsumexp over vocab chunks."""
+    t = logits_ref.shape[0]
+    labels = labels_ref[:, 0]  # [T]
+    m = jnp.full((t, 1), -1e30, jnp.float32)
+    s = jnp.zeros((t, 1), jnp.float32)
+    picked = jnp.zeros((t, 1), jnp.float32)
+
+    def body(i, carry):
+        m, s, picked = carry
+        chunk = logits_ref[:, pl.ds(i * block_v, block_v)].astype(jnp.float32)
+        cmax = jnp.max(chunk, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, cmax)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(chunk - m_new), axis=-1, keepdims=True
+        )
+        cols = i * block_v + jax.lax.broadcasted_iota(jnp.int32, (t, block_v), 1)
+        hit = (cols == labels[:, None]).astype(jnp.float32)
+        picked = picked + jnp.sum(hit * chunk, axis=-1, keepdims=True)
+        return m_new, s, picked
+
+    m, s, picked = jax.lax.fori_loop(0, vocab // block_v, body, (m, s, picked))
+    loss_ref[:, 0] = (jnp.log(s[:, 0]) + m[:, 0]) - picked[:, 0]
+
+
+def _bwd_kernel(logits_ref, labels_ref, g_ref, dlogits_ref, *, block_v: int,
+                vocab: int):
+    """dlogits = (softmax(logits) - onehot(labels)) * g, chunked over vocab."""
+    t = logits_ref.shape[0]
+    labels = labels_ref[:, 0]
+    g = g_ref[:, 0].astype(jnp.float32)
+    # pass 1: logsumexp statistics
+    m = jnp.full((t, 1), -1e30, jnp.float32)
+    s = jnp.zeros((t, 1), jnp.float32)
+
+    def stat(i, carry):
+        m, s = carry
+        chunk = logits_ref[:, pl.ds(i * block_v, block_v)].astype(jnp.float32)
+        cmax = jnp.max(chunk, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, cmax)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(chunk - m_new), axis=-1, keepdims=True
+        )
+        return m_new, s
+
+    m, s = jax.lax.fori_loop(0, vocab // block_v, stat, (m, s))
+
+    # pass 2: write gradients
+    def write(i, _):
+        chunk = logits_ref[:, pl.ds(i * block_v, block_v)].astype(jnp.float32)
+        p = jnp.exp(chunk - m) / s
+        cols = i * block_v + jax.lax.broadcasted_iota(jnp.int32, (t, block_v), 1)
+        onehot = (cols == labels[:, None]).astype(jnp.float32)
+        dlogits_ref[:, pl.ds(i * block_v, block_v)] = (
+            (p - onehot) * g[:, None]
+        ).astype(dlogits_ref.dtype)
+        return 0
+
+    jax.lax.fori_loop(0, vocab // block_v, write, 0)
+
+
+def _call_fwd(logits, labels, block_t, block_v, interpret):
+    T, V = logits.shape
+    kernel = functools.partial(_fwd_kernel, block_v=min(block_v, V), vocab=V)
+    return pl.pallas_call(
+        kernel,
+        grid=(T // block_t,),
+        in_specs=[
+            pl.BlockSpec((block_t, V), lambda i: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, 1), jnp.float32),
+        interpret=interpret,
+    )(logits, labels[:, None])[:, 0]
+
+
+def _call_bwd(logits, labels, g, block_t, block_v, interpret):
+    T, V = logits.shape
+    kernel = functools.partial(_bwd_kernel, block_v=min(block_v, V), vocab=V)
+    return pl.pallas_call(
+        kernel,
+        grid=(T // block_t,),
+        in_specs=[
+            pl.BlockSpec((block_t, V), lambda i: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, V), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, V), logits.dtype),
+        interpret=interpret,
+    )(logits, labels[:, None], g[:, None])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _xent(logits, labels, block_t, block_v, interpret):
+    return _call_fwd(logits, labels, block_t, block_v, interpret)
+
+
+def _xent_fwd(logits, labels, block_t, block_v, interpret):
+    return _call_fwd(logits, labels, block_t, block_v, interpret), (logits, labels)
+
+
+def _xent_bwd(block_t, block_v, interpret, residuals, g):
+    logits, labels = residuals
+    return _call_bwd(logits, labels, g, block_t, block_v, interpret), None
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+def fused_softmax_xent(
+    logits,
+    labels,
+    block_t: int = 128,
+    block_v: int = 512,
+    interpret: bool | None = None,
+):
+    """Mean cross-entropy over tokens.
+
+    ``logits``: ``[..., V]`` (any leading shape); ``labels``: integer ids of
+    the leading shape. Returns a scalar (mean loss). Registered in the loss
+    registry as ``"fused_categorical_crossentropy"``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    V = logits.shape[-1]
+    flat_logits = logits.reshape(-1, V)
+    flat_labels = labels.reshape(-1).astype(jnp.int32)
+    T = flat_logits.shape[0]
+    bt = block_t
+    while T % bt and bt > 1:
+        bt //= 2
+    bv = block_v if V % block_v == 0 else V
+    per_token = _xent(flat_logits, flat_labels, bt, bv, interpret)
+    return jnp.mean(per_token)
