@@ -42,13 +42,16 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
-def _block_attn_update(q, k_blk, v_blk, acc, m, denom, scale):
+def _block_attn_update(q, k_blk, v_blk, acc, m, denom, scale, mask=None):
     """One online-softmax accumulation step against a K/V block.
 
     ``acc``: running numerator [B,S,H,D] (f32); ``m``: running max [B,H,S,1];
-    ``denom``: running sum of exp [B,H,S,1].
+    ``denom``: running sum of exp [B,H,S,1]. ``mask`` (broadcastable to
+    [B,H,Sq,Sk]): True = attend.
     """
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
     blk_max = jnp.max(scores, axis=-1, keepdims=True)
     new_m = jnp.maximum(m, blk_max)
     correction = jnp.exp(m - new_m)
@@ -63,7 +66,7 @@ def _block_attn_update(q, k_blk, v_blk, acc, m, denom, scale):
     return new_acc, new_m, new_denom
 
 
-def ring_attention(q, k, v, axis_name: str):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Ring attention over a sharded sequence axis.
 
     To be called **inside** ``shard_map`` (or an equivalent SPMD context)
@@ -75,6 +78,8 @@ def ring_attention(q, k, v, axis_name: str):
     collectives.
     """
     p = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    S_local = q.shape[1]
     scale = q.shape[-1] ** -0.5
     # Derive the accumulators from q so they carry q's device-varying axes
     # (a plain jnp.zeros would be axis-invariant and reject the scan carry
@@ -85,19 +90,30 @@ def ring_attention(q, k, v, axis_name: str):
     denom = stat
     perm = [(i, (i + 1) % p) for i in range(p)]
 
-    def body(carry, _):
+    def body(carry, step):
         acc, m, denom, k_cur, v_cur = carry
-        acc, m, denom = _block_attn_update(q, k_cur, v_cur, acc, m, denom, scale)
+        mask = None
+        if causal:
+            # K/V shard visiting at `step` originated on device (my - step) % p.
+            src = (my - step) % p
+            rows = my * S_local + jnp.arange(S_local)[:, None]  # global q pos
+            cols = src * S_local + jnp.arange(S_local)[None, :]  # global k pos
+            mask = (rows >= cols)[None, None]  # [1,1,Sq,Sk]
+        acc, m, denom = _block_attn_update(
+            q, k_cur, v_cur, acc, m, denom, scale, mask=mask
+        )
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (acc, m, denom, k_nxt, v_nxt), None
 
-    (acc, m, denom, _, _), _ = lax.scan(body, (acc, m, denom, k, v), None, length=p)
+    (acc, m, denom, _, _), _ = lax.scan(
+        body, (acc, m, denom, k, v), jnp.arange(p)
+    )
     denom_t = jnp.transpose(denom, (0, 2, 1, 3))  # [B,S,H,1]
-    return (acc / denom_t).astype(q.dtype)
+    return (acc / jnp.maximum(denom_t, 1e-30)).astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp"):
+def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp", causal: bool = False):
     """Convenience wrapper: run :func:`ring_attention` under ``shard_map`` on
     ``mesh``, sharding the sequence dimension of ``[B, S, H, D]`` inputs over
     ``seq_axis`` and the batch over ``dp`` if present."""
@@ -108,7 +124,7 @@ def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp"):
     spec = P(batch_axis, seq_axis, None, None)
 
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis),
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -53,3 +53,19 @@ def test_ring_attention_full_sp_axis(rng):
     out = ring_self_attention(q, k, v, mesh, seq_axis="sp")
     ref = dot_product_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+
+def test_causal_ring_attention_matches_dense(rng):
+    q, k, v = _qkv(rng, B=2, S=64, H=2, D=8)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    out = ring_self_attention(q, k, v, mesh, seq_axis="sp", causal=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+
+def test_causal_ring_attention_full_sp(rng):
+    q, k, v = _qkv(rng, B=1, S=64, H=1, D=8)
+    mesh = make_mesh({"sp": 8})
+    out = ring_self_attention(q, k, v, mesh, seq_axis="sp", causal=True)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
