@@ -17,7 +17,7 @@ from distkeras_tpu.data.dataset import Dataset
 from distkeras_tpu.models.core import TrainedModel
 from distkeras_tpu.parallel.mesh import data_parallel_shardings
 
-__all__ = ["Predictor", "ModelPredictor"]
+__all__ = ["Predictor", "ModelPredictor", "EnsemblePredictor"]
 
 
 class Predictor:
@@ -78,6 +78,56 @@ class ModelPredictor(Predictor):
                 else jnp.asarray(chunk)
             )
             out = np.asarray(self._jitted(self.trained.variables, dev))
+            outs.append(out[: bs - pad] if pad else out)
+        preds = np.concatenate(outs) if outs else np.zeros((0,))
+        return dataset.with_column(self.output_col, preds)
+
+
+class EnsemblePredictor(Predictor):
+    """Average the softmax of N trained models (what ``EnsembleTrainer``
+    returns) in **one vmapped forward pass**: the model stack is a leading
+    axis on the parameters, not N sequential predicts."""
+
+    def __init__(
+        self,
+        models: list[TrainedModel],
+        features_col: str = "features",
+        output_col: str = "prediction",
+        batch_size: int = 1024,
+    ):
+        if not models:
+            raise ValueError("EnsemblePredictor needs at least one model")
+        self.models = models
+        self.features_col = features_col
+        self.output_col = output_col
+        self.batch_size = int(batch_size)
+        spec = models[0].model
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *[m.variables for m in models],
+        )
+        self._stacked = stacked
+
+        def one(variables, x):
+            out, _ = spec.apply(variables, x, train=False)
+            return jax.nn.softmax(out, axis=-1)
+
+        self._jitted = jax.jit(
+            lambda vs, x: jnp.mean(jax.vmap(one, in_axes=(0, None))(vs, x), axis=0)
+        )
+
+    def predict(self, dataset: Dataset) -> Dataset:
+        x = np.asarray(dataset[self.features_col])
+        outs = []
+        bs = self.batch_size
+        for lo in range(0, x.shape[0], bs):
+            chunk = x[lo : lo + bs]
+            pad = bs - chunk.shape[0] if chunk.shape[0] < bs else 0
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad, *chunk.shape[1:]), chunk.dtype)]
+                )
+            out = np.asarray(self._jitted(self._stacked, jnp.asarray(chunk)))
             outs.append(out[: bs - pad] if pad else out)
         preds = np.concatenate(outs) if outs else np.zeros((0,))
         return dataset.with_column(self.output_col, preds)

@@ -118,7 +118,7 @@ def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp", causal: bool = Fals
     ``mesh``, sharding the sequence dimension of ``[B, S, H, D]`` inputs over
     ``seq_axis`` and the batch over ``dp`` if present."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch_axis, seq_axis, None, None)

@@ -99,7 +99,7 @@ def pipeline_apply(
     Returns ``[M, B, ...]`` — the final stage's outputs, replicated.
     Differentiable end-to-end.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     num_stages = mesh.shape[axis_name]
     spec_params = P(axis_name)

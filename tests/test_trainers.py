@@ -178,3 +178,37 @@ def test_async_islands_sync_submesh(toy_classification):
     trained = trainer.train(toy_classification)
     assert _accuracy(trained, toy_classification) > 0.85
     assert trainer.parameter_server.num_commits > 0
+
+
+def test_ensemble_predictor_averages(toy_classification):
+    from distkeras_tpu.inference.predictors import EnsemblePredictor
+
+    trainer = dk.EnsembleTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01, num_models=3,
+        batch_size=16, num_epoch=4,
+    )
+    models = trainer.train(toy_classification)
+    pred = EnsemblePredictor(models, batch_size=128)
+    out = pred.predict(toy_classification)
+    probs = out["prediction"]
+    assert probs.shape == (len(toy_classification), 2)
+    assert np.allclose(probs.sum(-1), 1.0, atol=1e-5)  # averaged softmax
+    out = dk.LabelIndexTransformer(input_col="prediction").transform(out)
+    acc = dk.AccuracyEvaluator(
+        prediction_col="prediction_index", label_col="label"
+    ).evaluate(out)
+    assert acc > 0.85, acc
+
+
+def test_single_trainer_deterministic(toy_classification):
+    """Same seed, same data -> bit-identical weights (reproducibility)."""
+    def run():
+        t = dk.SingleTrainer(
+            _model(), worker_optimizer="adam", learning_rate=0.01,
+            batch_size=32, num_epoch=2, seed=11,
+        )
+        return t.train(toy_classification, shuffle=True)
+
+    w1 = run().params["Dense_0"]["kernel"]
+    w2 = run().params["Dense_0"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
