@@ -43,6 +43,9 @@ class BertConfig:
     # Use the Pallas flash-attention kernel (ops/pallas/flash_attention.py)
     # instead of dense attention. Unmasked attention only.
     use_flash_attention: bool = False
+    # > 0 replaces each dense MLP block with a top-1 MoE of this many
+    # experts (ops/moe.py; expert weights shard over the ep mesh axis).
+    moe_experts: int = 0
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -95,9 +98,20 @@ class EncoderLayer(nn.Module):
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-        y = _dense(cfg.mlp_dim, ("embed", "mlp"), "mlp_in", cfg.dtype)(y)
-        y = nn.gelu(y)
-        y = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_out", cfg.dtype)(y)
+        if cfg.moe_experts > 0:
+            from distkeras_tpu.ops.moe import MoEMLP
+
+            y = MoEMLP(
+                num_experts=cfg.moe_experts,
+                mlp_dim=cfg.mlp_dim,
+                dtype=cfg.dtype,
+                residual=False,
+                name="moe_mlp",
+            )(y, train=train)
+        else:
+            y = _dense(cfg.mlp_dim, ("embed", "mlp"), "mlp_in", cfg.dtype)(y)
+            y = nn.gelu(y)
+            y = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_out", cfg.dtype)(y)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         return x + y
 
@@ -197,3 +211,14 @@ def bert_tiny_mlm(seq_len: int = 64, vocab_size: int = 1024) -> Model:
         mlp_dim=512, max_seq_len=max(seq_len, 64),
     )
     return _make(cfg, seq_len, "bert_tiny_mlm")
+
+
+def bert_tiny_moe_mlm(
+    seq_len: int = 64, vocab_size: int = 1024, num_experts: int = 4
+) -> Model:
+    """MoE variant: each MLP block is a top-1 expert mixture (ep-shardable)."""
+    cfg = BertConfig(
+        vocab_size=vocab_size, hidden_size=128, num_layers=2, num_heads=4,
+        mlp_dim=512, max_seq_len=max(seq_len, 64), moe_experts=num_experts,
+    )
+    return _make(cfg, seq_len, "bert_tiny_moe_mlm")

@@ -35,6 +35,9 @@ class MoEMLP(nn.Module):
     mlp_dim: int
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
+    # Include the residual add (x + moe(x)). Set False when the caller owns
+    # the residual stream (e.g. a transformer block adding around LayerNorm).
+    residual: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -95,7 +98,8 @@ class MoEMLP(nn.Module):
         y = jnp.einsum(
             "tec,ecd->td", combine.astype(self.dtype), expert_out
         ).astype(x.dtype)
-        return x + y.reshape(B, S, D)
+        y = y.reshape(B, S, D)
+        return x + y if self.residual else y
 
     @staticmethod
     def reference_forward(variables, x):

@@ -375,7 +375,8 @@ class SynchronousDistributedTrainer(Trainer):
 
         optimizer = self._optimizer()
         model_axes = any(
-            a in mesh.axis_names and mesh.shape[a] > 1 for a in ("tp", "sp", "fsdp")
+            a in mesh.axis_names and mesh.shape[a] > 1
+            for a in ("tp", "sp", "fsdp", "ep")
         )
         if model_axes and (
             hasattr(self.model, "boxed_init") or "fsdp" in mesh.axis_names

@@ -68,3 +68,22 @@ def test_moe_gradients_flow(rng):
     g = jax.grad(loss)(variables)
     gn = np.asarray(jnp.linalg.norm(g["params"]["w_in"].reshape(-1)))
     assert np.isfinite(gn) and gn > 0
+
+
+def test_moe_bert_trains_on_ep_mesh(rng):
+    """MoE-BERT end-to-end on a dp x ep mesh via the sync trainer."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.bert import bert_tiny_moe_mlm
+
+    vocab, seq = 64, 8
+    tokens = np.asarray(rng.integers(1, vocab, size=(128, seq)), np.int32)
+    ds = dk.Dataset.from_arrays(features=tokens, label=tokens)
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    trainer = dk.SynchronousDistributedTrainer(
+        bert_tiny_moe_mlm(seq_len=seq, vocab_size=vocab, num_experts=4),
+        worker_optimizer="adam", learning_rate=1e-3,
+        batch_size=8, num_epoch=3, mesh=mesh,
+    )
+    trainer.train(ds)
+    hist = trainer.get_history()
+    assert hist[-1]["loss"] < hist[0]["loss"]
