@@ -1,0 +1,51 @@
+import numpy as np
+import pytest
+
+from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.inference.evaluators import (
+    AccuracyEvaluator,
+    ConfusionMatrixEvaluator,
+    PrecisionRecallEvaluator,
+)
+
+
+def _ds():
+    return Dataset.from_arrays(
+        prediction_index=np.array([1, 0, 1, 1, 0, 0]),
+        label=np.array([1, 0, 0, 1, 1, 0]),
+    )
+
+
+def test_accuracy():
+    assert AccuracyEvaluator().evaluate(_ds()) == pytest.approx(4 / 6)
+
+
+def test_accuracy_one_hot_label():
+    ds = Dataset.from_arrays(
+        prediction_index=np.array([1, 0]),
+        label=np.array([[0.0, 1.0], [0.0, 1.0]]),
+    )
+    assert AccuracyEvaluator().evaluate(ds) == pytest.approx(0.5)
+
+
+def test_accuracy_length_mismatch():
+    ds = Dataset.from_arrays(a=np.zeros(3), b=np.zeros(3))
+    ds2 = ds.with_column("prediction_index", np.zeros(3))
+    with pytest.raises(KeyError):
+        AccuracyEvaluator(label_col="missing").evaluate(ds2)
+
+
+def test_precision_recall_f1():
+    out = PrecisionRecallEvaluator().evaluate(_ds())
+    # preds==1: idx 0,2,3 -> tp=2 (0,3), fp=1 (2); fn=1 (idx 4)
+    assert out["tp"] == 2 and out["fp"] == 1 and out["fn"] == 1
+    assert out["precision"] == pytest.approx(2 / 3)
+    assert out["recall"] == pytest.approx(2 / 3)
+    assert out["f1"] == pytest.approx(2 / 3)
+
+
+def test_confusion_matrix():
+    m = ConfusionMatrixEvaluator(2).evaluate(_ds())
+    # rows=true, cols=pred
+    assert m[1, 1] == 2 and m[0, 0] == 2 and m[0, 1] == 1 and m[1, 0] == 1
+    assert m.sum() == 6
