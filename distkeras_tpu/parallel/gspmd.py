@@ -139,6 +139,7 @@ def make_sharded_train_step(
     loss: str | Callable,
     mesh: Mesh,
     donate: bool = True,
+    metrics: tuple[str, ...] = ("accuracy",),
 ):
     """Jitted ``(state, batch) -> (state, metrics)`` under GSPMD.
 
@@ -157,9 +158,9 @@ def make_sharded_train_step(
             outputs, new_model_state = model.apply(
                 variables, batch["features"], train=True, rngs={"dropout": step_rng}
             )
-            return loss_fn(outputs, batch["label"]), new_model_state
+            return loss_fn(outputs, batch["label"]), (outputs, new_model_state)
 
-        (loss_value, new_model_state), grads = jax.value_and_grad(
+        (loss_value, (outputs, new_model_state)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -170,6 +171,11 @@ def make_sharded_train_step(
             opt_state=new_opt_state,
             step=state.step + 1,
         )
-        return new_state, {"loss": loss_value}
+        out_metrics = {"loss": loss_value}
+        if "accuracy" in metrics:
+            from distkeras_tpu.ops.metrics import accuracy as accuracy_metric
+
+            out_metrics["accuracy"] = accuracy_metric(outputs, batch["label"])
+        return new_state, out_metrics
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

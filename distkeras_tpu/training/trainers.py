@@ -389,7 +389,9 @@ class SynchronousDistributedTrainer(Trainer):
             )
 
             state, _ = sharded_train_state(self.model, optimizer, mesh, rng=self.seed)
-            step_fn = make_sharded_train_step(self.model, optimizer, self.loss, mesh)
+            step_fn = make_sharded_train_step(
+                self.model, optimizer, self.loss, mesh, metrics=self.metrics
+            )
             shard_fn = lambda b: shard_batch(mesh, b)
         else:
             batch_sharding, replicated = data_parallel_shardings(mesh)
