@@ -178,13 +178,22 @@ def _make(cfg: BertConfig, seq_len: int, name: str) -> Model:
         variables = module.init({"params": rng, "dropout": rng}, dummy, train=False)
         # Strip Partitioned boxes for the plain (non-GSPMD) paths; the
         # sharded path re-derives specs via eval_shape on boxed_init.
-        return dict(nn.meta.unbox(variables))
+        out = dict(nn.meta.unbox(variables))
+        out.pop("aux_loss", None)  # sown per step, not persistent state
+        return out
 
     def boxed_init(rng):
         dummy = jnp.zeros((1, seq_len), jnp.int32)
-        return dict(module.init({"params": rng, "dropout": rng}, dummy, train=False))
+        out = dict(module.init({"params": rng, "dropout": rng}, dummy, train=False))
+        out.pop("aux_loss", None)
+        return out
 
     def apply_fn(variables, x, train=False, rngs=None):
+        if train and cfg.moe_experts > 0:
+            out, state = module.apply(
+                variables, x, train=train, rngs=rngs, mutable=["aux_loss"]
+            )
+            return out, dict(state)
         return module.apply(variables, x, train=train, rngs=rngs), {}
 
     m = Model(

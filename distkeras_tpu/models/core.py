@@ -81,7 +81,11 @@ class Model:
         def init_fn(rng: jax.Array) -> Variables:
             dummy = jnp.zeros((1, *input_shape), dtype=init_dtype)
             variables = module.init({"params": rng, "dropout": rng}, dummy, train=False)
-            return jax.tree.map(lambda x: x, dict(variables))  # unfreeze copy
+            out = jax.tree.map(lambda x: x, dict(variables))  # unfreeze copy
+            # "aux_loss" is a per-step sown output (e.g. MoE load balance),
+            # not persistent state — never carried in the variables.
+            out.pop("aux_loss", None)
+            return out
 
         def apply_fn(
             variables: Variables,
@@ -89,7 +93,11 @@ class Model:
             train: bool = False,
             rngs: dict[str, jax.Array] | None = None,
         ) -> tuple[jax.Array, Variables]:
-            mutable = [c for c in train_mutable if c in variables] if train else []
+            if train:
+                mutable = [c for c in train_mutable if c in variables]
+                mutable.append("aux_loss")  # sown fresh each step if present
+            else:
+                mutable = []
             if mutable:
                 out, new_state = module.apply(
                     variables, x, train=train, rngs=rngs, mutable=mutable

@@ -59,6 +59,15 @@ class MoEMLP(nn.Module):
         expert_idx = jnp.argmax(gates, axis=-1)  # [T]
         gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=-1)[:, 0]
 
+        # Switch-style load-balancing auxiliary loss: E * Σ_e f_e · P_e,
+        # where f_e is the fraction of tokens routed to expert e and P_e the
+        # mean router probability. Minimized (=1) at uniform routing. Sown
+        # into the "aux_loss" collection; the step engines add it to the
+        # task loss when present.
+        frac = jnp.mean(jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=0)
+        prob = jnp.mean(gates, axis=0)
+        self.sow("aux_loss", "load_balance", E * jnp.sum(frac * prob))
+
         onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
         # position of each token within its expert's queue
         pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]

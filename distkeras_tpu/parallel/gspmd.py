@@ -140,6 +140,7 @@ def make_sharded_train_step(
     mesh: Mesh,
     donate: bool = True,
     metrics: tuple[str, ...] = ("accuracy",),
+    aux_loss_weight: float = 0.01,
 ):
     """Jitted ``(state, batch) -> (state, metrics)`` under GSPMD.
 
@@ -158,7 +159,15 @@ def make_sharded_train_step(
             outputs, new_model_state = model.apply(
                 variables, batch["features"], train=True, rngs={"dropout": step_rng}
             )
-            return loss_fn(outputs, batch["label"]), (outputs, new_model_state)
+            task_loss = loss_fn(outputs, batch["label"])
+            aux = new_model_state.pop("aux_loss", None)
+            if aux is not None:
+                import jax.numpy as jnp
+
+                task_loss = task_loss + aux_loss_weight * sum(
+                    jnp.sum(leaf) for leaf in jax.tree.leaves(aux)
+                )
+            return task_loss, (outputs, new_model_state)
 
         (loss_value, (outputs, new_model_state)), grads = jax.value_and_grad(
             compute_loss, has_aux=True

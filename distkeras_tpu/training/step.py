@@ -74,6 +74,7 @@ def make_train_step(
     jit: bool = True,
     donate: bool = True,
     remat: bool = False,
+    aux_loss_weight: float = 0.01,
 ):
     """Build ``step(state, batch) -> (state, metrics_dict)``.
 
@@ -99,7 +100,15 @@ def make_train_step(
             outputs, new_model_state = apply_fn(
                 variables, batch["features"], True, rngs={"dropout": step_rng}
             )
-            return loss_fn(outputs, batch["label"]), (outputs, new_model_state)
+            task_loss = loss_fn(outputs, batch["label"])
+            # Sown auxiliary losses (MoE load balancing, ...) join the
+            # objective; they are per-step outputs, not persistent state.
+            aux = new_model_state.pop("aux_loss", None)
+            if aux is not None:
+                task_loss = task_loss + aux_loss_weight * sum(
+                    jnp.sum(leaf) for leaf in jax.tree.leaves(aux)
+                )
+            return task_loss, (outputs, new_model_state)
 
         (loss_value, (outputs, new_model_state)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
