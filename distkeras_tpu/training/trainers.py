@@ -454,6 +454,7 @@ class AsynchronousDistributedTrainer(Trainer):
         checkpoint_dir: str | None = None,
         checkpoint_interval_s: float = 60.0,
         resume: bool = False,
+        compress_deltas: bool = False,
         metric_stream=None,
         **protocol_kwargs,
     ):
@@ -479,6 +480,8 @@ class AsynchronousDistributedTrainer(Trainer):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_interval_s = float(checkpoint_interval_s)
         self.resume = bool(resume)
+        # bf16 commit deltas: halves PS wire traffic (ha.CompressingClient)
+        self.compress_deltas = bool(compress_deltas)
         if communication_window is not None:
             protocol_kwargs["communication_window"] = communication_window
         self.protocol = self._allocate_protocol(**protocol_kwargs)
@@ -600,11 +603,17 @@ class AsynchronousDistributedTrainer(Trainer):
                     put_batch = lambda b: {
                         k: jax.device_put(v, device) for k, v in b.items()
                     }
-                from distkeras_tpu.parallel.ha import RetryingClient, StampingClient
+                from distkeras_tpu.parallel.ha import (
+                    CompressingClient,
+                    RetryingClient,
+                    StampingClient,
+                )
 
                 client = self._make_client()
                 if self.transport == "grpc":
                     client = RetryingClient(client)
+                if self.compress_deltas:
+                    client = CompressingClient(client)
                 # Stamped commit ids + PS dedupe = exactly-once commits even
                 # through retries (the reference's Spark-retry path was
                 # silently at-least-once; SURVEY §5).

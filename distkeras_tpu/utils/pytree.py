@@ -82,14 +82,28 @@ def _key_str(key) -> str:
     return f"r:{key!r}"
 
 
+_WIDE_VIEW = {2: np.uint16, 1: np.uint8}
+
+
 def serialize_pytree(tree: Any) -> bytes:
-    """Serialize a PyTree of arrays to bytes (no pickle)."""
+    """Serialize a PyTree of arrays to bytes (no pickle).
+
+    Non-native dtypes (bfloat16, float8 — ml_dtypes) are stored as unsigned
+    views with the true dtype recorded, since npz round-trips them as raw
+    void data otherwise.
+    """
     leaves, _ = jax.tree.flatten(tree)
     buf = io.BytesIO()
-    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
-    arrays["__treedef__"] = np.frombuffer(
-        _treedef_to_json(tree).encode("utf-8"), dtype=np.uint8
-    )
+    arrays = {}
+    dtypes = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        dtypes.append(arr.dtype.name if arr.dtype.names is None else str(arr.dtype))
+        if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:
+            arr = np.ascontiguousarray(arr).view(_WIDE_VIEW[arr.dtype.itemsize])
+        arrays[f"leaf_{i}"] = arr
+    meta = json.dumps({"paths": json.loads(_treedef_to_json(tree)), "dtypes": dtypes})
+    arrays["__treedef__"] = np.frombuffer(meta.encode("utf-8"), dtype=np.uint8)
     np.savez(buf, **arrays)
     return buf.getvalue()
 
@@ -104,7 +118,15 @@ def deserialize_pytree(data: bytes, like: Any | None = None) -> Any:
     with np.load(io.BytesIO(data)) as npz:
         n = sum(1 for k in npz.files if k.startswith("leaf_"))
         leaves = [npz[f"leaf_{i}"] for i in range(n)]
-        paths = json.loads(bytes(npz["__treedef__"]).decode("utf-8"))
+        meta = json.loads(bytes(npz["__treedef__"]).decode("utf-8"))
+    if isinstance(meta, dict):
+        paths, dtypes = meta["paths"], meta["dtypes"]
+        leaves = [
+            leaf.view(np.dtype(dt)) if leaf.dtype.name != dt else leaf
+            for leaf, dt in zip(leaves, dtypes)
+        ]
+    else:  # legacy format: paths only
+        paths = meta
     if like is not None:
         treedef = jax.tree.structure(like)
         return jax.tree.unflatten(treedef, leaves)

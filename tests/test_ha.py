@@ -158,3 +158,22 @@ def test_trainer_ps_checkpoint_and_resume(tmp_path):
     preds = trained2.predict(x)
     acc = float(np.mean(np.argmax(preds, -1) == y))
     assert acc > 0.8, acc
+
+
+def test_compressed_deltas_train(tmp_path):
+    """bf16 delta compression end-to-end, in-process and over gRPC."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = dk.Dataset.from_arrays(features=x, label=y)
+    model = Model.from_flax(MLP(features=(16,), num_classes=2), input_shape=(8,))
+    for transport in ("inprocess", "grpc"):
+        trainer = dk.ADAG(
+            model, worker_optimizer="adam", learning_rate=0.01,
+            num_workers=2, batch_size=16, num_epoch=4, communication_window=4,
+            transport=transport, compress_deltas=True,
+        )
+        trained = trainer.train(ds)
+        preds = trained.predict(x)
+        acc = float(np.mean(np.argmax(preds, -1) == y))
+        assert acc > 0.85, (transport, acc)

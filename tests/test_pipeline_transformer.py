@@ -1,0 +1,77 @@
+"""Pipeline-parallel training of a small transformer LM: embedding and head
+outside the pipelined trunk, 4 residual attention+MLP blocks as stages over
+pp=4. Demonstrates pp is a *training* axis, not a demo."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distkeras_tpu.ops.attention import dot_product_attention
+from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+D, H, VOCAB, SEQ = 32, 2, 64, 16
+
+
+def block_fn(params, x):
+    """One pre-LN transformer block; shape-preserving [B, S, D]."""
+    def ln(z):
+        mu = z.mean(-1, keepdims=True)
+        var = ((z - mu) ** 2).mean(-1, keepdims=True)
+        return (z - mu) * jax.lax.rsqrt(var + 1e-6)
+
+    B, S, _ = x.shape
+    y = ln(x)
+    qkv = y @ params["wqkv"]  # [B,S,3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (B, S, H, D // H)
+    attn = dot_product_attention(
+        q.reshape(shape), k.reshape(shape), v.reshape(shape), causal=True
+    ).reshape(B, S, D)
+    x = x + attn @ params["wo"]
+    y = ln(x)
+    return x + jnp.tanh(y @ params["w1"]) @ params["w2"]
+
+
+def init_stage(rng):
+    s = 0.08
+    return {
+        "wqkv": rng.normal(size=(D, 3 * D)).astype(np.float32) * s,
+        "wo": rng.normal(size=(D, D)).astype(np.float32) * s,
+        "w1": rng.normal(size=(D, 2 * D)).astype(np.float32) * s,
+        "w2": rng.normal(size=(2 * D, D)).astype(np.float32) * s,
+    }
+
+
+def test_pipelined_transformer_trains(rng):
+    P, M, B = 4, 4, 2
+    mesh = make_mesh({"pp": P})
+    embed = rng.normal(size=(VOCAB, D)).astype(np.float32) * 0.1
+    stages = stack_stage_params([init_stage(rng) for _ in range(P)])
+    params = {"embed": jnp.asarray(embed), "stages": jax.tree.map(jnp.asarray, stages)}
+
+    tokens = rng.integers(0, VOCAB, size=(M, B, SEQ)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=-1)
+
+    def loss_fn(params):
+        x = params["embed"][tokens]  # [M, B, S, D]
+        # pipeline over the stage trunk; microbatch axis M
+        out = pipeline_apply(block_fn, params["stages"], x, mesh)
+        logits = out @ params["embed"].T  # tied head [M, B, S, V]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    losses = []
+    p = params
+    for _ in range(12):
+        loss, g = grad_fn(p)
+        updates, opt_state = opt.update(g, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+    assert np.isfinite(losses).all()

@@ -79,3 +79,14 @@ def test_serialize_keras_model_parity_helpers():
     back = deserialize_keras_model(blob, model)
     x = np.zeros((2, 3), np.float32)
     np.testing.assert_allclose(trained.predict(x), back.predict(x), atol=1e-7)
+
+
+def test_serialize_bfloat16_roundtrip():
+    import ml_dtypes
+
+    t = {"w": np.full((3, 2), 1.5, ml_dtypes.bfloat16),
+         "b": np.zeros(2, np.float32)}
+    back = deserialize_pytree(serialize_pytree(t))
+    assert back["w"].dtype == ml_dtypes.bfloat16
+    assert np.allclose(back["w"].astype(np.float32), 1.5)
+    assert back["b"].dtype == np.float32
