@@ -75,6 +75,7 @@ def make_train_step(
     donate: bool = True,
     remat: bool = False,
     aux_loss_weight: float = 0.01,
+    grad_accum_steps: int = 1,
 ):
     """Build ``step(state, batch) -> (state, metrics_dict)``.
 
@@ -84,6 +85,9 @@ def make_train_step(
     wraps the forward pass in ``jax.checkpoint`` — activations are
     recomputed in the backward pass instead of held in HBM, trading FLOPs
     for memory (long sequences / deep models on one chip).
+    ``grad_accum_steps=k`` splits the batch into k micro-batches scanned
+    sequentially with gradient averaging and ONE optimizer update — a k×
+    effective batch at 1/k activation memory.
     """
     loss_fn = get_loss(loss)
     apply_fn = model.apply
@@ -91,28 +95,76 @@ def make_train_step(
         apply_fn = jax.checkpoint(
             model.apply, static_argnums=(2,), policy=None
         )
+    accum = max(1, int(grad_accum_steps))
+
+    def forward(params, model_state, features, labels, step_rng):
+        variables = {"params": params, **model_state}
+        outputs, new_model_state = apply_fn(
+            variables, features, True, rngs={"dropout": step_rng}
+        )
+        task_loss = loss_fn(outputs, labels)
+        # Sown auxiliary losses (MoE load balancing, ...) join the
+        # objective; they are per-step outputs, not persistent state.
+        aux = new_model_state.pop("aux_loss", None)
+        if aux is not None:
+            task_loss = task_loss + aux_loss_weight * sum(
+                jnp.sum(leaf) for leaf in jax.tree.leaves(aux)
+            )
+        return task_loss, (outputs, new_model_state)
 
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         step_rng = jax.random.fold_in(state.rng, state.step)
 
-        def compute_loss(params):
-            variables = {"params": params, **state.model_state}
-            outputs, new_model_state = apply_fn(
-                variables, batch["features"], True, rngs={"dropout": step_rng}
+        if accum == 1:
+            (loss_value, (outputs, new_model_state)), grads = jax.value_and_grad(
+                forward, has_aux=True
+            )(state.params, state.model_state, batch["features"], batch["label"],
+              step_rng)
+            out_metrics = {"loss": loss_value}
+            if "accuracy" in metrics:
+                out_metrics["accuracy"] = accuracy_metric(outputs, batch["label"])
+        else:
+            B = batch["features"].shape[0]
+            micro = B // accum
+            feats = batch["features"][: micro * accum].reshape(
+                accum, micro, *batch["features"].shape[1:]
             )
-            task_loss = loss_fn(outputs, batch["label"])
-            # Sown auxiliary losses (MoE load balancing, ...) join the
-            # objective; they are per-step outputs, not persistent state.
-            aux = new_model_state.pop("aux_loss", None)
-            if aux is not None:
-                task_loss = task_loss + aux_loss_weight * sum(
-                    jnp.sum(leaf) for leaf in jax.tree.leaves(aux)
-                )
-            return task_loss, (outputs, new_model_state)
+            labels = batch["label"][: micro * accum].reshape(
+                accum, micro, *batch["label"].shape[1:]
+            )
 
-        (loss_value, (outputs, new_model_state)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
+            def micro_step(carry, xs):
+                grads_acc, loss_acc, acc_acc, model_state = carry
+                f, l, i = xs
+                rng_i = jax.random.fold_in(step_rng, i)
+                (loss_value, (outputs, new_ms)), grads = jax.value_and_grad(
+                    forward, has_aux=True
+                )(state.params, model_state, f, l, rng_i)
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                acc = (
+                    accuracy_metric(outputs, l)
+                    if "accuracy" in metrics
+                    else jnp.zeros(())
+                )
+                return (
+                    grads_acc,
+                    loss_acc + loss_value,
+                    acc_acc + acc,
+                    new_ms if new_ms else model_state,
+                ), None
+
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, loss_sum, acc_sum, new_model_state), _ = jax.lax.scan(
+                micro_step,
+                (zero_grads, jnp.zeros(()), jnp.zeros(()), state.model_state),
+                (feats, labels, jnp.arange(accum)),
+            )
+            grads = jax.tree.map(lambda g: g / accum, grads)
+            loss_value = loss_sum / accum
+            out_metrics = {"loss": loss_value}
+            if "accuracy" in metrics:
+                out_metrics["accuracy"] = acc_sum / accum
+
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -121,9 +173,6 @@ def make_train_step(
             opt_state=new_opt_state,
             step=state.step + 1,
         )
-        out_metrics = {"loss": loss_value}
-        if "accuracy" in metrics:
-            out_metrics["accuracy"] = accuracy_metric(outputs, batch["label"])
         return new_state, out_metrics
 
     if jit:

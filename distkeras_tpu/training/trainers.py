@@ -161,6 +161,8 @@ class SingleTrainer(Trainer):
         num_epoch: int = 1,
         learning_rate: float | None = None,
         seed: int = 0,
+        grad_accum_steps: int = 1,
+        remat: bool = False,
         metric_stream=None,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
@@ -169,11 +171,16 @@ class SingleTrainer(Trainer):
         self.label_col = label_col
         self.batch_size = int(batch_size)
         self.num_epoch = int(num_epoch)
+        self.grad_accum_steps = int(grad_accum_steps)
+        self.remat = bool(remat)
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
         optimizer = self._optimizer()
-        step_fn = make_train_step(self.model, optimizer, self.loss, self.metrics)
+        step_fn = make_train_step(
+            self.model, optimizer, self.loss, self.metrics,
+            remat=self.remat, grad_accum_steps=self.grad_accum_steps,
+        )
         state = TrainState.create(self.model, optimizer, rng=self.seed)
         batches = minibatches(
             dataset,
