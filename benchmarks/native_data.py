@@ -1,0 +1,78 @@
+"""Native data-plane speedups vs pure Python/numpy.
+
+Measures `native/fastdata.cpp` (ctypes) against the fallback paths for the
+host-side hot ops: CSV parse, shuffle gather, batch pack. Prints one JSON
+line. (The reference assembled minibatches row-by-row in Python inside
+executors — its data path; SURVEY §3.1.)
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io
+import json
+import time
+
+import numpy as np
+
+from distkeras_tpu.data import native
+
+
+def timeit(fn, repeat=5):
+    best = float("inf")
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    assert native.available(), "build with: make -C native"
+    rng = np.random.default_rng(0)
+
+    # CSV parse: 20k rows x 29 cols
+    rows, cols = 20000, 29
+    mat = rng.normal(size=(rows, cols)).astype(np.float32)
+    buf = io.StringIO()
+    np.savetxt(buf, mat, fmt="%.6f", delimiter=",")
+    data = buf.getvalue().encode()
+
+    def py_parse():
+        reader = _csv.reader(io.StringIO(data.decode()))
+        return np.array([[float(v) for v in row] for row in reader], np.float32)
+
+    t_native_parse = timeit(lambda: native.parse_csv(data, rows, cols), 3)
+    t_py_parse = timeit(py_parse, 3)
+
+    # gather: 1M rows x 32
+    src = rng.normal(size=(1_000_000, 32)).astype(np.float32)
+    idx = rng.permutation(1_000_000)
+    t_native_gather = timeit(lambda: native.gather_rows(src, idx))
+    t_np_gather = timeit(lambda: src[idx])
+
+    # pack with fused normalize
+    t_native_pack = timeit(
+        lambda: native.pack_batch(src, 0, 65536, scale=1 / 255.0, shift=0.0)
+    )
+    t_np_pack = timeit(lambda: src[0:65536] * (1 / 255.0))
+
+    print(json.dumps({
+        "metric": "native_data_plane_speedup",
+        "csv_parse": {
+            "native_s": round(t_native_parse, 4), "python_s": round(t_py_parse, 4),
+            "speedup": round(t_py_parse / t_native_parse, 1),
+        },
+        "shuffle_gather_1m": {
+            "native_s": round(t_native_gather, 4), "numpy_s": round(t_np_gather, 4),
+            "speedup": round(t_np_gather / t_native_gather, 2),
+        },
+        "fused_pack_normalize": {
+            "native_s": round(t_native_pack, 4), "numpy_s": round(t_np_pack, 4),
+            "speedup": round(t_np_pack / t_native_pack, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,8 +45,17 @@ from distkeras_tpu.training.trainers import (
     SynchronousDistributedTrainer,
     Trainer,
 )
-from distkeras_tpu.inference.predictors import ModelPredictor, Predictor
-from distkeras_tpu.inference.evaluators import AccuracyEvaluator
+from distkeras_tpu.inference.predictors import (
+    EnsemblePredictor,
+    ModelPredictor,
+    Predictor,
+)
+from distkeras_tpu.inference.evaluators import (
+    AccuracyEvaluator,
+    ConfusionMatrixEvaluator,
+    PrecisionRecallEvaluator,
+)
+from distkeras_tpu.utils.config import TrainerConfig
 
 __all__ = [
     "Dataset",
@@ -69,5 +78,9 @@ __all__ = [
     "LabelIndexTransformer",
     "Predictor",
     "ModelPredictor",
+    "EnsemblePredictor",
     "AccuracyEvaluator",
+    "PrecisionRecallEvaluator",
+    "ConfusionMatrixEvaluator",
+    "TrainerConfig",
 ]

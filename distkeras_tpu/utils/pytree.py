@@ -133,6 +133,8 @@ def deserialize_pytree(data: bytes, like: Any | None = None) -> Any:
     # Rebuild nested dicts/lists from tagged paths ("d:name" dict key,
     # "s:idx" sequence index). The tag travels with the key so a dict whose
     # keys happen to be digits is never mistaken for a list.
+    if len(leaves) == 1 and paths and paths[0] == "":
+        return leaves[0]  # the tree was a bare leaf
     root: dict = {}
     for path_str, leaf in zip(paths, leaves):
         keys = path_str.split("/") if path_str else []

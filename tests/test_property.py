@@ -1,0 +1,103 @@
+"""Property-based tests (hypothesis): serializer round-trips and protocol
+invariants over arbitrary inputs — the systematic version of SURVEY §4's
+"property test hammering concurrent commits"."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from distkeras_tpu.parallel.protocols import ADAGProtocol, DOWNPOURProtocol, DynSGDProtocol
+from distkeras_tpu.utils.pytree import deserialize_pytree, serialize_pytree
+
+# -- strategies --------------------------------------------------------------
+
+leaf_shapes = st.lists(st.integers(1, 5), min_size=0, max_size=3).map(tuple)
+
+
+@st.composite
+def pytrees(draw, depth=2):
+    if depth == 0 or draw(st.booleans()):
+        shape = draw(leaf_shapes)
+        return np.asarray(
+            draw(
+                st.lists(
+                    st.floats(-1e6, 1e6, width=32),
+                    min_size=int(np.prod(shape, dtype=int)),
+                    max_size=int(np.prod(shape, dtype=int)),
+                )
+            ),
+            np.float32,
+        ).reshape(shape)
+    n = draw(st.integers(1, 3))
+    keys = draw(
+        st.lists(
+            st.text("abcdefgh_0123", min_size=1, max_size=6),
+            min_size=n, max_size=n, unique=True,
+        )
+    )
+    return {k: draw(pytrees(depth=depth - 1)) for k in keys}
+
+
+@settings(max_examples=40, deadline=None)
+@given(pytrees())
+def test_serializer_roundtrip_arbitrary_trees(tree):
+    back = deserialize_pytree(serialize_pytree(tree))
+
+    def check(a, b):
+        if isinstance(a, dict):
+            assert set(a) == set(b)
+            for k in a:
+                check(a[k], b[k])
+        else:
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+    check(tree, back)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    st.lists(st.floats(-100, 100, width=32), min_size=1, max_size=30),
+    st.integers(1, 8),
+)
+def test_downpour_center_is_sum_of_deltas(deltas, num_workers):
+    """Additive protocol: any commit order yields center == Σ deltas."""
+    p = DOWNPOURProtocol()
+    center, n = {"w": np.zeros(1, np.float32)}, 0
+    for d in deltas:
+        center, n = p.server_commit(
+            center, n, {"delta": {"w": np.full(1, d, np.float32)}}, num_workers
+        )
+    assert n == len(deltas)
+    np.testing.assert_allclose(center["w"][0], np.float32(sum(np.float32(d) for d in deltas)), rtol=1e-4, atol=1e-4)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    st.lists(
+        st.tuples(st.floats(-10, 10, width=32), st.integers(0, 1000)),
+        min_size=1, max_size=30,
+    )
+)
+def test_dynsgd_center_bounded_and_counter_exact(commits):
+    """DynSGD: counter == #commits; each applied delta is damped (≤ |delta|)."""
+    p = DynSGDProtocol()
+    center, n = {"w": np.zeros(1, np.float64)}, 0
+    bound = 0.0
+    for d, last in commits:
+        last = min(last, n)  # a worker can't have seen the future
+        center, n = p.server_commit(
+            center, n, {"delta": {"w": np.full(1, d)}, "last_update": last}, 2
+        )
+        bound += abs(d)
+    assert n == len(commits)
+    assert abs(center["w"][0]) <= bound + 1e-9
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(1, 16), st.floats(0.1, 10.0))
+def test_adag_scaling_is_1_over_n(num_workers, mag):
+    p = ADAGProtocol()
+    center, n = p.server_commit(
+        {"w": np.zeros(1, np.float64)}, 0,
+        {"delta": {"w": np.full(1, mag)}}, num_workers,
+    )
+    np.testing.assert_allclose(center["w"][0], mag / num_workers)
