@@ -57,9 +57,10 @@ def _pipeline_local(stage_fn, stacked_params, microbatches, axis_name: str):
     feat_shape = microbatches.shape[2:]
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
-    # pvary: the carry must be device-varying over the pp axis from the
-    # start (ppermute outputs are varying; scan carries must type-match).
-    state = lax.pvary(jnp.zeros((B, *feat_shape), microbatches.dtype), (axis_name,))
+    # The carry must be device-varying over the pp axis from the start
+    # (ppermute outputs are varying; scan carries must type-match).
+    zeros = jnp.zeros((B, *feat_shape), microbatches.dtype)
+    state = lax.pcast(zeros, axis_name, to="varying")
 
     def tick(carry, t):
         state = carry
