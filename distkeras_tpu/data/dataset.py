@@ -65,7 +65,11 @@ class Dataset:
 
         with open(path, "rb") as fb:
             raw = fb.read()
-        nl = raw.index(b"\n")
+        if not raw.strip():
+            raise ValueError(f"empty CSV file: {path}")
+        nl = raw.find(b"\n")
+        if nl == -1:  # header-only file without trailing newline
+            nl = len(raw)
         header = raw[:nl].decode().strip().split(",")
         body = raw[nl + 1 :]
         table: dict[str, np.ndarray] = {}

@@ -71,9 +71,12 @@ class Job:
     # -- lifecycle (reference: send -> execute -> fetch) ---------------------
 
     def send(self) -> None:
-        """Ship data and script to the target (scp), or stage locally."""
+        """Ship data and script to the target (scp), or stage locally (same
+        layout the remote path establishes: inputs sit next to the script)."""
         if self.address is None:
             os.makedirs(self._local_dir(), exist_ok=True)
+            for p in filter(None, (self.data_path, self.script_path)):
+                subprocess.run(["cp", "-r", p, self._local_dir()], check=False)
             return
         self._run(["ssh", self._target(), f"mkdir -p {self._remote_job_dir()}"])
         for p in filter(None, (self.data_path, self.script_path)):
@@ -93,7 +96,11 @@ class Job:
         )
         script_name = os.path.basename(self.script_path)
         if self.address is None:
-            cmd = f"cd {shlex.quote(self._local_dir())} && {env_prefix} python {shlex.quote(os.path.abspath(self.script_path))}"
+            # run the staged copy by name, mirroring the remote layout
+            cmd = (
+                f"cd {shlex.quote(self._local_dir())} && {env_prefix} "
+                f"python {shlex.quote(script_name)}"
+            )
             r = subprocess.run(["bash", "-c", cmd], capture_output=True, text=True)
         else:
             remote_cmd = (

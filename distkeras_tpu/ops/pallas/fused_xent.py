@@ -2,14 +2,16 @@
 
 For large-vocabulary heads (BERT MLM: ``[tokens, 30k+]`` logits), the naive
 ``softmax -> log -> gather`` chain materializes full probability tensors in
-HBM. This kernel streams vocabulary chunks through VMEM with an online
-logsumexp, producing per-token loss directly; the backward kernel
-regenerates ``softmax - onehot`` chunk-by-chunk the same way. Nothing of
-shape ``[T, V]`` is allocated beyond the logits themselves.
+HBM. Here the **vocabulary is a grid axis**: each kernel invocation sees one
+``[block_t, block_v]`` tile in VMEM while float32 scratch accumulators
+(running max / sum-exp / picked logit) persist across the vocab sweep — an
+online logsumexp whose VMEM footprint is one tile, independent of V. The
+backward runs the same sweep twice (stats, then ``softmax − onehot`` tiles).
 
 float32 statistics throughout (logits may be bf16); label gathering uses
 ``broadcasted_iota`` comparison (no 1-D iota on TPU — pallas guide pitfall
-#4).
+#4). Grid iteration order on TPU is sequential with the last axis fastest,
+which is what the cross-iteration scratch carry relies on.
 """
 
 from __future__ import annotations
@@ -19,102 +21,141 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_softmax_xent"]
 
 
-def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, block_v: int, vocab: int):
-    """One block of tokens: online logsumexp over vocab chunks."""
+def _fwd_kernel(logits_ref, labels_ref, loss_ref, m_ref, s_ref, picked_ref,
+                *, block_v: int):
+    """Grid (nt, nv), vocab fastest. Scratch persists across the vocab sweep."""
+    v_idx = pl.program_id(1)
+    nv = pl.num_programs(1)
     t = logits_ref.shape[0]
-    labels = labels_ref[:, 0]  # [T]
-    m = jnp.full((t, 1), -1e30, jnp.float32)
-    s = jnp.zeros((t, 1), jnp.float32)
-    picked = jnp.zeros((t, 1), jnp.float32)
 
-    def body(i, carry):
-        m, s, picked = carry
-        chunk = logits_ref[:, pl.ds(i * block_v, block_v)].astype(jnp.float32)
-        cmax = jnp.max(chunk, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, cmax)
-        s = s * jnp.exp(m - m_new) + jnp.sum(
-            jnp.exp(chunk - m_new), axis=-1, keepdims=True
-        )
-        cols = i * block_v + jax.lax.broadcasted_iota(jnp.int32, (t, block_v), 1)
-        hit = (cols == labels[:, None]).astype(jnp.float32)
-        picked = picked + jnp.sum(hit * chunk, axis=-1, keepdims=True)
-        return m_new, s, picked
+    @pl.when(v_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        picked_ref[:] = jnp.zeros_like(picked_ref)
 
-    m, s, picked = jax.lax.fori_loop(0, vocab // block_v, body, (m, s, picked))
-    loss_ref[:, 0] = (jnp.log(s[:, 0]) + m[:, 0]) - picked[:, 0]
+    chunk = logits_ref[:].astype(jnp.float32)  # [block_t, block_v]
+    labels = labels_ref[:, 0]
+    m = m_ref[:]
+    cmax = jnp.max(chunk, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, cmax)
+    s_ref[:] = s_ref[:] * jnp.exp(m - m_new) + jnp.sum(
+        jnp.exp(chunk - m_new), axis=-1, keepdims=True
+    )
+    m_ref[:] = m_new
+    cols = v_idx * block_v + jax.lax.broadcasted_iota(jnp.int32, (t, block_v), 1)
+    hit = (cols == labels[:, None]).astype(jnp.float32)
+    picked_ref[:] = picked_ref[:] + jnp.sum(hit * chunk, axis=-1, keepdims=True)
+
+    @pl.when(v_idx == nv - 1)
+    def _emit():
+        loss_ref[:, 0] = (
+            jnp.log(s_ref[:, 0]) + m_ref[:, 0]
+        ) - picked_ref[:, 0]
 
 
-def _bwd_kernel(logits_ref, labels_ref, g_ref, dlogits_ref, *, block_v: int,
-                vocab: int):
-    """dlogits = (softmax(logits) - onehot(labels)) * g, chunked over vocab."""
+def _stats_kernel(logits_ref, m_out_ref, s_out_ref, m_ref, s_ref):
+    """Grid (nt, nv): logsumexp stats per token block, written at sweep end."""
+    v_idx = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(v_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        s_ref[:] = jnp.zeros_like(s_ref)
+
+    chunk = logits_ref[:].astype(jnp.float32)
+    m = m_ref[:]
+    cmax = jnp.max(chunk, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, cmax)
+    s_ref[:] = s_ref[:] * jnp.exp(m - m_new) + jnp.sum(
+        jnp.exp(chunk - m_new), axis=-1, keepdims=True
+    )
+    m_ref[:] = m_new
+
+    @pl.when(v_idx == nv - 1)
+    def _emit():
+        m_out_ref[:] = m_ref[:]
+        s_out_ref[:] = s_ref[:]
+
+
+def _grad_kernel(logits_ref, labels_ref, g_ref, m_ref, s_ref, dlogits_ref,
+                 *, block_v: int):
+    """Grid (nt, nv): dlogits tile = (softmax − onehot) · g."""
+    v_idx = pl.program_id(1)
     t = logits_ref.shape[0]
+    chunk = logits_ref[:].astype(jnp.float32)
     labels = labels_ref[:, 0]
     g = g_ref[:, 0].astype(jnp.float32)
-    # pass 1: logsumexp statistics
-    m = jnp.full((t, 1), -1e30, jnp.float32)
-    s = jnp.zeros((t, 1), jnp.float32)
+    p = jnp.exp(chunk - m_ref[:]) / s_ref[:]
+    cols = v_idx * block_v + jax.lax.broadcasted_iota(jnp.int32, (t, block_v), 1)
+    onehot = (cols == labels[:, None]).astype(jnp.float32)
+    dlogits_ref[:] = ((p - onehot) * g[:, None]).astype(dlogits_ref.dtype)
 
-    def stat(i, carry):
-        m, s = carry
-        chunk = logits_ref[:, pl.ds(i * block_v, block_v)].astype(jnp.float32)
-        cmax = jnp.max(chunk, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, cmax)
-        s = s * jnp.exp(m - m_new) + jnp.sum(
-            jnp.exp(chunk - m_new), axis=-1, keepdims=True
-        )
-        return m_new, s
 
-    m, s = jax.lax.fori_loop(0, vocab // block_v, stat, (m, s))
-
-    # pass 2: write gradients
-    def write(i, _):
-        chunk = logits_ref[:, pl.ds(i * block_v, block_v)].astype(jnp.float32)
-        p = jnp.exp(chunk - m) / s
-        cols = i * block_v + jax.lax.broadcasted_iota(jnp.int32, (t, block_v), 1)
-        onehot = (cols == labels[:, None]).astype(jnp.float32)
-        dlogits_ref[:, pl.ds(i * block_v, block_v)] = (
-            (p - onehot) * g[:, None]
-        ).astype(dlogits_ref.dtype)
-        return 0
-
-    jax.lax.fori_loop(0, vocab // block_v, write, 0)
+def _grids(T, V, block_t, block_v):
+    return (T // block_t, V // block_v)
 
 
 def _call_fwd(logits, labels, block_t, block_v, interpret):
     T, V = logits.shape
-    kernel = functools.partial(_fwd_kernel, block_v=min(block_v, V), vocab=V)
     return pl.pallas_call(
-        kernel,
-        grid=(T // block_t,),
+        functools.partial(_fwd_kernel, block_v=block_v),
+        grid=_grids(T, V, block_t, block_v),
         in_specs=[
-            pl.BlockSpec((block_t, V), lambda i: (i, 0)),
-            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_t, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((T, 1), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(logits, labels[:, None])[:, 0]
 
 
 def _call_bwd(logits, labels, g, block_t, block_v, interpret):
     T, V = logits.shape
-    kernel = functools.partial(_bwd_kernel, block_v=min(block_v, V), vocab=V)
-    return pl.pallas_call(
-        kernel,
-        grid=(T // block_t,),
-        in_specs=[
-            pl.BlockSpec((block_t, V), lambda i: (i, 0)),
-            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
-            pl.BlockSpec((block_t, 1), lambda i: (i, 0)),
+    m, s = pl.pallas_call(
+        _stats_kernel,
+        grid=_grids(T, V, block_t, block_v),
+        in_specs=[pl.BlockSpec((block_t, block_v), lambda i, j: (i, j))],
+        out_specs=(
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, 1), jnp.float32),
+            jax.ShapeDtypeStruct((T, 1), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
         ],
-        out_specs=pl.BlockSpec((block_t, V), lambda i: (i, 0)),
+        interpret=interpret,
+    )(logits)
+    return pl.pallas_call(
+        functools.partial(_grad_kernel, block_v=block_v),
+        grid=_grids(T, V, block_t, block_v),
+        in_specs=[
+            pl.BlockSpec((block_t, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, block_v), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((T, V), logits.dtype),
         interpret=interpret,
-    )(logits, labels[:, None], g[:, None])
+    )(logits, labels[:, None], g[:, None], m, s)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
@@ -132,6 +173,13 @@ def _xent_bwd(block_t, block_v, interpret, residuals, g):
 
 
 _xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+def _fit_block(n: int, preferred: int) -> int:
+    b = min(preferred, n)
+    while n % b:
+        b //= 2
+    return max(1, b)
 
 
 def fused_softmax_xent(
@@ -153,9 +201,7 @@ def fused_softmax_xent(
     flat_logits = logits.reshape(-1, V)
     flat_labels = labels.reshape(-1).astype(jnp.int32)
     T = flat_logits.shape[0]
-    bt = block_t
-    while T % bt and bt > 1:
-        bt //= 2
-    bv = block_v if V % block_v == 0 else V
+    bt = _fit_block(T, block_t)
+    bv = _fit_block(V, block_v)
     per_token = _xent(flat_logits, flat_labels, bt, bv, interpret)
     return jnp.mean(per_token)

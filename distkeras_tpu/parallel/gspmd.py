@@ -35,11 +35,14 @@ __all__ = [
 
 def batch_sharding(mesh: Mesh, batch_rank: int = 2, seq_dim: int | None = 1):
     """Sharding for a ``[B, ...]`` batch: B over the data axes (dp and, when
-    present, fsdp — both carry data parallelism), seq dim over sp."""
+    present, fsdp — both carry data parallelism; policy lives in
+    ``mesh.shard_batch_spec``), seq dim over sp."""
+    from distkeras_tpu.parallel.mesh import shard_batch_spec
+
+    batch_spec = shard_batch_spec(mesh)  # P(<data axes>) or P()
     spec: list = [None] * batch_rank
-    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-    if batch_axes:
-        spec[0] = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+    if len(batch_spec) > 0:
+        spec[0] = batch_spec[0]
     if seq_dim is not None and "sp" in mesh.axis_names and seq_dim < batch_rank:
         spec[seq_dim] = "sp"
     return NamedSharding(mesh, P(*spec))

@@ -51,13 +51,14 @@ class RetryingClient:
     def _with_retries(self, fn: Callable, what: str):
         delay = self.base_delay
         last_exc: BaseException | None = None
-        for _ in range(self.max_retries + 1):
+        for attempt in range(self.max_retries + 1):
             try:
                 return fn()
             except Exception as e:  # transport-level failure
                 last_exc = e
-                time.sleep(delay)
-                delay = min(delay * 2, self.max_delay)
+                if attempt < self.max_retries:  # no pointless final sleep
+                    time.sleep(delay)
+                    delay = min(delay * 2, self.max_delay)
         raise ParameterServerUnavailable(
             f"{what} failed after {self.max_retries + 1} attempts"
         ) from last_exc

@@ -70,15 +70,15 @@ def _pipeline_local(stage_fn, stacked_params, microbatches, axis_name: str):
         )
         state = jnp.where(p == 0, jnp.where(t < M, x_in, state), state)
         y = stage_fn(my_params, state)
-        # after the last stage computes microbatch (t - P + 1), its result
-        # rotates back to stage 0's slot; emit from the last stage.
+        # the last stage owns microbatch (t - P + 1)'s final output; other
+        # devices contribute zeros and ONE psum after the scan broadcasts
+        # the results (keeping collectives off the scan's critical path).
         emitted = jnp.where(p == num_stages - 1, y, jnp.zeros_like(y))
-        # sum over the axis so every device carries the emitted value
-        emitted = lax.psum(emitted, axis_name)
         state = lax.ppermute(y, axis_name, perm)
         return state, emitted
 
     _, emitted_seq = lax.scan(tick, state, jnp.arange(M + num_stages - 1))
+    emitted_seq = lax.psum(emitted_seq, axis_name)
     # microbatch m is emitted at tick m + P - 1
     return emitted_seq[num_stages - 1 :]
 
@@ -102,7 +102,6 @@ def pipeline_apply(
     """
     from jax import shard_map
 
-    num_stages = mesh.shape[axis_name]
     spec_params = P(axis_name)
     fn = shard_map(
         partial(_pipeline_local, stage_fn, axis_name=axis_name),
@@ -110,8 +109,6 @@ def pipeline_apply(
         in_specs=(jax.tree.map(lambda _: spec_params, stacked_params), P()),
         out_specs=P(),
     )
-    M = microbatches.shape[0]
-    if M < 1:
+    if microbatches.shape[0] < 1:
         raise ValueError("need at least one microbatch")
-    del num_stages
     return fn(stacked_params, microbatches)

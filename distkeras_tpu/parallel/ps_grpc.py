@@ -173,7 +173,16 @@ class GrpcClient:
     :class:`distkeras_tpu.parallel.ps.InProcessClient` — trainers are
     transport-agnostic."""
 
-    def __init__(self, host: str, port: int = DEFAULT_PORT, like: Any = None):
+    def __init__(
+        self,
+        host: str,
+        port: int = DEFAULT_PORT,
+        like: Any = None,
+        rpc_timeout_s: float = 120.0,
+    ):
+        # Every RPC carries a deadline: a wedged-but-alive PS must surface as
+        # an error the HA retry layer can act on, not an eternal block.
+        self._rpc_timeout_s = float(rpc_timeout_s)
         import grpc
 
         self._channel = grpc.insecure_channel(
@@ -201,7 +210,9 @@ class GrpcClient:
         self._like = like
 
     def pull(self) -> tuple[Any, int]:
-        return _decode_pull_reply(self._pull(b""), like=self._like)
+        return _decode_pull_reply(
+            self._pull(b"", timeout=self._rpc_timeout_s), like=self._like
+        )
 
     def commit(self, payload: dict) -> None:
         import jax
@@ -210,7 +221,10 @@ class GrpcClient:
         # commit_id rides as an extra npz leaf so the frame format is stable
         if "commit_id" in payload:
             delta = {"__commit_id__": _id_to_array(payload["commit_id"]), "d": delta}
-        self._commit(_encode_commit(delta, int(payload.get("last_update", 0))))
+        self._commit(
+            _encode_commit(delta, int(payload.get("last_update", 0))),
+            timeout=self._rpc_timeout_s,
+        )
 
     def health(self, timeout: float = 5.0) -> dict:
         import json

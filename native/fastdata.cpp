@@ -39,7 +39,10 @@ int64_t fd_parse_csv_f32(const char* buf, int64_t len, float* out,
         else return -1;
       }
     }
-    while (p < end && (*p == '\r' || *p == '\n' || *p == ',')) ++p;
+    // The row must END here: a ',' means more fields than the header
+    // declared — reject rather than misalign every following row.
+    if (p < end && *p != '\r' && *p != '\n') return -1;
+    while (p < end && (*p == '\r' || *p == '\n')) ++p;
     ++r;
   }
   return r;

@@ -55,3 +55,9 @@ def test_column_minmax():
     x = np.array([[3.0, -1.5], [10.0, 0.0]], np.float32)
     lo, hi = native.column_minmax(x)
     assert lo == -1.5 and hi == 10.0
+
+
+def test_parse_csv_rejects_extra_fields():
+    # extra field must not silently misalign following rows
+    with pytest.raises(ValueError):
+        native.parse_csv(b"1,2,3,4\n5,6,7\n", rows=2, cols=3)
