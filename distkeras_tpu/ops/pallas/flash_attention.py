@@ -1,4 +1,4 @@
-"""Flash attention — Pallas TPU kernel for the attention hot op.
+"""Flash attention — Pallas TPU kernels, forward AND backward.
 
 Blocked online-softmax attention: Q tiles stream through VMEM against K/V
 blocks with float32 running max/denominator, so the ``S×S`` score matrix is
@@ -6,17 +6,22 @@ never materialized in HBM. QK^T and PV matmuls hit the MXU in the input
 dtype (bfloat16 end-to-end on TPU) with float32 accumulation
 (``preferred_element_type``), softmax statistics stay float32 on the VPU.
 
+Training uses the standard FlashAttention backward: the forward additionally
+saves per-row logsumexp stats ``L``; the backward recomputes probability
+tiles from (Q, K, L) block-by-block and accumulates
+
+    dV += Pᵀ·dO        dP = dO·Vᵀ        dS = P∘(dP − Δ)·scale
+    dQ += dS·K         dK += dSᵀ·Q        with Δ = rowsum(dO∘O)
+
+in two kernels (dQ over Q blocks; dK/dV over K blocks) — backward memory is
+O(S·D) like the forward, never O(S²).
+
 The reference framework has no attention at all (2016-era MLPs/CNNs,
 SURVEY §5); this kernel serves the BERT family and the long-context path —
 composing with ring attention (:mod:`distkeras_tpu.ops.attention`): ring
 hops move K/V shards between chips, this kernel computes each local block.
 
-Training: exposed through ``jax.custom_vjp``. The backward pass recomputes
-attention with the dense jnp path under ``jax.vjp`` (flash-style fused
-backward is future work) — forward memory stays O(S·D), backward costs the
-dense O(S²) scores transiently.
-
-Tests run the same kernel with ``interpret=True`` on CPU.
+Tests run the same kernels with ``interpret=True`` on CPU.
 """
 
 from __future__ import annotations
@@ -32,12 +37,17 @@ __all__ = ["flash_attention"]
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
-                  causal: bool, q_block: int, seq_len: int):
+def _causal_mask(q_start, k_start, block_q, block_k):
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return rows >= cols
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
+                scale: float, causal: bool, q_block: int, seq_len: int):
     q = q_ref[0]  # [block_q, D]
     num_k_blocks = seq_len // block_k
-    block_q = q.shape[0]
-    d = q.shape[1]
+    block_q, d = q.shape
     q_start = pl.program_id(1) * q_block
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
@@ -46,20 +56,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
 
     def body(i, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(i * block_k, block_k), :]  # [block_k, D]
+        k = k_ref[0, pl.ds(i * block_k, block_k), :]
         v = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = jnp.where(_causal_mask(q_start, i * block_k, block_q, block_k),
+                          s, _NEG_INF)
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, blk_max)
         p = jnp.exp(s - m_new)
@@ -69,28 +73,102 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        acc_new = acc * corr + pv
-        return m_new, l_new, acc_new
+        return m_new, l_new, acc * corr + pv
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # logsumexp per row: backward regenerates P = exp(S*scale - L)
+    l_ref[0, :, 0] = m[:, 0] + jnp.log(l_safe[:, 0])
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, scale: float, causal: bool, q_block: int,
+               seq_len: int):
+    q = q_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [block_q, 1]
+    delta = delta_ref[0]  # [block_q, 1]
+    block_q, d = q.shape
+    q_start = pl.program_id(1) * q_block
+    num_k_blocks = seq_len // block_k
+
+    def body(i, dq):
+        k = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = jnp.where(_causal_mask(q_start, i * block_k, block_q, block_k),
+                          s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, block_q: int, scale: float, causal: bool,
+                k_block: int, seq_len: int):
+    k = k_ref[0]  # [block_k, D]
+    v = v_ref[0]
+    block_k, d = k.shape
+    k_start = pl.program_id(1) * k_block
+    num_q_blocks = seq_len // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = jnp.where(_causal_mask(i * block_q, k_start, block_q, block_k),
+                          s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    zero = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, num_q_blocks, body, (zero, zero))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
-    """q/k/v: [BH, S, D] -> [BH, S, D]."""
+    """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, S, 1])."""
     bh, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(f"seq_len {s} must divide block sizes ({block_q},{block_k})")
     scale = d**-0.5
     kernel = functools.partial(
-        _flash_kernel,
-        block_k=block_k,
-        scale=scale,
-        causal=causal,
-        q_block=block_q,
-        seq_len=s,
+        _fwd_kernel, block_k=block_k, scale=scale, causal=causal,
+        q_block=block_q, seq_len=s,
     )
     grid = (bh, s // block_q)
     return pl.pallas_call(
@@ -101,40 +179,79 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ),
         interpret=interpret,
     )(q, k, v)
 
 
-def _dense_reference(q, k, v, causal):
-    scale = q.shape[-1] ** -0.5
-    s = jax.lax.dot_general(
-        q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
-    ) * scale  # [BH, Sq, Sk]
-    if causal:
-        S_q, S_k = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((S_q, S_k), bool))
-        s = jnp.where(mask, s, _NEG_INF)
-    w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jax.lax.dot_general(
-        w, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
-    ).astype(q.dtype)
+def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    scale = d**-0.5
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [BH, S, 1]
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
+                          causal=causal, q_block=block_q, seq_len=s),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
+                          causal=causal, k_block=block_k, seq_len=s),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ),
+        interpret=interpret,
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -158,6 +275,12 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(
+            f"seq_len {S} must divide block sizes ({block_q},{block_k})"
+        )
     fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(B * H, S, D)
     unfold = lambda x: jnp.moveaxis(x.reshape(B, H, S, D), 1, 2)
     out = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k, interpret)

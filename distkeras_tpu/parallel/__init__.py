@@ -13,6 +13,24 @@ from distkeras_tpu.parallel.protocols import (
 )
 from distkeras_tpu.parallel.ps import InProcessClient, ParameterServerService
 
+
+def __getattr__(name):
+    # Heavier submodules resolved lazily.
+    import importlib
+
+    lazy = {
+        "gspmd": "distkeras_tpu.parallel.gspmd",
+        "pipeline": "distkeras_tpu.parallel.pipeline",
+        "ha": "distkeras_tpu.parallel.ha",
+        "distributed": "distkeras_tpu.parallel.distributed",
+        "ps_grpc": "distkeras_tpu.parallel.ps_grpc",
+        "sharding": "distkeras_tpu.parallel.sharding",
+    }
+    if name in lazy:
+        return importlib.import_module(lazy[name])
+    raise AttributeError(name)
+
+
 __all__ = [
     "make_mesh",
     "best_mesh",

@@ -54,3 +54,33 @@ def test_flash_rejects_ragged_seq(rng):
     q, k, v = _qkv(rng, S=100)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, block_q=64, block_k=64)
+
+
+def test_flash_causal_gradients_match_dense(rng):
+    q, k, v = _qkv(rng, B=1, S=32, H=2, D=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16, block_k=16) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3)
+
+
+def test_flash_gradients_multihead_rect_blocks(rng):
+    q, k, v = _qkv(rng, B=2, S=64, H=2, D=16)
+
+    def loss_flash(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, block_q=32, block_k=16) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.mean(dot_product_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-3)
