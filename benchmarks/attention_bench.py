@@ -1,0 +1,61 @@
+"""Flash vs dense attention: wall time and peak-memory proxy at long S.
+
+On TPU the flash kernel avoids the S×S HBM intermediate and keeps the MXU
+fed from VMEM tiles; on CPU this script still runs (interpret mode) but the
+numbers are not meaningful — run on the chip. Prints one JSON line.
+
+  BENCH_SEQ=4096 python benchmarks/attention_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.ops.attention import dot_product_attention
+    from distkeras_tpu.ops.pallas.flash_attention import flash_attention
+
+    S = int(os.environ.get("BENCH_SEQ", "4096"))
+    B, H, D = 4, 8, 64
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, S, H, D)), dtype) for _ in range(3)
+    )
+
+    def bench(fn, steps=10):
+        out = fn(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / steps
+
+    dense = jax.jit(lambda q, k, v: dot_product_attention(q, k, v))
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+
+    t_dense = bench(dense)
+    t_flash = bench(flash)
+    scores_bytes = B * H * S * S * (2 if dtype == jnp.bfloat16 else 4)
+    print(json.dumps({
+        "metric": "flash_vs_dense_attention",
+        "seq_len": S,
+        "dense_ms": round(t_dense * 1e3, 2),
+        "flash_ms": round(t_flash * 1e3, 2),
+        "speedup": round(t_dense / t_flash, 2),
+        "dense_scores_bytes": scores_bytes,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -71,9 +71,11 @@ class CheckpointManager:
         items: dict[str, Any] = {}
         for key in ("state", "ps"):
             if key in saved:
+                # The template passes through as-is: jax.Arrays carry their
+                # shardings, so a GSPMD state restores distributed.
                 template = (like or {}).get(key)
                 items[key] = (
-                    ocp.args.StandardRestore(jax.device_get(template))
+                    ocp.args.StandardRestore(template)
                     if template is not None
                     else ocp.args.StandardRestore()
                 )

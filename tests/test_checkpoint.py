@@ -64,3 +64,29 @@ def test_max_to_keep(tmp_path):
     assert mgr.latest_step() == 3
     assert len(mgr.all_steps()) <= 2
     mgr.close()
+
+
+def test_restore_into_sharded_template(tmp_path):
+    """A checkpoint restores directly into a GSPMD-sharded TrainState: the
+    template's shardings are honored, so params come back distributed."""
+    import jax
+    from distkeras_tpu.models.bert import bert_tiny_mlm
+    from distkeras_tpu.parallel.gspmd import sharded_train_state
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    model = bert_tiny_mlm(seq_len=8, vocab_size=64)
+    opt = get_optimizer("adam", 1e-3)
+    state, _ = sharded_train_state(model, opt, mesh, rng=0)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(0, state=state)
+    restored = mgr.restore(0, like={"state": state})["state"]
+    k = restored.params["layer_0"]["mlp_in"]["kernel"]
+    # sharding preserved: mlp dim split over tp=4
+    assert {s.data.shape for s in k.addressable_shards} == {(128, 128)}
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(k)),
+        np.asarray(jax.device_get(state.params["layer_0"]["mlp_in"]["kernel"])),
+    )
+    mgr.close()
