@@ -46,6 +46,8 @@ class BertConfig:
     # > 0 replaces each dense MLP block with a top-1 MoE of this many
     # experts (ops/moe.py; expert weights shard over the ep mesh axis).
     moe_experts: int = 0
+    # Causal (decoder/GPT-style) attention masking.
+    causal: bool = False
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -77,11 +79,13 @@ class SelfAttention(nn.Module):
             from distkeras_tpu.ops.pallas.flash_attention import flash_attention
 
             out = flash_attention(
-                q.reshape(shape), k.reshape(shape), v.reshape(shape)
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                causal=cfg.causal,
             )
         else:
             out = dot_product_attention(
-                q.reshape(shape), k.reshape(shape), v.reshape(shape), mask=mask
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                mask=mask, causal=cfg.causal,
             )
         out = out.reshape(B, S, cfg.hidden_size)
         return _dense(cfg.hidden_size, ("heads", "embed"), "out", cfg.dtype)(out)
@@ -220,6 +224,25 @@ def bert_tiny_mlm(seq_len: int = 64, vocab_size: int = 1024) -> Model:
         mlp_dim=512, max_seq_len=max(seq_len, 64),
     )
     return _make(cfg, seq_len, "bert_tiny_mlm")
+
+
+def gpt_tiny(seq_len: int = 64, vocab_size: int = 1024) -> Model:
+    """Decoder-only causal LM (GPT-style): same encoder stack with causal
+    masking and the tied LM head — next-token training via shifted labels."""
+    cfg = BertConfig(
+        vocab_size=vocab_size, hidden_size=128, num_layers=2, num_heads=4,
+        mlp_dim=512, max_seq_len=max(seq_len, 64), causal=True,
+    )
+    return _make(cfg, seq_len, "gpt_tiny")
+
+
+def gpt_small(seq_len: int = 512, vocab_size: int = 50257) -> Model:
+    """GPT-2-small-shaped causal LM (124M params)."""
+    cfg = BertConfig(
+        vocab_size=vocab_size, hidden_size=768, num_layers=12, num_heads=12,
+        mlp_dim=3072, max_seq_len=max(seq_len, 512), causal=True,
+    )
+    return _make(cfg, seq_len, "gpt_small")
 
 
 def bert_tiny_moe_mlm(

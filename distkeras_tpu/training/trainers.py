@@ -144,6 +144,34 @@ class Trainer:
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         raise NotImplementedError
 
+    def evaluate(
+        self,
+        trained: TrainedModel,
+        dataset: Dataset,
+        batch_size: int = 1024,
+        features_col: str | None = None,
+        label_col: str | None = None,
+    ) -> dict:
+        """Mean eval metrics (loss + accuracy) over a dataset — the inline
+        counterpart of the ModelPredictor -> evaluator pipeline."""
+        from distkeras_tpu.training.step import make_eval_step
+
+        eval_step = make_eval_step(self.model, self.loss)
+        fcol = features_col or getattr(self, "features_col", "features")
+        lcol = label_col or getattr(self, "label_col", "label")
+        totals: dict[str, float] = {}
+        count = 0
+        for batch in minibatches(
+            dataset, min(batch_size, dataset.num_rows), fcol, lcol,
+            drop_remainder=False,
+        ):
+            m = eval_step(trained.variables, batch)
+            n = batch["features"].shape[0]
+            for k2, v2 in m.items():
+                totals[k2] = totals.get(k2, 0.0) + float(v2) * n
+            count += n
+        return {k2: v2 / max(1, count) for k2, v2 in totals.items()}
+
 
 class SingleTrainer(Trainer):
     """Single-device trainer (reference § ``SingleTrainer``: coalesce to one
