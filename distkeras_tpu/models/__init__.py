@@ -21,7 +21,14 @@ def __getattr__(name):
         from distkeras_tpu.models import resnet
 
         return getattr(resnet, name)
-    if name in ("Bert", "bert_base_mlm", "bert_tiny_mlm"):
+    if name in (
+        "Bert",
+        "bert_base_mlm",
+        "bert_tiny_mlm",
+        "bert_tiny_moe_mlm",
+        "gpt_tiny",
+        "gpt_small",
+    ):
         from distkeras_tpu.models import bert
 
         return getattr(bert, name)
