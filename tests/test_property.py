@@ -89,7 +89,7 @@ def test_dynsgd_center_bounded_and_counter_exact(commits):
         )
         bound += abs(d)
     assert n == len(commits)
-    assert abs(center["w"][0]) <= bound + 1e-9
+    assert abs(center["w"][0]) <= bound * (1 + 1e-5) + 1e-6  # f32 accumulation slack
 
 
 @settings(max_examples=20, deadline=None)
