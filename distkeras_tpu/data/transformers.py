@@ -22,6 +22,7 @@ from distkeras_tpu.data.dataset import Dataset
 
 __all__ = [
     "Transformer",
+    "TransformerPipeline",
     "OneHotTransformer",
     "MinMaxTransformer",
     "ReshapeTransformer",
@@ -41,6 +42,20 @@ class Transformer:
 
     def __call__(self, dataset: Dataset) -> Dataset:
         return self.transform(dataset)
+
+
+class TransformerPipeline(Transformer):
+    """Chain transformers: ``TransformerPipeline([a, b]).transform(ds)`` ==
+    ``b.transform(a.transform(ds))`` (the manual chaining of the reference
+    notebooks, packaged)."""
+
+    def __init__(self, stages: list[Transformer]):
+        self.stages = list(stages)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        for stage in self.stages:
+            dataset = stage.transform(dataset)
+        return dataset
 
 
 class OneHotTransformer(Transformer):
@@ -89,6 +104,7 @@ class MinMaxTransformer(Transformer):
         max: float | None = None,  # noqa: A002 - reference kwarg name
         input_col: str = "features",
         output_col: str = "features_normalized",
+        per_feature: bool = False,
     ):
         self.new_min = float(new_min)
         self.new_max = float(new_max)
@@ -96,12 +112,22 @@ class MinMaxTransformer(Transformer):
         self.data_max = max
         self.input_col = input_col
         self.output_col = output_col
+        # Fitted mode only: normalize each trailing-dim feature by its own
+        # min/max (tabular columns on very different scales) instead of the
+        # global range.
+        self.per_feature = bool(per_feature)
 
     def transform(self, dataset: Dataset) -> Dataset:
         x = np.asarray(dataset[self.input_col], dtype=np.float32)
-        lo = float(x.min()) if self.data_min is None else float(self.data_min)
-        hi = float(x.max()) if self.data_max is None else float(self.data_max)
-        span = hi - lo if hi != lo else 1.0
+        if self.per_feature and self.data_min is None and self.data_max is None:
+            axes = tuple(range(x.ndim - 1))
+            lo = x.min(axis=axes, keepdims=True)
+            hi = x.max(axis=axes, keepdims=True)
+            span = np.where(hi != lo, hi - lo, 1.0)
+        else:
+            lo = float(x.min()) if self.data_min is None else float(self.data_min)
+            hi = float(x.max()) if self.data_max is None else float(self.data_max)
+            span = hi - lo if hi != lo else 1.0
         scaled = self.new_min + (x - lo) * (self.new_max - self.new_min) / span
         return dataset.with_column(self.output_col, scaled.astype(np.float32))
 

@@ -63,3 +63,27 @@ def test_label_index_scalar_threshold():
     ds = Dataset.from_arrays(prediction=np.array([0.3, 0.8]))
     out = LabelIndexTransformer().transform(ds)
     assert np.array_equal(out["prediction_index"], [0.0, 1.0])
+
+
+def test_min_max_per_feature():
+    ds = Dataset.from_arrays(
+        features=np.array([[0.0, 100.0], [5.0, 300.0], [10.0, 200.0]])
+    )
+    out = MinMaxTransformer(per_feature=True).transform(ds)
+    f = out["features_normalized"]
+    np.testing.assert_allclose(f[:, 0], [0.0, 0.5, 1.0])
+    np.testing.assert_allclose(f[:, 1], [0.0, 1.0, 0.5])
+
+
+def test_transformer_pipeline():
+    from distkeras_tpu.data.transformers import TransformerPipeline
+
+    ds = Dataset.from_arrays(
+        features=np.array([[0.0], [255.0]]), label=np.array([0, 1])
+    )
+    pipe = TransformerPipeline([
+        MinMaxTransformer(min=0.0, max=255.0),
+        OneHotTransformer(2),
+    ])
+    out = pipe.transform(ds)
+    assert "features_normalized" in out and "label_encoded" in out
