@@ -84,9 +84,22 @@ class Trainer:
         metrics: tuple[str, ...] = ("accuracy",),
         learning_rate: float | None = None,
         seed: int = 0,
+        loss_weights=None,
         metric_stream=None,
     ):
         self.model = _as_model(keras_model)
+        # Reference API parity (`Trainer.__init__(..., loss_weights=None)`).
+        # Single-output models: a scalar scales the loss; None is a no-op.
+        self.loss_weights = loss_weights
+        if loss_weights is not None:
+            base = loss
+
+            def _weighted(preds, targets, _base=base, _w=float(loss_weights)):
+                from distkeras_tpu.ops.losses import get_loss
+
+                return get_loss(_base)(preds, targets) * _w
+
+            loss = _weighted
         self.loss = loss
         self.worker_optimizer = worker_optimizer
         self.metrics = tuple(metrics)
@@ -191,10 +204,12 @@ class SingleTrainer(Trainer):
         seed: int = 0,
         grad_accum_steps: int = 1,
         remat: bool = False,
+        loss_weights=None,
         metric_stream=None,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
-                         learning_rate, seed, metric_stream)
+                         learning_rate=learning_rate, seed=seed,
+                         loss_weights=loss_weights, metric_stream=metric_stream)
         self.features_col = features_col
         self.label_col = label_col
         self.batch_size = int(batch_size)
@@ -252,10 +267,12 @@ class _VmappedReplicasTrainer(Trainer):
         num_epoch: int = 1,
         learning_rate: float | None = None,
         seed: int = 0,
+        loss_weights=None,
         metric_stream=None,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
-                         learning_rate, seed, metric_stream)
+                         learning_rate=learning_rate, seed=seed,
+                         loss_weights=loss_weights, metric_stream=metric_stream)
         self.num_models = int(num_models)
         self.features_col = features_col
         self.label_col = label_col
@@ -385,10 +402,12 @@ class SynchronousDistributedTrainer(Trainer):
         learning_rate: float | None = None,
         seed: int = 0,
         mesh=None,
+        loss_weights=None,
         metric_stream=None,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
-                         learning_rate, seed, metric_stream)
+                         learning_rate=learning_rate, seed=seed,
+                         loss_weights=loss_weights, metric_stream=metric_stream)
         self.num_workers = num_workers
         self.batch_size = int(batch_size)
         self.features_col = features_col
@@ -490,11 +509,13 @@ class AsynchronousDistributedTrainer(Trainer):
         checkpoint_interval_s: float = 60.0,
         resume: bool = False,
         compress_deltas: bool = False,
+        loss_weights=None,
         metric_stream=None,
         **protocol_kwargs,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
-                         learning_rate, seed, metric_stream)
+                         learning_rate=learning_rate, seed=seed,
+                         loss_weights=loss_weights, metric_stream=metric_stream)
         self.num_workers = int(num_workers)
         # devices_per_worker > 1 turns each worker into an *island*: a sync
         # data-parallel sub-mesh (gradient all-reduce over ICI inside the

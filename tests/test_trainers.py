@@ -261,3 +261,15 @@ def test_single_trainer_accum_and_remat_flags(toy_classification):
     )
     trained = trainer.train(toy_classification)
     assert _accuracy(trained, toy_classification) > 0.85
+
+
+def test_loss_weights_scales_loss(toy_classification):
+    t1 = dk.SingleTrainer(_model(), worker_optimizer="sgd", learning_rate=0.0,
+                          batch_size=32, num_epoch=1)
+    t2 = dk.SingleTrainer(_model(), worker_optimizer="sgd", learning_rate=0.0,
+                          batch_size=32, num_epoch=1, loss_weights=2.0)
+    t1.train(toy_classification)
+    t2.train(toy_classification)
+    l1 = t1.get_history()[0]["loss"]
+    l2 = t2.get_history()[0]["loss"]
+    np.testing.assert_allclose(l2, 2 * l1, rtol=1e-5)
