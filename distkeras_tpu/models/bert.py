@@ -22,7 +22,6 @@ import dataclasses
 
 import flax.linen as nn
 import jax.numpy as jnp
-import numpy as np
 
 from distkeras_tpu.models.core import Model
 from distkeras_tpu.ops.attention import dot_product_attention
