@@ -650,15 +650,11 @@ class AsynchronousDistributedTrainer(Trainer):
                     island_mesh = make_mesh({"dp": dpw}, devices=island_devices)
                     batch_sh, repl_sh = data_parallel_shardings(island_mesh)
                     put_state = lambda tree: jax.device_put(tree, repl_sh)
-                    put_batch = lambda b: {
-                        k: jax.device_put(v, batch_sh) for k, v in b.items()
-                    }
+                    batch_placement = batch_sh
                 else:
                     device = devices[widx % len(devices)]
                     put_state = lambda tree: jax.device_put(tree, device)
-                    put_batch = lambda b: {
-                        k: jax.device_put(v, device) for k, v in b.items()
-                    }
+                    batch_placement = device
                 from distkeras_tpu.parallel.ha import (
                     CompressingClient,
                     RetryingClient,
@@ -684,15 +680,19 @@ class AsynchronousDistributedTrainer(Trainer):
                 my_parts = partitions[widx :: self.num_workers]
                 i = 0
                 for part in my_parts:
-                    for batch in minibatches(
-                        part,
-                        self.batch_size * dpw,
-                        self.features_col,
-                        self.label_col,
-                        num_epoch=self.num_epoch,
-                        seed=worker_seed(self.seed, widx) if shuffle else None,
-                    ):
-                        batch = put_batch(batch)
+                    feed = DeviceFeed(
+                        minibatches(
+                            part,
+                            self.batch_size * dpw,
+                            self.features_col,
+                            self.label_col,
+                            num_epoch=self.num_epoch,
+                            seed=worker_seed(self.seed, widx) if shuffle else None,
+                        ),
+                        sharding=batch_placement,
+                        buffer_size=2,
+                    )
+                    for batch in feed:
                         state, m = step_fn(state, batch)
                         histories[widx].append(m)
                         i += 1
