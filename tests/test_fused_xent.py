@@ -66,3 +66,17 @@ def test_registered_loss_trains(rng):
     trainer.train(ds)
     hist = trainer.get_history()
     assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_bf16_logits(rng):
+    import ml_dtypes
+
+    logits, labels = _data(rng, T=32, V=256)
+    got = float(fused_softmax_xent(logits.astype(ml_dtypes.bfloat16), labels,
+                                   block_t=8, block_v=64))
+    ref = float(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(ml_dtypes.bfloat16).astype(np.float32), labels
+        ).mean()
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-3)

@@ -101,3 +101,16 @@ def test_adag_scaling_is_1_over_n(num_workers, mag):
         {"delta": {"w": np.full(1, mag)}}, num_workers,
     )
     np.testing.assert_allclose(center["w"][0], mag / num_workers)
+
+
+@settings(max_examples=50, deadline=None)
+@given(st.binary(min_size=0, max_size=64))
+def test_grpc_frame_decoder_rejects_garbage(blob):
+    """Arbitrary bytes must raise a clean error, never crash or hang."""
+    from distkeras_tpu.parallel.ps_grpc import _decode_commit, _decode_pull_reply
+
+    for decoder in (_decode_commit, _decode_pull_reply):
+        try:
+            decoder(blob)
+        except Exception as e:
+            assert not isinstance(e, (SystemExit, KeyboardInterrupt, MemoryError))
