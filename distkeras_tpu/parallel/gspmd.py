@@ -59,21 +59,23 @@ def shard_batch(mesh: Mesh, batch: dict, seq_dim: int | None = None) -> dict:
     }
 
 
-def fsdp_sharding_for(mesh: Mesh, shape: tuple[int, ...], dtype=None) -> NamedSharding:
-    """FSDP heuristic for an un-annotated parameter: shard the largest
-    dimension divisible by the ``fsdp`` axis size; replicate otherwise.
-    Small tensors (< 2^14 elements) stay replicated — the all-gather would
-    cost more than the memory saved."""
-    if "fsdp" not in mesh.axis_names:
+def fsdp_sharding_for(
+    mesh: Mesh, shape: tuple[int, ...], dtype=None, axis: str = "fsdp"
+) -> NamedSharding:
+    """Largest-divisible-dimension sharding heuristic for an un-annotated
+    tensor over ``axis``; replicate otherwise. Small tensors (< 2^14
+    elements) stay replicated — the all-gather would cost more than the
+    memory saved."""
+    if axis not in mesh.axis_names:
         return NamedSharding(mesh, P())
-    n = mesh.shape["fsdp"]
+    n = mesh.shape[axis]
     if int(np.prod(shape or (1,))) < (1 << 14):
         return NamedSharding(mesh, P())
     dims = sorted(range(len(shape)), key=lambda i: -shape[i])
     for d in dims:
         if shape[d] % n == 0:
             spec = [None] * len(shape)
-            spec[d] = "fsdp"
+            spec[d] = axis
             return NamedSharding(mesh, P(*spec))
     return NamedSharding(mesh, P())
 
@@ -83,6 +85,7 @@ def sharded_train_state(
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     rng: int | jax.Array = 0,
+    zero1: bool = False,
 ):
     """Initialize a TrainState with every parameter placed per its logical
     axes — parameters materialize directly in their distributed layout
@@ -123,9 +126,24 @@ def sharded_train_state(
     params = variables["params"]
     model_state = {k: v for k, v in variables.items() if k != "params"}
     param_shardings = var_shardings["params"]
-    opt_state = jax.jit(
-        optimizer.init, in_shardings=(param_shardings,), out_shardings=None
-    )(params)
+    if zero1 and "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+        # ZeRO-1 / cross-replica weight-update sharding (PAPERS.md:
+        # arXiv:2004.13336): optimizer moments shard over the data axis even
+        # when params are replicated — XLA reduce-scatters gradients into
+        # the moment shards and all-gathers the updates.
+        abstract_opt = jax.eval_shape(optimizer.init, params)
+        opt_shardings = jax.tree.map(
+            lambda a: fsdp_sharding_for(mesh, a.shape, axis="dp"), abstract_opt
+        )
+        opt_state = jax.jit(
+            optimizer.init,
+            in_shardings=(param_shardings,),
+            out_shardings=opt_shardings,
+        )(params)
+    else:
+        opt_state = jax.jit(
+            optimizer.init, in_shardings=(param_shardings,), out_shardings=None
+        )(params)
     state = TrainState(
         params=params,
         model_state=model_state,

@@ -182,3 +182,44 @@ def test_fsdp_params_sharded_and_loss_matches():
     plain = make_train_step(model, opt, "categorical_crossentropy", metrics=(), donate=False)
     _, m_plain = plain(s1, {"features": feats, "label": labels})
     np.testing.assert_allclose(float(m_fsdp["loss"]), float(m_plain["loss"]), rtol=2e-5)
+
+
+def test_zero1_optimizer_state_sharded():
+    """ZeRO-1: adam moments shard over dp while params stay replicated;
+    the step still computes the same loss."""
+    import jax.numpy as jnp
+    from distkeras_tpu.models.core import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.ops.losses import get_optimizer
+    from distkeras_tpu.parallel.gspmd import (
+        make_sharded_train_step,
+        shard_batch,
+        sharded_train_state,
+    )
+
+    model = Model.from_flax(
+        MLP(features=(256,), num_classes=4, compute_dtype=jnp.float32),
+        input_shape=(64,),
+    )
+    opt = get_optimizer("adam", 1e-3)
+    mesh = make_mesh({"dp": 8})
+    state, _ = sharded_train_state(model, opt, mesh, rng=0, zero1=True)
+    # params replicated
+    k = state.params["Dense_0"]["kernel"]
+    assert {s.data.shape for s in k.addressable_shards} == {(64, 256)}
+    # adam mu for that kernel sharded over dp=8
+    mu_kernel = state.opt_state[0].mu["Dense_0"]["kernel"]
+    assert {s.data.shape for s in mu_kernel.addressable_shards} == {(64, 32)}
+
+    step = make_sharded_train_step(model, opt, "categorical_crossentropy", mesh,
+                                   donate=False)
+    rng = np.random.default_rng(0)
+    batch = shard_batch(mesh, {
+        "features": rng.normal(size=(16, 64)).astype(np.float32),
+        "label": rng.integers(0, 4, size=16).astype(np.float32),
+    })
+    s2, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    # moments keep their dp sharding through the step
+    mu2 = s2.opt_state[0].mu["Dense_0"]["kernel"]
+    assert {s.data.shape for s in mu2.addressable_shards} == {(64, 32)}
