@@ -402,6 +402,7 @@ class SynchronousDistributedTrainer(Trainer):
         learning_rate: float | None = None,
         seed: int = 0,
         mesh=None,
+        zero1: bool = False,
         loss_weights=None,
         metric_stream=None,
     ):
@@ -414,6 +415,7 @@ class SynchronousDistributedTrainer(Trainer):
         self.label_col = label_col
         self.num_epoch = int(num_epoch)
         self.mesh = mesh
+        self.zero1 = bool(zero1)
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
@@ -432,8 +434,9 @@ class SynchronousDistributedTrainer(Trainer):
             a in mesh.axis_names and mesh.shape[a] > 1
             for a in ("tp", "sp", "fsdp", "ep")
         )
-        if model_axes and (
-            hasattr(self.model, "boxed_init") or "fsdp" in mesh.axis_names
+        if self.zero1 or (
+            model_axes
+            and (hasattr(self.model, "boxed_init") or "fsdp" in mesh.axis_names)
         ):
             # GSPMD data+model sharding (logical-axis-annotated model).
             from distkeras_tpu.parallel.gspmd import (
@@ -442,7 +445,9 @@ class SynchronousDistributedTrainer(Trainer):
                 sharded_train_state,
             )
 
-            state, _ = sharded_train_state(self.model, optimizer, mesh, rng=self.seed)
+            state, _ = sharded_train_state(
+                self.model, optimizer, mesh, rng=self.seed, zero1=self.zero1
+            )
             step_fn = make_sharded_train_step(
                 self.model, optimizer, self.loss, mesh, metrics=self.metrics
             )

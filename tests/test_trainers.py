@@ -273,3 +273,12 @@ def test_loss_weights_scales_loss(toy_classification):
     l1 = t1.get_history()[0]["loss"]
     l2 = t2.get_history()[0]["loss"]
     np.testing.assert_allclose(l2, 2 * l1, rtol=1e-5)
+
+
+def test_sync_trainer_zero1(toy_classification):
+    trainer = dk.SynchronousDistributedTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        batch_size=8, num_epoch=6, zero1=True,
+    )
+    trained = trainer.train(toy_classification)
+    assert _accuracy(trained, toy_classification) > 0.85
