@@ -67,13 +67,17 @@ class DeviceFeed:
         batches: Iterator[Batch],
         sharding: jax.sharding.Sharding | None = None,
         buffer_size: int = 2,
+        put_fn=None,
     ):
         self._batches = batches
         self._sharding = sharding
+        self._put_fn = put_fn  # custom placement (e.g. rank-matched GSPMD)
         self._buffer: collections.deque = collections.deque()
         self._buffer_size = max(1, buffer_size)
 
     def _put(self, batch: Batch):
+        if self._put_fn is not None:
+            return self._put_fn(batch)
         if self._sharding is not None:
             return {
                 k: jax.device_put(v, self._sharding) for k, v in batch.items()

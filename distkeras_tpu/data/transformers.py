@@ -25,6 +25,7 @@ __all__ = [
     "TransformerPipeline",
     "OneHotTransformer",
     "MinMaxTransformer",
+    "StandardScaleTransformer",
     "ReshapeTransformer",
     "DenseTransformer",
     "LabelIndexTransformer",
@@ -130,6 +131,29 @@ class MinMaxTransformer(Transformer):
             span = hi - lo if hi != lo else 1.0
         scaled = self.new_min + (x - lo) * (self.new_max - self.new_min) / span
         return dataset.with_column(self.output_col, scaled.astype(np.float32))
+
+
+class StandardScaleTransformer(Transformer):
+    """Z-score normalization per trailing-dim feature: ``(x - mean) / std``
+    (beyond-reference; the usual companion to MinMax for tabular data)."""
+
+    def __init__(
+        self,
+        input_col: str = "features",
+        output_col: str = "features_standardized",
+        epsilon: float = 1e-8,
+    ):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.epsilon = float(epsilon)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        x = np.asarray(dataset[self.input_col], dtype=np.float32)
+        axes = tuple(range(x.ndim - 1))
+        mu = x.mean(axis=axes, keepdims=True)
+        sd = x.std(axis=axes, keepdims=True)
+        out = (x - mu) / (sd + self.epsilon)
+        return dataset.with_column(self.output_col, out.astype(np.float32))
 
 
 class ReshapeTransformer(Transformer):

@@ -59,6 +59,14 @@ class Model:
             rng = jax.random.PRNGKey(rng)
         return self._init_fn(rng)
 
+    def count_params(self) -> int:
+        """Trainable parameter count (from shapes only — no allocation)."""
+        abstract = jax.eval_shape(self._init_fn, jax.random.PRNGKey(0))
+        params = abstract.get("params", abstract)
+        return int(
+            sum(np.prod(leaf.shape, dtype=np.int64) for leaf in jax.tree.leaves(params))
+        )
+
     # -- flax integration ----------------------------------------------------
 
     @classmethod

@@ -462,15 +462,20 @@ class SynchronousDistributedTrainer(Trainer):
             }
 
         self.history = []
-        for batch in minibatches(
-            dataset,
-            global_batch,
-            self.features_col,
-            self.label_col,
-            num_epoch=self.num_epoch,
-            seed=self.seed if shuffle else None,
-        ):
-            state, m = step_fn(state, shard_fn(batch))
+        feed = DeviceFeed(
+            minibatches(
+                dataset,
+                global_batch,
+                self.features_col,
+                self.label_col,
+                num_epoch=self.num_epoch,
+                seed=self.seed if shuffle else None,
+            ),
+            put_fn=shard_fn,
+            buffer_size=2,
+        )
+        for batch in feed:
+            state, m = step_fn(state, batch)
             self.history.append(m)
         self.history = [{k: float(v) for k, v in h.items()} for h in self.history]
         self._emit_history()

@@ -1,0 +1,27 @@
+"""Model zoo sanity tests."""
+
+import numpy as np
+
+
+def test_count_params_bert_base():
+    from distkeras_tpu.models.bert import bert_base_mlm
+
+    n = bert_base_mlm(seq_len=16).count_params()
+    assert 105e6 < n < 115e6, n  # BERT-base ~109M
+
+
+def test_count_params_mlp():
+    from distkeras_tpu.models import mnist_mlp
+
+    n = mnist_mlp().count_params()
+    expected = 785 * 500 + 501 * 300 + 301 * 10
+    assert n == expected, (n, expected)
+
+
+def test_resnet50_flops_and_shapes():
+    from distkeras_tpu.models.resnet import resnet50
+
+    m = resnet50(image_size=224)
+    assert m.flops_per_example > 8e9  # ~8.2 GFLOPs forward
+    n = m.count_params()
+    assert 24e6 < n < 27e6, n  # ResNet-50 ~25.6M

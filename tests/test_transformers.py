@@ -87,3 +87,14 @@ def test_transformer_pipeline():
     ])
     out = pipe.transform(ds)
     assert "features_normalized" in out and "label_encoded" in out
+
+
+def test_standard_scale():
+    from distkeras_tpu.data.transformers import StandardScaleTransformer
+
+    rng = np.random.default_rng(0)
+    ds = Dataset.from_arrays(features=(rng.normal(size=(200, 3)) * [1, 10, 100]).astype(np.float32))
+    out = StandardScaleTransformer().transform(ds)
+    f = out["features_standardized"]
+    np.testing.assert_allclose(f.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(f.std(0), 1.0, atol=1e-3)
