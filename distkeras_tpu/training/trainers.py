@@ -403,6 +403,7 @@ class SynchronousDistributedTrainer(Trainer):
         seed: int = 0,
         mesh=None,
         zero1: bool = False,
+        shard_sequence: bool = False,
         loss_weights=None,
         metric_stream=None,
     ):
@@ -416,6 +417,10 @@ class SynchronousDistributedTrainer(Trainer):
         self.num_epoch = int(num_epoch)
         self.mesh = mesh
         self.zero1 = bool(zero1)
+        # Shard the sequence dimension of [B, S] batches over the mesh's sp
+        # axis (XLA inserts the activation collectives; ring attention is the
+        # shard_map alternative for attention itself).
+        self.shard_sequence = bool(shard_sequence)
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
@@ -451,7 +456,8 @@ class SynchronousDistributedTrainer(Trainer):
             step_fn = make_sharded_train_step(
                 self.model, optimizer, self.loss, mesh, metrics=self.metrics
             )
-            shard_fn = lambda b: shard_batch(mesh, b)
+            seq_dim = 1 if self.shard_sequence else None
+            shard_fn = lambda b: shard_batch(mesh, b, seq_dim=seq_dim)
         else:
             batch_sharding, replicated = data_parallel_shardings(mesh)
             step_fn = make_train_step(self.model, optimizer, self.loss, self.metrics)

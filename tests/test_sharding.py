@@ -223,3 +223,25 @@ def test_zero1_optimizer_state_sharded():
     # moments keep their dp sharding through the step
     mu2 = s2.opt_state[0].mu["Dense_0"]["kernel"]
     assert {s.data.shape for s in mu2.addressable_shards} == {(64, 32)}
+
+
+def test_sync_trainer_sequence_sharded_bert():
+    """BERT-tiny with the sequence dimension sharded over sp (XLA-SP)."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.bert import bert_tiny_mlm
+
+    rng = np.random.default_rng(0)
+    vocab, seq = 64, 16
+    feats = rng.integers(0, vocab, size=(128, seq)).astype(np.int32)
+    from distkeras_tpu.data.dataset import Dataset as DS
+
+    ds = DS.from_arrays(features=feats, label=feats)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    trainer = dk.SynchronousDistributedTrainer(
+        bert_tiny_mlm(seq_len=seq, vocab_size=vocab),
+        worker_optimizer="adam", learning_rate=1e-3,
+        batch_size=8, num_epoch=2, mesh=mesh, shard_sequence=True,
+    )
+    trainer.train(ds)
+    hist = trainer.get_history()
+    assert hist[-1]["loss"] < hist[0]["loss"]
