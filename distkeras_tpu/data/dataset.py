@@ -192,6 +192,24 @@ class Dataset:
         for i in range(self._num_rows):
             yield {k: v[i] for k, v in self._columns.items()}
 
+    def head(self, n: int = 5) -> "Dataset":
+        return self.take(min(n, self._num_rows))
+
+    def describe(self) -> dict[str, dict[str, float]]:
+        """Per-column summary stats for numeric columns (notebook aid)."""
+        out: dict[str, dict[str, float]] = {}
+        for name, col in self._columns.items():
+            if not np.issubdtype(col.dtype, np.number):
+                continue
+            c = col.astype(np.float64)
+            out[name] = {
+                "min": float(c.min()),
+                "max": float(c.max()),
+                "mean": float(c.mean()),
+                "std": float(c.std()),
+            }
+        return out
+
     def __repr__(self) -> str:
         spec = ", ".join(
             f"{k}: {v.dtype}{list(v.shape[1:])}" for k, v in self._columns.items()

@@ -68,3 +68,12 @@ def test_from_csv(tmp_path):
     ds = Dataset.from_csv(str(p), features=["x1", "x2"], label="y")
     assert ds["features"].shape == (3, 2)
     assert np.array_equal(ds["label"], [0, 1, 0])
+
+
+def test_head_and_describe():
+    ds = Dataset.from_arrays(a=np.arange(10, dtype=np.float32),
+                             s=np.array(["x"] * 10))
+    assert len(ds.head(3)) == 3
+    d = ds.describe()
+    assert "a" in d and "s" not in d
+    assert d["a"]["min"] == 0.0 and d["a"]["max"] == 9.0
