@@ -2,11 +2,11 @@
 
 The canonical dist-keras user flow: load data, preprocess with
 transformers, train with SingleTrainer and a distributed trainer,
-predict, evaluate. Uses the real MNIST if an IDX/npz file is available,
-otherwise a synthetic stand-in with the same shapes (this container has no
-network egress).
+predict, evaluate. Pass ``--npz path`` (arrays ``x`` [N,784] or [N,28,28],
+``y`` [N]) to use the real MNIST; otherwise a synthetic stand-in with the
+same shapes is generated (this container has no network egress).
 
-Run: python examples/mnist.py [--trainer adag] [--epochs 2]
+Run: python examples/mnist.py [--trainer adag] [--epochs 2] [--npz mnist.npz]
 """
 
 import argparse
@@ -18,8 +18,13 @@ import distkeras_tpu as dk
 from distkeras_tpu.models import mnist_mlp
 
 
-def load_mnist(n=8192, seed=0):
-    """Synthetic MNIST-shaped data: 10 gaussian digit prototypes."""
+def load_mnist(npz: str | None = None, n=8192, seed=0):
+    if npz:
+        with np.load(npz) as d:
+            x = d["x"].reshape(len(d["x"]), -1).astype(np.float32)
+            y = d["y"].astype(np.float32)
+        return dk.Dataset.from_arrays(features=x, label=y)
+    # Synthetic MNIST-shaped data: 10 gaussian digit prototypes.
     rng = np.random.default_rng(seed)
     protos = rng.uniform(0, 255, size=(10, 784))
     labels = rng.integers(0, 10, size=n)
@@ -36,9 +41,10 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--npz", default=None)
     args = ap.parse_args()
 
-    raw = load_mnist()
+    raw = load_mnist(args.npz)
     # Preprocessing pipeline (reference workflow.ipynb §3.5 shape):
     pipeline = [
         dk.MinMaxTransformer(new_min=0.0, new_max=1.0, min=0.0, max=255.0,
