@@ -120,10 +120,15 @@ class Dataset:
         return name in self._columns
 
     def column(self, name: str) -> np.ndarray:
-        return self._columns[name]
+        return self[name]
 
     def __getitem__(self, name: str) -> np.ndarray:
-        return self._columns[name]
+        try:
+            return self._columns[name]
+        except KeyError:
+            raise KeyError(
+                f"no column {name!r}; available: {sorted(self._columns)}"
+            ) from None
 
     # -- functional updates -------------------------------------------------
 

@@ -77,3 +77,9 @@ def test_head_and_describe():
     d = ds.describe()
     assert "a" in d and "s" not in d
     assert d["a"]["min"] == 0.0 and d["a"]["max"] == 9.0
+
+
+def test_missing_column_names_available():
+    ds = Dataset.from_arrays(features=np.zeros(3), label=np.zeros(3))
+    with pytest.raises(KeyError, match="available.*features"):
+        ds["featuers"]

@@ -55,3 +55,15 @@ def test_device_feed_sharded_placement():
     feed = DeviceFeed(minibatches(_ds(), 32), sharding=batch_sh)
     batch = next(iter(feed))
     assert {s.data.shape for s in batch["features"].addressable_shards} == {(4, 4)}
+
+
+def test_device_feed_put_fn():
+    calls = []
+
+    def put(batch):
+        calls.append(1)
+        return batch
+
+    feed = DeviceFeed(minibatches(_ds(), 16), put_fn=put)
+    out = list(feed)
+    assert len(out) == 4 and len(calls) == 4
