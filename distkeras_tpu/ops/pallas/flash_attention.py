@@ -257,6 +257,29 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_with_lse(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_with_lse_bwd(causal, block_q, block_k, interpret, residuals, g):
+    # lse is a statistic of the softmax; treat its cotangent as zero (ring
+    # merging consumes lse only through the merge weights, whose gradient
+    # flows via the merged output).
+    q, k, v, o, lse = residuals
+    g_out, _ = g
+    return _flash_backward(q, k, v, o, lse, g_out, causal, block_q, block_k,
+                           interpret)
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
 def flash_attention(
     q,
     k,
@@ -265,12 +288,15 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    return_lse: bool = False,
 ):
     """Flash attention over ``[B, S, H, D]`` inputs (same convention as
     :func:`distkeras_tpu.ops.attention.dot_product_attention`).
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
-    interpreter elsewhere (CPU tests).
+    interpreter elsewhere (CPU tests). ``return_lse=True`` additionally
+    returns the per-row logsumexp ``[B, S, H]`` — the statistic needed to
+    merge attention over disjoint K/V sets (ring composition).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -283,5 +309,11 @@ def flash_attention(
         )
     fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(B * H, S, D)
     unfold = lambda x: jnp.moveaxis(x.reshape(B, H, S, D), 1, 2)
+    if return_lse:
+        out, lse = _flash_with_lse(
+            fold(q), fold(k), fold(v), causal, block_q, block_k, interpret
+        )
+        lse = jnp.moveaxis(lse[..., 0].reshape(B, H, S), 1, 2)  # [B, S, H]
+        return unfold(out), lse
     out = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k, interpret)
     return unfold(out)

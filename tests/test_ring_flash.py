@@ -1,0 +1,61 @@
+"""Ring flash attention: flash kernels per hop + exact logsumexp merge.
+Forward and gradients verified against dense attention, causal and not."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.attention import dot_product_attention
+from distkeras_tpu.ops.ring_flash import ring_flash_attention
+from distkeras_tpu.parallel.mesh import make_mesh
+
+
+def _qkv(rng, B=2, S=64, H=2, D=8):
+    mk = lambda: np.asarray(rng.normal(size=(B, S, H, D)), np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(rng, causal):
+    q, k, v = _qkv(rng)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    out = ring_flash_attention(q, k, v, mesh, seq_axis="sp", causal=causal,
+                               block_q=8)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_gradients_match_dense(rng, causal):
+    q, k, v = _qkv(rng, B=1, S=32, H=1, D=8)
+    mesh = make_mesh({"sp": 8})
+
+    def loss_ring(q, k, v):
+        return jnp.mean(
+            ring_flash_attention(q, k, v, mesh, seq_axis="sp", causal=causal,
+                                 block_q=4) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.mean(dot_product_attention(q, k, v, causal=causal) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-3)
+
+
+def test_flash_return_lse_matches_manual(rng):
+    from distkeras_tpu.ops.pallas.flash_attention import flash_attention
+
+    q, k, v = _qkv(rng, B=1, S=32, H=1, D=8)
+    out, lse = flash_attention(q, k, v, block_q=16, block_k=16, return_lse=True)
+    # manual logsumexp of scores
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8)
+    ref_lse = np.log(np.exp(scores - scores.max(-1, keepdims=True)).sum(-1)) + scores.max(-1)
+    np.testing.assert_allclose(
+        np.asarray(lse)[0, :, 0], ref_lse[0, 0], atol=1e-4, rtol=1e-4
+    )
