@@ -47,6 +47,11 @@ class BertConfig:
     moe_experts: int = 0
     # Causal (decoder/GPT-style) attention masking.
     causal: bool = False
+    # Sequence-parallel attention: a jax.sharding.Mesh (hashable, so valid
+    # as static config) + axis name routes attention through
+    # ring_flash_attention — the sequence dimension never gathers.
+    ring_mesh: object = None
+    ring_axis: str = "sp"
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -74,7 +79,14 @@ class SelfAttention(nn.Module):
         v = _dense(cfg.hidden_size, qkv_axes, "value", cfg.dtype)(x)
         B, S = x.shape[0], x.shape[1]
         shape = (B, S, cfg.num_heads, head_dim)
-        if cfg.use_flash_attention and mask is None:
+        if cfg.ring_mesh is not None and mask is None:
+            from distkeras_tpu.ops.ring_flash import ring_flash_attention
+
+            out = ring_flash_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                cfg.ring_mesh, seq_axis=cfg.ring_axis, causal=cfg.causal,
+            )
+        elif cfg.use_flash_attention and mask is None:
             from distkeras_tpu.ops.pallas.flash_attention import flash_attention
 
             out = flash_attention(

@@ -249,7 +249,13 @@ def ring_flash_attention(
     s_local = S // p
     block_q = min(block_q, s_local)
 
-    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    # Shard the batch over dp only when divisible (model init traces with
+    # a dummy batch of 1; a replicated tiny batch is fine there).
+    batch_axis = (
+        "dp"
+        if "dp" in mesh.axis_names and B % mesh.shape["dp"] == 0
+        else None
+    )
     spec = P(batch_axis, seq_axis, None, None)
     ring = _make_ring(seq_axis, causal, block_q, interpret)
 

@@ -59,3 +59,42 @@ def test_flash_return_lse_matches_manual(rng):
     np.testing.assert_allclose(
         np.asarray(lse)[0, :, 0], ref_lse[0, 0], atol=1e-4, rtol=1e-4
     )
+
+
+def test_bert_with_ring_attention_trains(rng):
+    """BERT with ring-flash attention trains under the sync trainer on a
+    dp x sp mesh — end-to-end sequence-parallel long-context training."""
+    import dataclasses
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import bert as bert_mod
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    vocab, seq = 64, 32
+    cfg = bert_mod.BertConfig(
+        vocab_size=vocab, hidden_size=64, num_layers=2, num_heads=2,
+        mlp_dim=128, max_seq_len=seq, dropout_rate=0.0,
+        ring_mesh=mesh, ring_axis="sp",
+    )
+    model = bert_mod._make(cfg, seq, "bert_ring")
+
+    tokens = np.asarray(rng.integers(1, vocab, size=(128, seq)), np.int32)
+    ds = dk.Dataset.from_arrays(features=tokens, label=tokens)
+    trainer = dk.SynchronousDistributedTrainer(
+        model, worker_optimizer="adam", learning_rate=1e-3,
+        batch_size=8, num_epoch=2, mesh=mesh, shard_sequence=True,
+    )
+    trainer.train(ds)
+    hist = trainer.get_history()
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+    # correctness: ring model forward == plain model forward (same weights)
+    plain_cfg = dataclasses.replace(cfg, ring_mesh=None)
+    plain = bert_mod._make(plain_cfg, seq, "bert_plain")
+    variables = model.init(3)
+    x = tokens[:4]
+    o_ring, _ = model.apply(variables, x)
+    o_plain, _ = plain.apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(o_ring), np.asarray(o_plain), atol=3e-2, rtol=3e-2
+    )
