@@ -21,6 +21,8 @@ def main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--causal", action="store_true")
+    ap.add_argument("--flash", action="store_true",
+                    help="use ring_flash_attention (Pallas kernels per hop)")
     args = ap.parse_args()
 
     import os
@@ -49,7 +51,14 @@ def main():
           f"ring peak {ring_bytes/1e9:.2f} GB across all devices")
 
     t0 = time.time()
-    out = ring_self_attention(q, k, v, mesh, seq_axis="sp", causal=args.causal)
+    if args.flash:
+        from distkeras_tpu.ops.ring_flash import ring_flash_attention
+
+        out = ring_flash_attention(q, k, v, mesh, seq_axis="sp",
+                                   causal=args.causal)
+    else:
+        out = ring_self_attention(q, k, v, mesh, seq_axis="sp",
+                                  causal=args.causal)
     out = np.asarray(out)
     print(f"ring attention done in {time.time()-t0:.1f}s "
           f"out={out.shape} finite={np.isfinite(out).all()}")
