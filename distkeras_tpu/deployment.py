@@ -74,9 +74,20 @@ class Job:
         """Ship data and script to the target (scp), or stage locally (same
         layout the remote path establishes: inputs sit next to the script)."""
         if self.address is None:
+            import shutil
+
             os.makedirs(self._local_dir(), exist_ok=True)
             for p in filter(None, (self.data_path, self.script_path)):
-                subprocess.run(["cp", "-r", p, self._local_dir()], check=False)
+                # raise the real error here, not a confusing missing-file
+                # failure later in execute()
+                if os.path.isdir(p):
+                    shutil.copytree(
+                        p,
+                        os.path.join(self._local_dir(), os.path.basename(p)),
+                        dirs_exist_ok=True,
+                    )
+                else:
+                    shutil.copy2(p, self._local_dir())
             return
         self._run(["ssh", self._target(), f"mkdir -p {self._remote_job_dir()}"])
         for p in filter(None, (self.data_path, self.script_path)):

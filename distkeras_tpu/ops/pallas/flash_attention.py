@@ -191,19 +191,18 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
-def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+def dq_call(q, k, v, do, lse, delta, causal, block_q, interpret):
+    """dQ for (possibly differing) q/kv lengths — shared with ring_flash."""
     bh, s, d = q.shape
-    scale = d**-0.5
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-                    keepdims=True)  # [BH, S, 1]
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
-                          causal=causal, q_block=block_q, seq_len=s),
+    s_kv = k.shape[1]
+    return pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=min(block_q, s_kv), scale=d**-0.5,
+                          causal=causal, q_block=block_q, seq_len=s_kv),
         grid=(bh, s // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
@@ -212,28 +211,41 @@ def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
-                          causal=causal, k_block=block_k, seq_len=s),
-        grid=(bh, s // block_k),
+
+
+def dkv_call(k, v, q, do, lse, delta, causal, block_k, interpret):
+    """dK/dV for (possibly differing) q/kv lengths — shared with ring_flash."""
+    bh, s_kv, d = k.shape
+    s_q = q.shape[1]
+    return pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=min(block_k, s_q), scale=d**-0.5,
+                          causal=causal, k_block=block_k, seq_len=s_q),
+        grid=(bh, s_kv // block_k),
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_q, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_q, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_q, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_q, 1), lambda b, i: (b, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_kv, d), v.dtype),
         ),
         interpret=interpret,
     )(k, v, q, do, lse, delta)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [BH, S, 1]
+    dq = dq_call(q, k, v, do, lse, delta, causal, block_q, interpret)
+    dk, dv = dkv_call(k, v, q, do, lse, delta, causal, block_k, interpret)
     return dq, dk, dv
 
 
@@ -295,8 +307,11 @@ def flash_attention(
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
     interpreter elsewhere (CPU tests). ``return_lse=True`` additionally
-    returns the per-row logsumexp ``[B, S, H]`` — the statistic needed to
-    merge attention over disjoint K/V sets (ring composition).
+    returns the per-row logsumexp ``[B, S, H]`` — a **stop-gradient
+    diagnostic** (merging attention over disjoint K/V sets with correct
+    gradients is what :func:`distkeras_tpu.ops.ring_flash.ring_flash_attention`
+    implements; differentiating a hand-rolled merge through this lse would
+    silently drop the merge-weight gradient term, so it is cut explicitly).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -314,6 +329,6 @@ def flash_attention(
             fold(q), fold(k), fold(v), causal, block_q, block_k, interpret
         )
         lse = jnp.moveaxis(lse[..., 0].reshape(B, H, S), 1, 2)  # [B, S, H]
-        return unfold(out), lse
+        return unfold(out), jax.lax.stop_gradient(lse)
     out = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k, interpret)
     return unfold(out)

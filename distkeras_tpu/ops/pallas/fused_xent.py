@@ -175,13 +175,6 @@ def _xent_bwd(block_t, block_v, interpret, residuals, g):
 _xent.defvjp(_xent_fwd, _xent_bwd)
 
 
-def _fit_block(n: int, preferred: int) -> int:
-    b = min(preferred, n)
-    while n % b:
-        b //= 2
-    return max(1, b)
-
-
 def fused_softmax_xent(
     logits,
     labels,
@@ -201,7 +194,18 @@ def fused_softmax_xent(
     flat_logits = logits.reshape(-1, V)
     flat_labels = labels.reshape(-1).astype(jnp.int32)
     T = flat_logits.shape[0]
-    bt = _fit_block(T, block_t)
-    bv = _fit_block(V, block_v)
+    # Fixed tile sizes; ragged shapes are PADDED, never shrunk (halving the
+    # block to fit 30522/50257-sized vocabs degenerates to 1-2 wide tiles).
+    # Vocab pads with -1e30 columns (zero softmax mass); the token axis pads
+    # with dummy rows excluded from the mean.
+    bt = min(block_t, T)
+    bv = min(block_v, V)
+    pad_t = (-T) % bt
+    pad_v = (-V) % bv
+    if pad_t or pad_v:
+        flat_logits = jnp.pad(
+            flat_logits, ((0, pad_t), (0, pad_v)), constant_values=-1e30
+        )
+        flat_labels = jnp.pad(flat_labels, (0, pad_t))
     per_token = _xent(flat_logits, flat_labels, bt, bv, interpret)
-    return jnp.mean(per_token)
+    return jnp.mean(per_token[:T])

@@ -24,17 +24,14 @@ passes.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
 
 from distkeras_tpu.ops.pallas.flash_attention import (
-    _dkv_kernel,
-    _dq_kernel,
     _flash_forward,
+    dkv_call as _dkv_call,
+    dq_call as _dq_call,
 )
 
 __all__ = ["ring_flash_attention"]
@@ -48,54 +45,6 @@ def _fold(x):  # [B, S, H, D] -> [BH, S, D]
 def _unfold(x, B, H):  # [BH, S, D] -> [B, S, H, D]
     BH, S, D = x.shape
     return jnp.moveaxis(x.reshape(B, H, S, D), 1, 2)
-
-
-def _dq_call(q, k, v, do, lse, delta, causal, block_q, interpret):
-    bh, s, d = q.shape
-    s_kv = k.shape[1]
-    return pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=min(block_q, s_kv), scale=d**-0.5,
-                          causal=causal, q_block=block_q, seq_len=s_kv),
-        grid=(bh, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-
-def _dkv_call(k, v, q, do, lse, delta, causal, block_k, interpret):
-    bh, s_kv, d = k.shape
-    s_q = q.shape[1]
-    return pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=min(block_k, s_q), scale=d**-0.5,
-                          causal=causal, k_block=block_k, seq_len=s_q),
-        grid=(bh, s_kv // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s_q, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s_q, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s_q, 1), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s_q, 1), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, s_kv, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_kv, d), v.dtype),
-        ),
-        interpret=interpret,
-    )(k, v, q, do, lse, delta)
 
 
 def _hop_forward(q, k_cur, v_cur, mode, block_q, interpret):
@@ -246,8 +195,14 @@ def ring_flash_attention(
         interpret = jax.default_backend() != "tpu"
     B, S, H, D = q.shape
     p = mesh.shape[seq_axis]
+    if S % p:
+        raise ValueError(f"seq_len {S} not divisible by {seq_axis}={p}")
     s_local = S // p
+    # block must divide the per-device shard or the Pallas grids silently
+    # drop the tail rows; fit to the largest divisor <= requested.
     block_q = min(block_q, s_local)
+    while s_local % block_q:
+        block_q -= 1
 
     # Shard the batch over dp only when divisible (model init traces with
     # a dummy batch of 1; a replicated tiny batch is fine there).

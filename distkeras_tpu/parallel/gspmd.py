@@ -180,14 +180,12 @@ def make_sharded_train_step(
             outputs, new_model_state = model.apply(
                 variables, batch["features"], train=True, rngs={"dropout": step_rng}
             )
-            task_loss = loss_fn(outputs, batch["label"])
-            aux = new_model_state.pop("aux_loss", None)
-            if aux is not None:
-                import jax.numpy as jnp
+            from distkeras_tpu.training.step import apply_aux_loss
 
-                task_loss = task_loss + aux_loss_weight * sum(
-                    jnp.sum(leaf) for leaf in jax.tree.leaves(aux)
-                )
+            task_loss, new_model_state = apply_aux_loss(
+                loss_fn(outputs, batch["label"]), new_model_state,
+                aux_loss_weight,
+            )
             return task_loss, (outputs, new_model_state)
 
         (loss_value, (outputs, new_model_state)), grads = jax.value_and_grad(

@@ -23,7 +23,19 @@ from distkeras_tpu.models.core import Model
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.metrics import accuracy as accuracy_metric
 
-__all__ = ["TrainState", "make_train_step", "make_eval_step"]
+__all__ = ["TrainState", "make_train_step", "make_eval_step", "apply_aux_loss"]
+
+
+def apply_aux_loss(task_loss, new_model_state: dict, weight: float):
+    """Fold sown auxiliary losses (MoE load balancing, ...) into the
+    objective and strip them from carried state. Shared by the single-chip
+    and GSPMD step engines."""
+    aux = new_model_state.pop("aux_loss", None)
+    if aux is not None:
+        task_loss = task_loss + weight * sum(
+            jnp.sum(leaf) for leaf in jax.tree.leaves(aux)
+        )
+    return task_loss, new_model_state
 
 
 @struct.dataclass
@@ -102,14 +114,9 @@ def make_train_step(
         outputs, new_model_state = apply_fn(
             variables, features, True, rngs={"dropout": step_rng}
         )
-        task_loss = loss_fn(outputs, labels)
-        # Sown auxiliary losses (MoE load balancing, ...) join the
-        # objective; they are per-step outputs, not persistent state.
-        aux = new_model_state.pop("aux_loss", None)
-        if aux is not None:
-            task_loss = task_loss + aux_loss_weight * sum(
-                jnp.sum(leaf) for leaf in jax.tree.leaves(aux)
-            )
+        task_loss, new_model_state = apply_aux_loss(
+            loss_fn(outputs, labels), new_model_state, aux_loss_weight
+        )
         return task_loss, (outputs, new_model_state)
 
     def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
@@ -125,6 +132,11 @@ def make_train_step(
                 out_metrics["accuracy"] = accuracy_metric(outputs, batch["label"])
         else:
             B = batch["features"].shape[0]
+            if B % accum:
+                raise ValueError(
+                    f"batch size {B} not divisible by grad_accum_steps "
+                    f"{accum} (samples would be silently dropped)"
+                )
             micro = B // accum
             feats = batch["features"][: micro * accum].reshape(
                 accum, micro, *batch["features"].shape[1:]

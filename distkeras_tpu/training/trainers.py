@@ -204,6 +204,7 @@ class SingleTrainer(Trainer):
         seed: int = 0,
         grad_accum_steps: int = 1,
         remat: bool = False,
+        aux_loss_weight: float = 0.01,
         loss_weights=None,
         metric_stream=None,
     ):
@@ -216,6 +217,7 @@ class SingleTrainer(Trainer):
         self.num_epoch = int(num_epoch)
         self.grad_accum_steps = int(grad_accum_steps)
         self.remat = bool(remat)
+        self.aux_loss_weight = float(aux_loss_weight)
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
@@ -223,6 +225,7 @@ class SingleTrainer(Trainer):
         step_fn = make_train_step(
             self.model, optimizer, self.loss, self.metrics,
             remat=self.remat, grad_accum_steps=self.grad_accum_steps,
+            aux_loss_weight=self.aux_loss_weight,
         )
         state = TrainState.create(self.model, optimizer, rng=self.seed)
         batches = minibatches(
@@ -404,6 +407,7 @@ class SynchronousDistributedTrainer(Trainer):
         mesh=None,
         zero1: bool = False,
         shard_sequence: bool = False,
+        aux_loss_weight: float = 0.01,
         loss_weights=None,
         metric_stream=None,
     ):
@@ -421,6 +425,7 @@ class SynchronousDistributedTrainer(Trainer):
         # axis (XLA inserts the activation collectives; ring attention is the
         # shard_map alternative for attention itself).
         self.shard_sequence = bool(shard_sequence)
+        self.aux_loss_weight = float(aux_loss_weight)
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
@@ -454,7 +459,8 @@ class SynchronousDistributedTrainer(Trainer):
                 self.model, optimizer, mesh, rng=self.seed, zero1=self.zero1
             )
             step_fn = make_sharded_train_step(
-                self.model, optimizer, self.loss, mesh, metrics=self.metrics
+                self.model, optimizer, self.loss, mesh, metrics=self.metrics,
+                aux_loss_weight=self.aux_loss_weight,
             )
             seq_dim = 1 if self.shard_sequence else None
             shard_fn = lambda b: shard_batch(mesh, b, seq_dim=seq_dim)

@@ -80,3 +80,17 @@ def test_bf16_logits(rng):
         ).mean()
     )
     np.testing.assert_allclose(got, ref, rtol=1e-3)
+
+
+def test_odd_vocab_real_sizes(rng):
+    """30522-style vocab must keep full-width tiles via padding (no
+    degenerate block shrink) and still match optax."""
+    T, V = 16, 1003  # deliberately prime-ish, indivisible by any block
+    logits = np.asarray(rng.normal(size=(T, V)) * 2, np.float32)
+    labels = rng.integers(0, V, size=T).astype(np.int32)
+    got = float(fused_softmax_xent(logits, labels, block_t=8, block_v=128))
+    ref = float(optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean())
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    g = jax.grad(lambda l: fused_softmax_xent(l, labels, block_t=8, block_v=128))(logits)
+    gr = jax.grad(lambda l: optax.softmax_cross_entropy_with_integer_labels(l, labels).mean())(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-6, rtol=1e-4)

@@ -98,3 +98,14 @@ def test_bert_with_ring_attention_trains(rng):
     np.testing.assert_allclose(
         np.asarray(o_ring), np.asarray(o_plain), atol=3e-2, rtol=3e-2
     )
+
+
+def test_ring_flash_non_divisible_block(rng):
+    """s_local=24 with default-ish block 16 -> fitted divisor; no dropped
+    tail rows (regression for the silent floor-division bug)."""
+    q, k, v = _qkv(rng, B=1, S=48, H=1, D=8)
+    mesh = make_mesh({"sp": 2})
+    out = ring_flash_attention(q, k, v, mesh, seq_axis="sp", block_q=16)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
