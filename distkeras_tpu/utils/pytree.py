@@ -20,17 +20,35 @@ import numpy as np
 
 # ---------------------------------------------------------------------------
 # PyTree arithmetic (the building blocks of every PS protocol update rule).
+#
+# Leaf-type dispatch: numpy inputs stay numpy (the PS loop runs on the HOST
+# and must not bounce center weights through the accelerator on every
+# commit); jax arrays stay jax (worker-side window math runs on device).
 # ---------------------------------------------------------------------------
+
+
+def _np_leaf(x) -> bool:
+    return isinstance(x, np.ndarray) or np.isscalar(x)
 
 
 def pytree_add(a: Any, b: Any) -> Any:
     """``a + b`` leaf-wise."""
-    return jax.tree.map(jnp.add, a, b)
+    return jax.tree.map(
+        lambda x, y: np.add(x, y) if _np_leaf(x) and _np_leaf(y) else jnp.add(x, y),
+        a,
+        b,
+    )
 
 
 def pytree_sub(a: Any, b: Any) -> Any:
     """``a - b`` leaf-wise (e.g. weight deltas: ``w_after - w_before``)."""
-    return jax.tree.map(jnp.subtract, a, b)
+    return jax.tree.map(
+        lambda x, y: (
+            np.subtract(x, y) if _np_leaf(x) and _np_leaf(y) else jnp.subtract(x, y)
+        ),
+        a,
+        b,
+    )
 
 
 def pytree_scale(a: Any, s) -> Any:
@@ -39,7 +57,9 @@ def pytree_scale(a: Any, s) -> Any:
 
 
 def pytree_zeros_like(a: Any) -> Any:
-    return jax.tree.map(jnp.zeros_like, a)
+    return jax.tree.map(
+        lambda x: np.zeros_like(x) if _np_leaf(x) else jnp.zeros_like(x), a
+    )
 
 
 def pytree_mean(trees: list[Any]) -> Any:

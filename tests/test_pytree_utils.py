@@ -90,3 +90,22 @@ def test_serialize_bfloat16_roundtrip():
     assert back["w"].dtype == ml_dtypes.bfloat16
     assert np.allclose(back["w"].astype(np.float32), 1.5)
     assert back["b"].dtype == np.float32
+
+
+def test_pytree_ops_stay_numpy_for_host_inputs():
+    """PS-side math must not bounce host arrays through the accelerator."""
+    a = {"w": np.ones(4, np.float32)}
+    b = {"w": np.full(4, 2.0, np.float32)}
+    out = pytree_add(a, b)
+    assert isinstance(out["w"], np.ndarray)  # not a jax.Array
+    out = pytree_sub(a, b)
+    assert isinstance(out["w"], np.ndarray)
+    # device inputs stay device
+    import jax.numpy as jnp
+
+    da = {"w": jnp.ones(4)}
+    db = {"w": jnp.ones(4)}
+    dout = pytree_add(da, db)
+    import jax
+
+    assert isinstance(dout["w"], jax.Array)
