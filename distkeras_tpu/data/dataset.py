@@ -103,6 +103,17 @@ class Dataset:
             out = {name: _maybe_numeric(col, dtype) for name, col in table.items()}
         return cls(out)
 
+    @classmethod
+    def from_npz(cls, path: str) -> "Dataset":
+        """Load a dataset saved with :meth:`to_npz` (or any npz whose arrays
+        share a leading row dimension)."""
+        with np.load(path) as d:
+            return cls({k: d[k] for k in d.files})
+
+    def to_npz(self, path: str, compressed: bool = False) -> None:
+        save = np.savez_compressed if compressed else np.savez
+        save(path, **self._columns)
+
     # -- basic accessors ----------------------------------------------------
 
     @property

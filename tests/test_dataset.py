@@ -83,3 +83,13 @@ def test_missing_column_names_available():
     ds = Dataset.from_arrays(features=np.zeros(3), label=np.zeros(3))
     with pytest.raises(KeyError, match="available.*features"):
         ds["featuers"]
+
+
+def test_npz_roundtrip(tmp_path):
+    ds = Dataset.from_arrays(features=np.arange(12, dtype=np.float32).reshape(4, 3),
+                             label=np.arange(4))
+    p = str(tmp_path / "d.npz")
+    ds.to_npz(p)
+    back = Dataset.from_npz(p)
+    assert set(back.columns) == {"features", "label"}
+    np.testing.assert_array_equal(back["features"], ds["features"])

@@ -113,3 +113,14 @@ def test_async_trainer_over_grpc_transport(toy_classification=None):
     preds = trained.predict(x)
     acc = float(np.mean((np.argmax(preds, -1) == y)))
     assert acc > 0.85, acc
+
+
+def test_grpc_health_rpc(adag_server):
+    ps, port = adag_server
+    client = GrpcClient("127.0.0.1", port)
+    h = client.health()
+    assert h["running"] is True and h["num_commits"] == 0
+    client.commit({"delta": {"w": np.ones(4, np.float32)}})
+    client.pull()
+    assert client.health()["num_commits"] == 1
+    client.close()
