@@ -177,3 +177,33 @@ def test_compressed_deltas_train(tmp_path):
         preds = trained.predict(x)
         acc = float(np.mean(np.argmax(preds, -1) == y))
         assert acc > 0.85, (transport, acc)
+
+
+def test_kitchen_sink_async(tmp_path):
+    """Feature interaction: ADAG with islands (2x2 devices), gRPC transport,
+    bf16 delta compression, and PS checkpointing — all at once."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = dk.Dataset.from_arrays(features=x, label=y)
+    model = Model.from_flax(MLP(features=(16,), num_classes=2), input_shape=(8,))
+    trainer = dk.ADAG(
+        model, worker_optimizer="adam", learning_rate=0.01,
+        num_workers=2, devices_per_worker=2, batch_size=8, num_epoch=4,
+        communication_window=3, transport="grpc", compress_deltas=True,
+        checkpoint_dir=str(tmp_path / "ks"),
+    )
+    trained = trainer.train(ds)
+    assert trainer.parameter_server.num_commits > 0
+    preds = trained.predict(x)
+    acc = float(np.mean(np.argmax(preds, -1) == y))
+    assert acc > 0.85, acc
+    # resume pass picks up the checkpointed center
+    t2 = dk.ADAG(
+        model, worker_optimizer="adam", learning_rate=0.01,
+        num_workers=2, devices_per_worker=2, batch_size=8, num_epoch=1,
+        communication_window=3, transport="grpc", compress_deltas=True,
+        checkpoint_dir=str(tmp_path / "ks"), resume=True,
+    )
+    t2.train(ds)
+    assert t2.parameter_server.num_commits > 0
