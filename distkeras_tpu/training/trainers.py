@@ -205,6 +205,7 @@ class SingleTrainer(Trainer):
         grad_accum_steps: int = 1,
         remat: bool = False,
         aux_loss_weight: float = 0.01,
+        validation_data: Dataset | None = None,
         loss_weights=None,
         metric_stream=None,
     ):
@@ -218,6 +219,10 @@ class SingleTrainer(Trainer):
         self.grad_accum_steps = int(grad_accum_steps)
         self.remat = bool(remat)
         self.aux_loss_weight = float(aux_loss_weight)
+        # Optional held-out set: evaluated after every epoch into
+        # validation_history (val_loss/val_accuracy).
+        self.validation_data = validation_data
+        self.validation_history: list[dict] = []
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
@@ -228,21 +233,31 @@ class SingleTrainer(Trainer):
             aux_loss_weight=self.aux_loss_weight,
         )
         state = TrainState.create(self.model, optimizer, rng=self.seed)
-        batches = minibatches(
-            dataset,
-            self.batch_size,
-            self.features_col,
-            self.label_col,
-            num_epoch=self.num_epoch,
-            seed=self.seed if shuffle else None,
-        )
-        # Double-buffered host->HBM feed: the next batch's transfer overlaps
-        # the current step's compute.
-        feed = DeviceFeed(batches, buffer_size=2)
         self.history = []
-        for batch in feed:
-            state, m = step_fn(state, batch)
-            self.history.append(m)
+        self.validation_history = []
+        for epoch in range(self.num_epoch):
+            batches = minibatches(
+                dataset,
+                self.batch_size,
+                self.features_col,
+                self.label_col,
+                num_epoch=1,
+                seed=(self.seed + epoch) if shuffle else None,
+            )
+            # Double-buffered host->HBM feed: the next batch's transfer
+            # overlaps the current step's compute.
+            for batch in DeviceFeed(batches, buffer_size=2):
+                state, m = step_fn(state, batch)
+                self.history.append(m)
+            if self.validation_data is not None:
+                snapshot = TrainedModel(self.model, state.variables)
+                val = self.evaluate(
+                    snapshot, self.validation_data,
+                    features_col=self.features_col, label_col=self.label_col,
+                )
+                self.validation_history.append(
+                    {"epoch": epoch, **{f"val_{k}": v for k, v in val.items()}}
+                )
         # Materialize metrics (they were async device scalars).
         self.history = [
             {k: float(v) for k, v in h.items()} for h in self.history

@@ -282,3 +282,16 @@ def test_sync_trainer_zero1(toy_classification):
     )
     trained = trainer.train(toy_classification)
     assert _accuracy(trained, toy_classification) > 0.85
+
+
+def test_validation_history(toy_classification):
+    train, val = toy_classification.split(0.8, seed=0)
+    trainer = dk.SingleTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        batch_size=32, num_epoch=3, validation_data=val,
+    )
+    trainer.train(train, shuffle=True)
+    vh = trainer.validation_history
+    assert len(vh) == 3
+    assert {"epoch", "val_loss", "val_accuracy"} <= set(vh[0])
+    assert vh[-1]["val_accuracy"] > 0.85
