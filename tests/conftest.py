@@ -7,14 +7,17 @@ side effects.
 """
 
 import os
+import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("KERAS_BACKEND", "jax")
+# The suite is written against exactly 8 virtual devices; replace any
+# pre-existing count rather than deferring to it.
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8"
+).strip()
 
 # The container's axon sitecustomize force-selects the TPU platform even
 # when JAX_PLATFORMS=cpu is in the environment; the config update below is
