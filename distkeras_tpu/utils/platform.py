@@ -1,0 +1,97 @@
+"""Platform selection helpers.
+
+This container's sitecustomize pins ``jax_platforms="axon,cpu"`` at import
+time, which *overrides* the ``JAX_PLATFORMS`` environment variable — so the
+documented JAX way of forcing CPU doesn't work here, and any script run
+while the TPU tunnel is unhealthy hangs in backend init.  The reliable
+knob is ``jax.config.update("jax_platforms", ...)`` *before the first
+backend-initializing call* (tests/conftest.py uses the same pattern).
+
+No reference analogue (the reference picks backends via Spark executor
+placement); this is TPU-container plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+
+def jax_backends_live() -> bool:
+    """True iff jax has already initialized at least one backend.
+
+    Uses the private ``xla_bridge._backends`` registry; degrades to False
+    (the safe "not yet initialized" answer) if that moves in a future jax.
+    """
+    if sys.modules.get("jax") is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def ensure_virtual_cpu_flags(n: int) -> None:
+    """Request >=n virtual host CPU devices via XLA_FLAGS.
+
+    Only effective before jax initializes backends; appends or raises the
+    ``--xla_force_host_platform_device_count`` flag as needed.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+    os.environ["XLA_FLAGS"] = flags
+
+
+def force_platform(platform: str | None, num_virtual_cpu: int | None = None) -> None:
+    """Pin jax to ``platform`` ("cpu", "tpu"/"axon", or None for default).
+
+    Must run before jax initializes any backend; raises RuntimeError if a
+    backend is already live (``jax.config.update("jax_platforms", ...)``
+    silently no-ops after init, which would leave the script on the default
+    axon platform — the exact hang this helper exists to prevent).
+
+    With ``platform="cpu"``, ``num_virtual_cpu`` alone implies cpu; N
+    virtual host devices are requested for mesh work on a machine without
+    N real chips.
+    """
+    if num_virtual_cpu and platform in (None, "", "default"):
+        platform = "cpu"
+    if platform in (None, "", "default"):
+        return
+    if jax_backends_live():
+        raise RuntimeError(
+            f"cannot force platform {platform!r}: jax already initialized a "
+            "backend (jax.config.update('jax_platforms', ...) would silently "
+            "no-op). Call force_platform before any jax.devices()/jnp use."
+        )
+    if platform == "cpu" and num_virtual_cpu:
+        ensure_virtual_cpu_flags(num_virtual_cpu)
+    import jax
+
+    name = {"tpu": "axon,cpu", "axon": "axon,cpu"}.get(platform, platform)
+    jax.config.update("jax_platforms", name)
+
+
+def add_platform_flag(parser) -> None:
+    """Add ``--platform`` / ``--devices`` to an example's argparse parser."""
+    parser.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu", "default"],
+        help="Pin the jax platform (cpu works even when the TPU tunnel is "
+        "down; this container ignores the JAX_PLATFORMS env var).")
+    parser.add_argument(
+        "--devices", type=int, default=None,
+        help="Number of virtual host devices (implies --platform cpu).")
+
+
+def apply_platform_args(args) -> None:
+    force_platform(getattr(args, "platform", None),
+                   getattr(args, "devices", None))

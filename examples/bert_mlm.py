@@ -43,7 +43,10 @@ def main():
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--epochs", type=int, default=1)
     ap.add_argument("--batch-size", type=int, default=8)
+    from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
+    add_platform_flag(ap)
     args = ap.parse_args()
+    apply_platform_args(args)
 
     ds = make_mlm_data(seq=args.seq, vocab=args.vocab)
     model = bert_tiny_mlm(seq_len=args.seq, vocab_size=args.vocab)

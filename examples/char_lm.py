@@ -40,7 +40,10 @@ def main():
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--sample", type=int, default=200)
+    from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
+    add_platform_flag(ap)
     args = ap.parse_args()
+    apply_platform_args(args)
 
     ids, stoi, chars = load_corpus()
     vocab = len(chars)

@@ -38,7 +38,10 @@ def main():
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=64)
+    from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
+    add_platform_flag(ap)
     args = ap.parse_args()
+    apply_platform_args(args)
 
     ds = load_cifar(args.npz)
     ds = dk.MinMaxTransformer(min=0.0, max=255.0, input_col="features",

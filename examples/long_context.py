@@ -23,7 +23,10 @@ def main():
     ap.add_argument("--causal", action="store_true")
     ap.add_argument("--flash", action="store_true",
                     help="use ring_flash_attention (Pallas kernels per hop)")
+    from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
+    add_platform_flag(ap)
     args = ap.parse_args()
+    apply_platform_args(args)
 
     import os
 

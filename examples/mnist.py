@@ -42,7 +42,10 @@ def main():
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--npz", default=None)
+    from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
+    add_platform_flag(ap)
     args = ap.parse_args()
+    apply_platform_args(args)
 
     raw = load_mnist(args.npz)
     # Preprocessing pipeline (reference workflow.ipynb §3.5 shape):

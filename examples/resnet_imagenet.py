@@ -26,7 +26,10 @@ def main():
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--transport", default="inprocess", choices=["inprocess", "grpc"])
+    from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
+    add_platform_flag(ap)
     args = ap.parse_args()
+    apply_platform_args(args)
 
     n = args.steps * args.batch_size * args.workers
     rng = np.random.default_rng(0)
