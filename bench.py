@@ -83,7 +83,11 @@ def main() -> None:
         pass
 
     from distkeras_tpu.ops.losses import get_optimizer
-    from distkeras_tpu.tracing import StepTimer, device_peak_flops
+    from distkeras_tpu.tracing import (
+        StepTimer,
+        compiled_step_flops,
+        device_peak_flops,
+    )
     from distkeras_tpu.training.step import TrainState, make_train_step
 
     model, b = _model_and_batch(kind, batch)
@@ -91,6 +95,10 @@ def main() -> None:
     step_fn = make_train_step(model, optimizer, "categorical_crossentropy",
                               metrics=())
     state = TrainState.create(model, optimizer, rng=0)
+
+    # XLA's own FLOP count for the whole compiled step (a compile-cache hit
+    # after the warmup compile); the hand constant is the cross-check.
+    xla_flops = compiled_step_flops(step_fn, state, b)
 
     for _ in range(warmup):
         state, m = step_fn(state, b)
@@ -108,9 +116,16 @@ def main() -> None:
         flops_per_example=model.flops_per_example,
         num_chips=1,
         skip_warmup=1,
+        flops_per_step=xla_flops,
     )
     sps = summary["samples_per_sec_per_chip"]
     mfu = summary.get("mfu", 0.0)
+    hand_flops = (
+        3.0 * model.flops_per_example * batch if model.flops_per_example else None
+    )
+    flops_agreement = (
+        round(xla_flops / hand_flops, 3) if (xla_flops and hand_flops) else None
+    )
     print(json.dumps({
         "metric": f"{model.name}_train_samples_per_sec_per_chip",
         "value": round(sps, 2),
@@ -124,6 +139,9 @@ def main() -> None:
             "step_time_var_s2": round(summary["step_time_var_s2"], 8),
             "device": str(jax.devices()[0]),
             "peak_flops": device_peak_flops() or 0,
+            "flops_per_step_xla": xla_flops,
+            "flops_per_step_hand": hand_flops,
+            "flops_xla_over_hand": flops_agreement,
         },
     }))
 

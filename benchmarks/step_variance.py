@@ -72,6 +72,18 @@ def main():
     async_steps = len(async_trainer.get_history())
     async_mean = async_wall / max(1, async_steps / args.workers)
 
+    # Steady state: drop each worker's first window (it absorbs the one-off
+    # XLA compile, which the sync path's timed loop also excludes).
+    wt = async_trainer.window_times
+    warm_start = max(h[0][0] for h in wt if h)  # all workers past compile
+    steady_steps = sum(n for h in wt for (t, n) in h if t > warm_start)
+    t_end = max(t for h in wt for (t, _) in h)
+    async_steady = (
+        (t_end - warm_start) / max(1, steady_steps / args.workers)
+        if steady_steps
+        else async_mean
+    )
+
     # --- sync all-reduce path (explicit per-step timing) -------------------
     from distkeras_tpu.data.feed import minibatches
     from distkeras_tpu.ops.losses import get_optimizer
@@ -116,6 +128,8 @@ def main():
         },
         "async_ps": {
             "effective_step_mean_s": round(async_mean, 6),
+            "steady_state_step_s": round(async_steady, 6),
+            "vs_sync": round(async_steady / sync_mean, 2),
             "workers": args.workers,
             "commits": async_trainer.parameter_server.num_commits,
         },

@@ -17,7 +17,7 @@ import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
 
-__all__ = ["minibatches", "DeviceFeed"]
+__all__ = ["minibatches", "window_batches", "DeviceFeed"]
 
 Batch = dict[str, np.ndarray]
 
@@ -52,6 +52,30 @@ def minibatches(
         for lo in range(0, stop, batch_size):
             hi = min(lo + batch_size, n)
             yield {"features": xe[lo:hi], "label": ye[lo:hi]}
+
+
+def window_batches(batches: Iterator[Batch], window: int) -> Iterator[Batch]:
+    """Group ``window`` consecutive minibatches into one stacked batch with a
+    leading window axis (``[W, B, ...]``) for the scanned window step
+    (:func:`distkeras_tpu.training.step.make_window_train_step`).
+
+    The dataset tail is emitted as ``[1, B, ...]`` singles rather than one
+    ``[W', B, ...]`` group: the scanned program is compiled per distinct
+    leading length, so singles bound the compile count at two programs
+    (full window + single) instead of one per distinct tail length.
+    """
+
+    def _stack(buf: list[Batch]) -> Batch:
+        return {k: np.stack([b[k] for b in buf]) for k in buf[0]}
+
+    buf: list[Batch] = []
+    for b in batches:
+        buf.append(b)
+        if len(buf) == window:
+            yield _stack(buf)
+            buf = []
+    for b in buf:
+        yield _stack([b])
 
 
 class DeviceFeed:

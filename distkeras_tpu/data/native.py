@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import subprocess
 
 import numpy as np
 
@@ -24,24 +25,51 @@ __all__ = [
 ]
 
 _LIB = None
+_LOAD_TRIED = False
 
 
 def _find_lib():
     here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    candidates = [
-        os.path.join(here, "native", "libfastdata.so"),
-        os.path.join(os.path.dirname(__file__), "libfastdata.so"),
-    ]
-    for c in candidates:
-        if os.path.exists(c):
-            return c
-    return None
+    native_dir = os.path.join(here, "native")
+    built = _ensure_built(native_dir)
+    if built is not None:
+        return built
+    local = os.path.join(os.path.dirname(__file__), "libfastdata.so")
+    return local if os.path.exists(local) else None
+
+
+def _ensure_built(native_dir: str) -> str | None:
+    """Build (or rebuild) libfastdata.so from source when the checkout has
+    the sources. The .so is NOT committed (it would be an unauditable binary
+    that silently goes stale against fastdata.cpp); a stale .so is never
+    loaded — numpy fallback instead."""
+    src = os.path.join(native_dir, "fastdata.cpp")
+    so = os.path.join(native_dir, "libfastdata.so")
+    if not os.path.exists(src):
+        return so if os.path.exists(so) else None
+    fresh = os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src)
+    if fresh:
+        return so
+    try:
+        subprocess.run(
+            ["make", "-C", native_dir],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None  # no toolchain / failed build: numpy fallback, not stale .so
+    return so if os.path.exists(so) else None
 
 
 def _load():
-    global _LIB
-    if _LIB is not None:
+    global _LIB, _LOAD_TRIED
+    if _LIB is not None or _LOAD_TRIED:
+        # One attempt per process: a failed build/load must not re-spawn
+        # `make` on every minibatch call (the numpy fallback is the steady
+        # state on toolchain-less hosts).
         return _LIB
+    _LOAD_TRIED = True
     path = _find_lib()
     if path is None:
         return None
@@ -83,10 +111,11 @@ def parse_csv(data: bytes, rows: int, cols: int) -> np.ndarray:
     """Parse a headerless numeric CSV buffer into a [rows, cols] float32."""
     lib = _load()
     if lib is None:
-        text = data.decode()
-        return np.fromstring(text.replace("\n", ","), sep=",", dtype=np.float32)[
-            : rows * cols
-        ].reshape(rows, cols)
+        flat = np.array(
+            data.decode().replace("\n", ",").split(",")[: rows * cols],
+            dtype=np.float32,
+        )
+        return flat.reshape(rows, cols)
     out = np.empty((rows, cols), np.float32)
     n = lib.fd_parse_csv_f32(data, len(data), _f32p(out), rows, cols)
     if n < 0:
@@ -101,6 +130,14 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     idx = np.ascontiguousarray(idx, dtype=np.int64)
     if lib is None:
         return src[idx]
+    # The C path is a raw memcpy: out-of-range indices would read (or fault
+    # on) arbitrary memory, where the numpy fallback raises IndexError.
+    # Match the fallback's contract before crossing the FFI boundary.
+    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= src.shape[0]):
+        raise IndexError(
+            f"gather index out of range [0, {src.shape[0]}): "
+            f"min={int(idx.min())} max={int(idx.max())}"
+        )
     row_elems = int(np.prod(src.shape[1:], dtype=np.int64)) if src.ndim > 1 else 1
     out = np.empty((idx.shape[0],) + src.shape[1:], np.float32)
     lib.fd_gather_f32(_f32p(src), _i64p(idx), _f32p(out), idx.shape[0], row_elems)
@@ -113,6 +150,13 @@ def pack_batch(
     """Contiguous [start:start+batch] slice, optionally fused ``x*scale+shift``."""
     lib = _load()
     src = np.ascontiguousarray(src, dtype=np.float32)
+    if start < 0 or batch < 0 or start + batch > src.shape[0]:
+        # The C path is a raw memcpy; keep the numpy fallback on the same
+        # contract so the two paths never diverge on bad ranges.
+        raise IndexError(
+            f"pack_batch range [{start}, {start + batch}) outside "
+            f"[0, {src.shape[0]})"
+        )
     if lib is None:
         chunk = src[start : start + batch]
         return chunk * scale + shift if (scale != 1.0 or shift != 0.0) else chunk.copy()

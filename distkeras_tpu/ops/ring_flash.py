@@ -199,10 +199,38 @@ def ring_flash_attention(
         raise ValueError(f"seq_len {S} not divisible by {seq_axis}={p}")
     s_local = S // p
     # block must divide the per-device shard or the Pallas grids silently
-    # drop the tail rows; fit to the largest divisor <= requested.
-    block_q = min(block_q, s_local)
-    while s_local % block_q:
-        block_q -= 1
+    # drop the tail rows. Prefer MXU/VPU-aligned divisors: a multiple of 128
+    # (full lane tile) when one divides, else a multiple of 8 (sublane) —
+    # unaligned blocks compile under interpret mode but fail or tile badly
+    # under the real Mosaic TPU compiler.
+    cap = min(block_q, s_local)
+    requested = block_q
+    block_q = 0
+    for align in (128, 8):
+        if cap < align:
+            continue
+        for b in range(cap - cap % align, 0, -align):
+            if s_local % b == 0:
+                block_q = b
+                break
+        if block_q:
+            break
+    if not block_q:
+        if interpret:
+            block_q = cap
+            while s_local % block_q:
+                block_q -= 1
+        elif cap < 8:
+            raise ValueError(
+                f"block_q={requested} is below the TPU sublane width; pass a "
+                f"multiple of 8 (the per-device shard is {s_local} rows)"
+            )
+        else:
+            raise ValueError(
+                f"no 8-aligned query block <= {cap} divides the per-device "
+                f"sequence shard {s_local} (seq_len {S} / {seq_axis}={p}); "
+                f"pad the sequence so each shard is a multiple of 8"
+            )
 
     # Shard the batch over dp only when divisible (model init traces with
     # a dummy batch of 1; a replicated tiny batch is fine there).

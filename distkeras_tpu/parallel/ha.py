@@ -70,6 +70,13 @@ class RetryingClient:
         # Safe to retry only when the commit is idempotent (stamped).
         self._with_retries(lambda: self._client.commit(payload), "commit")
 
+    def commit_pull(self, payload: dict):
+        # Same idempotence story: the PS dedupe window makes a retried fused
+        # exchange apply-at-most-once, and the dup path still replies.
+        return self._with_retries(
+            lambda: self._client.commit_pull(payload), "commit_pull"
+        )
+
     def __getattr__(self, name: str) -> Any:
         return getattr(self._client, name)
 
@@ -85,11 +92,15 @@ class StampingClient:
     def pull(self):
         return self._client.pull()
 
-    def commit(self, payload: dict) -> None:
+    def _stamp(self, payload: dict) -> dict:
         self._counter += 1
-        self._client.commit(
-            {**payload, "commit_id": f"w{self._worker_id}:{self._counter}"}
-        )
+        return {**payload, "commit_id": f"w{self._worker_id}:{self._counter}"}
+
+    def commit(self, payload: dict) -> None:
+        self._client.commit(self._stamp(payload))
+
+    def commit_pull(self, payload: dict):
+        return self._client.commit_pull(self._stamp(payload))
 
     def __getattr__(self, name: str) -> Any:
         return getattr(self._client, name)
@@ -109,16 +120,27 @@ class CompressingClient:
     def pull(self):
         return self._client.pull()
 
-    def commit(self, payload: dict) -> None:
+    @staticmethod
+    def _bf16(tree):
         import jax
         import jax.numpy as jnp
         import numpy as np
 
-        delta = jax.tree.map(
+        return jax.tree.map(
             lambda x: np.asarray(jax.device_get(jnp.asarray(x).astype(jnp.bfloat16))),
-            payload["delta"],
+            tree,
         )
-        self._client.commit({**payload, "delta": delta})
+
+    def commit(self, payload: dict) -> None:
+        self._client.commit({**payload, "delta": self._bf16(payload["delta"])})
+
+    def commit_pull(self, payload: dict):
+        # Only deltas are compressed; a fused elastic exchange ships "local"
+        # params, whose absolute values don't tolerate bf16 truncation the
+        # way near-zero deltas do.
+        if "delta" in payload:
+            payload = {**payload, "delta": self._bf16(payload["delta"])}
+        return self._client.commit_pull(payload)
 
     def __getattr__(self, name: str) -> Any:
         return getattr(self._client, name)

@@ -77,6 +77,26 @@ class AsyncProtocol:
     ) -> tuple[PyTree, int]:
         raise NotImplementedError
 
+    def server_commit_pull(
+        self, center: PyTree, num_updates: int, payload: dict, num_workers: int
+    ) -> tuple[PyTree, int, tuple[PyTree, int]]:
+        """Fused exchange: apply the commit and produce the reply in one PS
+        transition. Returns ``(new_center, new_num_updates, reply)`` where
+        ``reply = (tree, counter)`` is what the committing worker receives —
+        by default the fresh post-commit center, restoring the reference's
+        one-round-trip-per-window cadence (``distkeras/workers.py`` §
+        ``NetworkWorker`` commit+pull pair collapsed into one exchange)."""
+        new_center, new_n = self.server_commit(center, num_updates, payload, num_workers)
+        return new_center, new_n, (new_center, new_n)
+
+    def server_duplicate_reply(
+        self, center: PyTree, num_updates: int, payload: dict
+    ) -> tuple[PyTree, int]:
+        """Reply for a fused exchange whose commit was already applied (a
+        retried ``commit_pull`` caught by the PS dedupe window): nothing is
+        re-applied, but the worker still needs an answer."""
+        return center, num_updates
+
     # -- worker side ---------------------------------------------------------
 
     def local_optimizer(
@@ -96,14 +116,41 @@ class AsyncProtocol:
         raise NotImplementedError
 
 
+def _device_delta(params, base):
+    """Whole-tree ``params - base`` as one compiled dispatch when params
+    live on device (the per-window worker delta); host numpy trees keep the
+    numpy path (the PS loop must not bounce through the accelerator)."""
+    import jax
+
+    leaves = jax.tree.leaves(params)
+    if leaves and isinstance(leaves[0], jax.Array):
+        global _delta_jit
+        if _delta_jit is None:
+            _delta_jit = jax.jit(
+                lambda p, b: jax.tree.map(lambda x, y: x - y, p, b)
+            )
+        return _delta_jit(params, base)
+    return pytree_sub(params, base)
+
+
+_delta_jit = None
+
+
 class _DeltaWindowMixin:
-    """Commit accumulated window delta, then pull fresh center and rebase —
-    the DOWNPOUR/ADAG/DynSGD worker cadence (SURVEY §3.1 hot loop)."""
+    """Commit accumulated window delta and receive the fresh center in one
+    fused exchange — the DOWNPOUR/ADAG/DynSGD worker cadence (SURVEY §3.1 hot
+    loop) at the reference's one-RTT-per-window cost. Falls back to separate
+    commit + pull round trips for clients without ``commit_pull``."""
 
     def worker_window(self, params, carry, client):
-        delta = pytree_sub(params, carry.window_start)
-        client.commit({"delta": delta, "last_update": carry.last_update})
-        center, num_updates = client.pull()
+        delta = _device_delta(params, carry.window_start)
+        payload = {"delta": delta, "last_update": carry.last_update}
+        fused = getattr(client, "commit_pull", None)
+        if fused is not None:
+            center, num_updates = fused(payload)
+        else:
+            client.commit(payload)
+            center, num_updates = client.pull()
         return center, WorkerCarry(window_start=center, last_update=num_updates)
 
 
@@ -150,10 +197,41 @@ class AEASGDProtocol(AsyncProtocol):
     def server_commit(self, center, num_updates, payload, num_workers):
         return pytree_add(center, payload["delta"]), num_updates + 1
 
-    def worker_window(self, params, carry, client):
-        center, num_updates = client.pull()
+    def _elastic(self, local, center):
         alpha = self.rho * self.learning_rate
-        elastic = pytree_scale(pytree_sub(params, center), alpha)
+        return pytree_scale(pytree_sub(local, center), alpha)
+
+    def server_commit_pull(self, center, num_updates, payload, num_workers):
+        # Fused elastic exchange: the worker ships its *local* params; the
+        # PS computes the elastic force against the center it owns, applies
+        # ``center += e``, and replies with ``e`` so the worker applies
+        # ``local -= e``. Exactly the reference's pull→compute→commit
+        # semantics (``distkeras/workers.py`` § ``AEASGDWorker``) collapsed
+        # into one round trip, with both sides using the identical force.
+        if "local" in payload:
+            e = self._elastic(payload["local"], center)
+            return pytree_add(center, e), num_updates + 1, (e, num_updates)
+        new_center, new_n = self.server_commit(center, num_updates, payload, num_workers)
+        return new_center, new_n, (new_center, new_n)
+
+    def server_duplicate_reply(self, center, num_updates, payload):
+        # The original reply was lost in transit after the commit applied;
+        # recompute the force against the (post-apply) center without
+        # re-applying it.
+        if "local" in payload:
+            return self._elastic(payload["local"], center), num_updates
+        return center, num_updates
+
+    def worker_window(self, params, carry, client):
+        fused = getattr(client, "commit_pull", None)
+        if fused is not None:
+            e, num_updates = fused({"local": params, "last_update": carry.last_update})
+            new_params = pytree_sub(params, e)
+            return new_params, WorkerCarry(
+                window_start=new_params, last_update=num_updates
+            )
+        center, num_updates = client.pull()
+        elastic = self._elastic(params, center)
         new_params = pytree_sub(params, elastic)
         client.commit({"delta": elastic, "last_update": num_updates})
         return new_params, WorkerCarry(window_start=new_params, last_update=num_updates)

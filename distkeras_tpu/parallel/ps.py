@@ -37,6 +37,7 @@ PyTree = Any
 
 _PULL = "pull"
 _COMMIT = "commit"
+_COMMIT_PULL = "commit_pull"
 _STOP = "stop"
 
 
@@ -109,22 +110,50 @@ class ParameterServerService:
                 snap = jax.tree.map(np.copy, self._center)
                 reply.put((snap, self._num_updates))
             elif action == _COMMIT:
-                cid = payload.get("commit_id")
-                if cid is not None and cid in self._seen_ids:
-                    self._num_duplicates += 1
+                if self._is_duplicate(payload):
                     if reply is not None:
                         reply.put(False)
                     continue
-                if cid is not None:
-                    self._seen_ids[cid] = None
-                    while len(self._seen_ids) > self._dedupe_window:
-                        self._seen_ids.popitem(last=False)
                 self._center, self._num_updates = self.protocol.server_commit(
                     self._center, self._num_updates, payload, self.num_workers
                 )
                 self._num_commits += 1
                 if reply is not None:
                     reply.put(True)
+            elif action == _COMMIT_PULL:
+                # Fused exchange: apply + reply in one PS transition — one
+                # wire round trip per window (the reference's cadence,
+                # SURVEY §3.1). A deduped retry still gets an answer.
+                if self._is_duplicate(payload):
+                    out = self.protocol.server_duplicate_reply(
+                        self._center, self._num_updates, payload
+                    )
+                else:
+                    (
+                        self._center,
+                        self._num_updates,
+                        out,
+                    ) = self.protocol.server_commit_pull(
+                        self._center, self._num_updates, payload, self.num_workers
+                    )
+                    self._num_commits += 1
+                tree, counter = out
+                reply.put((jax.tree.map(np.copy, tree), counter))
+
+    def _is_duplicate(self, payload: dict) -> bool:
+        """Record-and-test the commit id (sole-owner loop; no locking).
+        Idempotent commits: a retried/replayed commit is applied at most
+        once (the reference's Spark-retry path was at-least-once)."""
+        cid = payload.get("commit_id")
+        if cid is None:
+            return False
+        if cid in self._seen_ids:
+            self._num_duplicates += 1
+            return True
+        self._seen_ids[cid] = None
+        while len(self._seen_ids) > self._dedupe_window:
+            self._seen_ids.popitem(last=False)
+        return False
 
     # -- introspection -------------------------------------------------------
 
@@ -182,7 +211,17 @@ class InProcessClient:
         # Fire-and-forget, like the reference's one-way commit send; device
         # arrays are materialized to host numpy before enqueue so the PS
         # never touches device buffers.
-        host_payload = {
-            k: (_to_host(v) if k == "delta" else v) for k, v in payload.items()
-        }
-        self._service._queue.put((_COMMIT, host_payload, None))
+        self._service._queue.put((_COMMIT, _host_payload(payload), None))
+
+    def commit_pull(self, payload: dict) -> tuple[PyTree, int]:
+        """Fused commit + pull: one queue round trip, one PS transition."""
+        reply: queue.Queue = queue.Queue()
+        self._service._queue.put((_COMMIT_PULL, _host_payload(payload), reply))
+        return reply.get()
+
+
+def _host_payload(payload: dict) -> dict:
+    return {
+        k: (_to_host(v) if k in ("delta", "local") else v)
+        for k, v in payload.items()
+    }

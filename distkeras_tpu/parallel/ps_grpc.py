@@ -57,8 +57,13 @@ def determine_host_address() -> str:
 
 
 # -- wire format -------------------------------------------------------------
-# pull request:   b""            -> reply: u64 num_updates | npz(center)
-# commit request: u64 last_update | npz(delta)  -> reply: b"\x01"
+# pull request:        b""            -> reply: u64 num_updates | npz(center)
+# commit request:      u64 last_update | npz(delta)  -> reply: b"\x01"
+# commit_pull request: same frame as commit -> reply: same frame as pull reply
+#
+# The commit tree may be wrapped in a dict carrying out-of-band markers as
+# extra npz leaves: "__commit_id__" (dedupe stamp) and "__local__" (the tree
+# is the worker's local params for a fused elastic exchange, not a delta).
 
 
 def _encode_pull_reply(center: Any, num_updates: int) -> bytes:
@@ -70,19 +75,37 @@ def _decode_pull_reply(data: bytes, like: Any = None) -> tuple[Any, int]:
     return deserialize_pytree(data[8:], like=like), num_updates
 
 
-def _encode_commit(delta: Any, last_update: int) -> bytes:
-    return struct.pack("<Q", last_update) + serialize_pytree(delta)
+def _encode_commit(payload: dict) -> bytes:
+    """Build the commit wire frame from a client payload dict
+    (keys: delta|local, optional commit_id, last_update)."""
+    import jax
+
+    key = "local" if "local" in payload else "delta"
+    tree = jax.tree.map(np.asarray, payload[key])
+    markers = {}
+    if "commit_id" in payload:
+        markers["__commit_id__"] = _id_to_array(payload["commit_id"])
+    if key == "local":
+        markers["__local__"] = np.ones((1,), np.uint8)
+    if markers:
+        tree = {"d": tree, **markers}
+    return struct.pack("<Q", int(payload.get("last_update", 0))) + serialize_pytree(
+        tree
+    )
 
 
 def _decode_commit(data: bytes) -> dict:
     (last_update,) = struct.unpack("<Q", data[:8])
     tree = deserialize_pytree(data[8:])
     out = {"last_update": int(last_update)}
-    if isinstance(tree, dict) and "__commit_id__" in tree:
-        out["commit_id"] = _array_to_id(tree["__commit_id__"])
-        out["delta"] = tree["d"]
-    else:
-        out["delta"] = tree
+    key = "delta"
+    if isinstance(tree, dict) and ("__commit_id__" in tree or "__local__" in tree):
+        if "__commit_id__" in tree:
+            out["commit_id"] = _array_to_id(tree["__commit_id__"])
+        if "__local__" in tree:
+            key = "local"
+        tree = tree["d"]
+    out[key] = tree
     return out
 
 
@@ -97,13 +120,29 @@ class GrpcParameterServer:
         final = ps.get_model(); ps.stop()
     """
 
-    def __init__(self, protocol, center, num_workers, host="0.0.0.0", port=DEFAULT_PORT):
+    def __init__(
+        self,
+        protocol,
+        center,
+        num_workers,
+        host: str = "127.0.0.1",
+        port: int = DEFAULT_PORT,
+        max_message_mb: int = 1024,
+    ):
+        """``host`` defaults to loopback: the PS speaks an unauthenticated
+        protocol, so exposing it beyond the host must be an explicit choice
+        (``host="0.0.0.0"``) made only on an isolated/trusted network — an
+        open PS port lets anyone pull weights or poison training with
+        arbitrary deltas. ``max_message_mb`` bounds frame size (commit frames
+        scale with model size; 1 GiB covers multi-hundred-M-param models
+        while still rejecting pathological frames)."""
         import grpc
 
         self._grpc = grpc
         self.service = ParameterServerService(protocol, center, num_workers)
         self._host = host
         self._port = port
+        self._max_message_bytes = int(max_message_mb) * 1024 * 1024
         self._server = None
 
     def _handle(self, method: str):
@@ -118,12 +157,21 @@ class GrpcParameterServer:
             inproc.commit(_decode_commit(request))
             return b"\x01"
 
+        def commit_pull(request: bytes, context) -> bytes:
+            tree, num_updates = inproc.commit_pull(_decode_commit(request))
+            return _encode_pull_reply(tree, num_updates)
+
         def health(request: bytes, context) -> bytes:
             import json
 
             return json.dumps(self.service.health()).encode()
 
-        fn = {"pull": pull, "commit": commit, "health": health}.get(method)
+        fn = {
+            "pull": pull,
+            "commit": commit,
+            "commit_pull": commit_pull,
+            "health": health,
+        }.get(method)
         if fn is None:
             return None
         return grpc.unary_unary_rpc_method_handler(
@@ -145,8 +193,8 @@ class GrpcParameterServer:
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=8),
             options=[
-                ("grpc.max_receive_message_length", -1),
-                ("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", self._max_message_bytes),
+                ("grpc.max_send_message_length", self._max_message_bytes),
             ],
         )
         self._server.add_generic_rpc_handlers((Handler(),))
@@ -179,17 +227,19 @@ class GrpcClient:
         port: int = DEFAULT_PORT,
         like: Any = None,
         rpc_timeout_s: float = 120.0,
+        max_message_mb: int = 1024,
     ):
         # Every RPC carries a deadline: a wedged-but-alive PS must surface as
         # an error the HA retry layer can act on, not an eternal block.
         self._rpc_timeout_s = float(rpc_timeout_s)
         import grpc
 
+        max_bytes = int(max_message_mb) * 1024 * 1024
         self._channel = grpc.insecure_channel(
             f"{host}:{port}",
             options=[
-                ("grpc.max_receive_message_length", -1),
-                ("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", max_bytes),
+                ("grpc.max_send_message_length", max_bytes),
             ],
         )
         self._pull = self._channel.unary_unary(
@@ -199,6 +249,11 @@ class GrpcClient:
         )
         self._commit = self._channel.unary_unary(
             f"/{_SERVICE}/commit",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        self._commit_pull = self._channel.unary_unary(
+            f"/{_SERVICE}/commit_pull",
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
@@ -215,16 +270,13 @@ class GrpcClient:
         )
 
     def commit(self, payload: dict) -> None:
-        import jax
+        self._commit(_encode_commit(payload), timeout=self._rpc_timeout_s)
 
-        delta = jax.tree.map(np.asarray, payload["delta"])
-        # commit_id rides as an extra npz leaf so the frame format is stable
-        if "commit_id" in payload:
-            delta = {"__commit_id__": _id_to_array(payload["commit_id"]), "d": delta}
-        self._commit(
-            _encode_commit(delta, int(payload.get("last_update", 0))),
-            timeout=self._rpc_timeout_s,
-        )
+    def commit_pull(self, payload: dict) -> tuple[Any, int]:
+        """Fused commit+pull: one wire round trip per window (the reference's
+        cadence over its socket PS — SURVEY §3.1)."""
+        reply = self._commit_pull(_encode_commit(payload), timeout=self._rpc_timeout_s)
+        return _decode_pull_reply(reply, like=self._like)
 
     def health(self, timeout: float = 5.0) -> dict:
         import json

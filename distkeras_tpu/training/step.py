@@ -23,7 +23,13 @@ from distkeras_tpu.models.core import Model
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.metrics import accuracy as accuracy_metric
 
-__all__ = ["TrainState", "make_train_step", "make_eval_step", "apply_aux_loss"]
+__all__ = [
+    "TrainState",
+    "make_train_step",
+    "make_window_train_step",
+    "make_eval_step",
+    "apply_aux_loss",
+]
 
 
 def apply_aux_loss(task_loss, new_model_state: dict, weight: float):
@@ -190,6 +196,36 @@ def make_train_step(
     if jit:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
     return step
+
+
+def make_window_train_step(
+    model: Model,
+    optimizer: optax.GradientTransformation,
+    loss: str | Callable,
+    metrics: tuple[str, ...] = ("accuracy",),
+    donate: bool = False,
+    **step_kwargs,
+):
+    """Build ``window(state, batches) -> (state, metrics)`` where ``batches``
+    holds a whole communication window stacked on a leading axis
+    (``{"features": [W, B, ...], "label": [W, B, ...]}``) and the W steps run
+    as ONE ``lax.scan`` inside ONE compiled program.
+
+    This is the async-worker hot loop (reference ``distkeras/workers.py`` §
+    ``Worker.train``: W ``train_on_batch`` calls between PS round trips)
+    collapsed to a single XLA dispatch: one host→device launch per window
+    instead of per batch, so the Python thread is free (and the GIL
+    released) for the overlapped PS exchange while the device crunches the
+    window. Metrics come back stacked ``[W]`` per key.
+    """
+    base = make_train_step(
+        model, optimizer, loss, metrics, jit=False, donate=False, **step_kwargs
+    )
+
+    def window(state: TrainState, batches: dict) -> tuple[TrainState, dict]:
+        return jax.lax.scan(base, state, batches)
+
+    return jax.jit(window, donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(model: Model, loss: str | Callable | None = None, jit: bool = True):
