@@ -45,6 +45,7 @@ from distkeras_tpu.training.trainers import (
     SynchronousDistributedTrainer,
     Trainer,
 )
+from distkeras_tpu.training.pipeline_trainer import PipelineTrainer
 from distkeras_tpu.inference.predictors import (
     EnsemblePredictor,
     ModelPredictor,
@@ -66,6 +67,7 @@ __all__ = [
     "EnsembleTrainer",
     "AveragingTrainer",
     "SynchronousDistributedTrainer",
+    "PipelineTrainer",
     "DOWNPOUR",
     "ADAG",
     "AEASGD",

@@ -42,9 +42,11 @@ class BertConfig:
     # Use the Pallas flash-attention kernel (ops/pallas/flash_attention.py)
     # instead of dense attention. Unmasked attention only.
     use_flash_attention: bool = False
-    # > 0 replaces each dense MLP block with a top-1 MoE of this many
+    # > 0 replaces each dense MLP block with a routed MoE of this many
     # experts (ops/moe.py; expert weights shard over the ep mesh axis).
     moe_experts: int = 0
+    # Experts per token: 1 = Switch-style, 2 = GShard top-2 routing.
+    moe_top_k: int = 1
     # Causal (decoder/GPT-style) attention masking.
     causal: bool = False
     # Sequence-parallel attention: a jax.sharding.Mesh (hashable, so valid
@@ -121,6 +123,7 @@ class EncoderLayer(nn.Module):
                 mlp_dim=cfg.mlp_dim,
                 dtype=cfg.dtype,
                 residual=False,
+                router_top_k=cfg.moe_top_k,
                 name="moe_mlp",
             )(y, train=train)
         else:
@@ -257,11 +260,16 @@ def gpt_small(seq_len: int = 512, vocab_size: int = 50257) -> Model:
 
 
 def bert_tiny_moe_mlm(
-    seq_len: int = 64, vocab_size: int = 1024, num_experts: int = 4
+    seq_len: int = 64,
+    vocab_size: int = 1024,
+    num_experts: int = 4,
+    top_k: int = 1,
 ) -> Model:
-    """MoE variant: each MLP block is a top-1 expert mixture (ep-shardable)."""
+    """MoE variant: each MLP block is a routed expert mixture
+    (ep-shardable); ``top_k=2`` selects GShard top-2 routing."""
     cfg = BertConfig(
         vocab_size=vocab_size, hidden_size=128, num_layers=2, num_heads=4,
         mlp_dim=512, max_seq_len=max(seq_len, 64), moe_experts=num_experts,
+        moe_top_k=top_k,
     )
-    return _make(cfg, seq_len, "bert_tiny_moe_mlm")
+    return _make(cfg, seq_len, f"bert_tiny_moe{'_top2' if top_k == 2 else ''}_mlm")

@@ -1,16 +1,22 @@
 """Mixture-of-Experts with expert parallelism (``ep`` mesh axis).
 
-GShard-style top-1 routed MoE MLP: tokens are dispatched to experts through
-a capacity-bounded one-hot dispatch tensor, each expert runs a dense MLP
+GShard-style routed MoE MLP: tokens are dispatched to experts through a
+capacity-bounded one-hot dispatch tensor, each expert runs a dense MLP
 over its ``[capacity, d_model]`` slab (one big batched matmul on the MXU),
 and outputs are combined with the router gate weights. Expert weight
 tensors carry the ``"expert"`` logical axis, which the sharding rules map
 to the mesh's ``ep`` axis — under jit, XLA inserts the token all-to-all
 between data and expert layouts from the sharding constraints alone.
 
-Dropped tokens (expert over capacity) pass through the residual unchanged,
-as in GShard/Switch. The reference framework has nothing comparable
-(SURVEY §2: EP absent); this closes the ``ep`` axis of the mesh design.
+``router_top_k`` selects Switch-style top-1 (default) or GShard top-2
+routing. Top-2: each token goes to its two highest-gate experts with the
+two gate values renormalized to sum to 1; second choices queue *behind*
+all first choices in each expert's capacity buffer, so under congestion
+second choices are dropped first (capacity-aware combine). Dropped
+assignments contribute nothing — a token dropped by both experts passes
+through the residual unchanged, as in GShard/Switch. The reference
+framework has nothing comparable (SURVEY §2: EP absent); this closes the
+``ep`` axis of the mesh design.
 """
 
 from __future__ import annotations
@@ -38,13 +44,22 @@ class MoEMLP(nn.Module):
     # Include the residual add (x + moe(x)). Set False when the caller owns
     # the residual stream (e.g. a transformer block adding around LayerNorm).
     residual: bool = True
+    # 1 = Switch-style single expert per token; 2 = GShard top-2 with
+    # renormalized gates and second choices dropped first under congestion.
+    router_top_k: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         B, S, D = x.shape
         E = self.num_experts
         T = B * S
-        capacity = max(1, int(T / E * self.capacity_factor))
+        if self.router_top_k not in (1, 2):
+            raise ValueError(f"router_top_k must be 1 or 2, got {self.router_top_k}")
+        # Top-2 sends up to 2T assignments into the buffers; scale capacity
+        # so the same capacity_factor keeps the same drop behavior.
+        capacity = max(
+            1, int(T / E * self.capacity_factor * self.router_top_k)
+        )
 
         tokens = x.reshape(T, D)
         router_kernel = self.param(
@@ -56,28 +71,53 @@ class MoEMLP(nn.Module):
         gates = jax.nn.softmax(
             tokens.astype(jnp.float32) @ router_kernel, axis=-1
         )  # [T, E]
-        expert_idx = jnp.argmax(gates, axis=-1)  # [T]
+        expert_idx = jnp.argmax(gates, axis=-1)  # [T] first choice
         gate_val = jnp.take_along_axis(gates, expert_idx[:, None], axis=-1)[:, 0]
 
         # Switch-style load-balancing auxiliary loss: E * Σ_e f_e · P_e,
-        # where f_e is the fraction of tokens routed to expert e and P_e the
-        # mean router probability. Minimized (=1) at uniform routing. Sown
-        # into the "aux_loss" collection; the step engines add it to the
-        # task loss when present.
+        # where f_e is the fraction of (first-choice) tokens routed to
+        # expert e and P_e the mean router probability. Minimized (=1) at
+        # uniform routing. Sown into the "aux_loss" collection; the step
+        # engines add it to the task loss when present.
         frac = jnp.mean(jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=0)
         prob = jnp.mean(gates, axis=0)
         self.sow("aux_loss", "load_balance", E * jnp.sum(frac * prob))
 
         onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
-        # position of each token within its expert's queue
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]
-        keep = (pos < capacity) * onehot  # [T, E] tokens within capacity
-        pos_clamped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
-        pos_onehot = jax.nn.one_hot(
-            (pos_clamped * onehot.astype(jnp.int32)).sum(-1), capacity, dtype=jnp.float32
-        )  # [T, C]
-        dispatch = keep[:, :, None] * pos_onehot[:, None, :]  # [T, E, C]
-        combine = dispatch * gate_val[:, None, None]  # [T, E, C]
+
+        def _dispatch_for(onehot_k, base_count):
+            """Queue positions for one choice rank; ``base_count`` [E] seats
+            already taken by higher-priority ranks."""
+            pos = (jnp.cumsum(onehot_k, axis=0) - 1.0) * onehot_k
+            pos = pos + base_count[None, :] * onehot_k
+            keep = (pos < capacity) * onehot_k  # [T, E] within capacity
+            pos_clamped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+            pos_onehot = jax.nn.one_hot(
+                (pos_clamped * onehot_k.astype(jnp.int32)).sum(-1),
+                capacity,
+                dtype=jnp.float32,
+            )  # [T, C]
+            return keep[:, :, None] * pos_onehot[:, None, :]  # [T, E, C]
+
+        if self.router_top_k == 1:
+            dispatch = _dispatch_for(onehot, jnp.zeros((E,), jnp.float32))
+            combine = dispatch * gate_val[:, None, None]
+        else:
+            # Second choice: argmax with the first choice masked out.
+            gates2 = gates * (1.0 - onehot)
+            expert_idx2 = jnp.argmax(gates2, axis=-1)  # [T]
+            gate_val2 = jnp.take_along_axis(gates, expert_idx2[:, None], axis=-1)[:, 0]
+            onehot2 = jax.nn.one_hot(expert_idx2, E, dtype=jnp.float32)
+            # Renormalize the two winning gates to sum to 1 (GShard).
+            denom = gate_val + gate_val2 + 1e-9
+            g1 = gate_val / denom
+            g2 = gate_val2 / denom
+            # All first choices seat before any second choice per expert.
+            count1 = jnp.sum(onehot, axis=0)  # [E]
+            d1 = _dispatch_for(onehot, jnp.zeros((E,), jnp.float32))
+            d2 = _dispatch_for(onehot2, count1)
+            dispatch = d1 + d2
+            combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
 
         w_in = self.param(
             "w_in",
@@ -111,16 +151,31 @@ class MoEMLP(nn.Module):
         return x + y if self.residual else y
 
     @staticmethod
-    def reference_forward(variables, x):
-        """Per-token gather reference (no dispatch tensors) for testing."""
+    def reference_forward(variables, x, top_k: int = 1):
+        """Per-token gather reference (no dispatch tensors, no capacity
+        drops) for testing."""
         p = variables["params"]
         B, S, D = x.shape
         tokens = x.reshape(-1, D).astype(jnp.float32)
         gates = jax.nn.softmax(tokens @ p["router"], axis=-1)
-        idx = jnp.argmax(gates, axis=-1)
-        gate = jnp.take_along_axis(gates, idx[:, None], axis=-1)[:, 0]
-        w_in = p["w_in"][idx]  # [T, D, M]
-        w_out = p["w_out"][idx]  # [T, M, D]
-        h = nn.gelu(jnp.einsum("td,tdm->tm", tokens, w_in))
-        y = jnp.einsum("tm,tmd->td", h, w_out) * gate[:, None]
+
+        def expert_out(idx):
+            w_in = p["w_in"][idx]  # [T, D, M]
+            w_out = p["w_out"][idx]  # [T, M, D]
+            h = nn.gelu(jnp.einsum("td,tdm->tm", tokens, w_in))
+            return jnp.einsum("tm,tmd->td", h, w_out)
+
+        idx1 = jnp.argmax(gates, axis=-1)
+        g1 = jnp.take_along_axis(gates, idx1[:, None], axis=-1)[:, 0]
+        if top_k == 1:
+            y = expert_out(idx1) * g1[:, None]
+        else:
+            masked = gates * (1.0 - jax.nn.one_hot(idx1, gates.shape[-1]))
+            idx2 = jnp.argmax(masked, axis=-1)
+            g2 = jnp.take_along_axis(gates, idx2[:, None], axis=-1)[:, 0]
+            denom = g1 + g2 + 1e-9
+            y = (
+                expert_out(idx1) * (g1 / denom)[:, None]
+                + expert_out(idx2) * (g2 / denom)[:, None]
+            )
         return x + y.reshape(B, S, D).astype(x.dtype)

@@ -35,14 +35,25 @@ def stack_stage_params(stage_params_list):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
 
 
+def _io_spec(mesh: Mesh) -> P:
+    """Microbatch spec ``[M, B, ...]``: shard the batch axis over ``dp``
+    when the mesh has one (each dp slice runs its own pipeline replica over
+    the pp axis) instead of replicating the whole feed to every device."""
+    if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+        return P(None, "dp")
+    return P()
+
+
 def pipeline_shardings(mesh: Mesh):
     """(stacked_params_sharding, io_sharding) for :func:`pipeline_apply`."""
     params = NamedSharding(mesh, P("pp"))
-    io = NamedSharding(mesh, P())  # microbatches replicated; refine as needed
+    io = NamedSharding(mesh, _io_spec(mesh))
     return params, io
 
 
-def _pipeline_local(stage_fn, stacked_params, microbatches, axis_name: str):
+def _pipeline_local(
+    stage_fn, stacked_params, microbatches, axis_name: str, varying_axes=()
+):
     """Per-device body (inside shard_map).
 
     ``stacked_params``: this device's stage params ([1, ...] leaves —
@@ -58,9 +69,11 @@ def _pipeline_local(stage_fn, stacked_params, microbatches, axis_name: str):
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
     # The carry must be device-varying over the pp axis from the start
-    # (ppermute outputs are varying; scan carries must type-match).
+    # (ppermute outputs are varying; scan carries must type-match) — and
+    # over any axis the microbatches are sharded on (dp io sharding makes
+    # the ingested state dp-varying too).
     zeros = jnp.zeros((B, *feat_shape), microbatches.dtype)
-    state = lax.pcast(zeros, axis_name, to="varying")
+    state = lax.pcast(zeros, (axis_name, *varying_axes), to="varying")
 
     def tick(carry, t):
         state = carry
@@ -89,25 +102,40 @@ def pipeline_apply(
     microbatches,
     mesh: Mesh,
     axis_name: str = "pp",
+    io_spec: P | None = None,
 ):
     """Run a P-stage pipeline over ``mesh[axis_name]``.
 
     - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``;
     - ``stacked_params``: PyTree with leading stage axis (see
       :func:`stack_stage_params`), sharded over ``axis_name``;
-    - ``microbatches``: ``[M, B, ...]`` array.
+    - ``microbatches``: ``[M, B, ...]`` array. By default the batch axis
+      shards over the mesh's ``dp`` axis when present (each dp slice runs
+      its own pipeline replica); pass ``io_spec`` to override.
 
-    Returns ``[M, B, ...]`` — the final stage's outputs, replicated.
-    Differentiable end-to-end.
+    Returns ``[M, B, ...]`` — the final stage's outputs. Differentiable
+    end-to-end.
     """
     from jax import shard_map
 
+    if io_spec is None:
+        io_spec = _io_spec(mesh)
+    varying_axes = tuple(
+        ax
+        for entry in io_spec
+        if entry is not None
+        for ax in ((entry,) if isinstance(entry, str) else tuple(entry))
+        if ax != axis_name
+    )
     spec_params = P(axis_name)
     fn = shard_map(
-        partial(_pipeline_local, stage_fn, axis_name=axis_name),
+        partial(
+            _pipeline_local, stage_fn, axis_name=axis_name,
+            varying_axes=varying_axes,
+        ),
         mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: spec_params, stacked_params), P()),
-        out_specs=P(),
+        in_specs=(jax.tree.map(lambda _: spec_params, stacked_params), io_spec),
+        out_specs=io_spec,
     )
     if microbatches.shape[0] < 1:
         raise ValueError("need at least one microbatch")

@@ -75,6 +75,9 @@ class ParameterServerService:
         self._queue: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self.running = False
+        # Incremented by the trainer's snapshot loop on checkpoint failures;
+        # surfaced through health() so a dead snapshot loop is visible.
+        self.snapshot_failures = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -189,6 +192,7 @@ class ParameterServerService:
             "num_commits": self._num_commits,
             "num_duplicates": self._num_duplicates,
             "queue_depth": self._queue.qsize(),
+            "snapshot_failures": self.snapshot_failures,
         }
 
     def client(self) -> "InProcessClient":

@@ -658,8 +658,11 @@ class AsynchronousDistributedTrainer(Trainer):
                 center_init = restored["ps"]["center"]
         ps = self.service(center_init)
         if ckpt_mgr is not None:
+            import logging
+
             svc = self.parameter_server
             stop_ckpt = threading.Event()
+            log = logging.getLogger(__name__)
 
             def _periodic_checkpoint():
                 while not stop_ckpt.wait(self.checkpoint_interval_s):
@@ -670,7 +673,18 @@ class AsynchronousDistributedTrainer(Trainer):
                             ps_num_updates=svc.num_updates,
                         )
                     except Exception:
-                        pass  # snapshotting must never take down training
+                        # Snapshotting must never take down training — but a
+                        # permanently failing snapshot loop is silent data
+                        # loss at restore time: log the first failure with
+                        # traceback, count the rest, surface in health().
+                        svc.snapshot_failures += 1
+                        if svc.snapshot_failures == 1:
+                            log.exception("PS checkpoint snapshot failed")
+                        else:
+                            log.warning(
+                                "PS checkpoint snapshot failed (%d so far)",
+                                svc.snapshot_failures,
+                            )
 
             ckpt_thread = threading.Thread(
                 target=_periodic_checkpoint, name="ps-checkpoint", daemon=True

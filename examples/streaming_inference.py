@@ -2,16 +2,15 @@
 (``examples/`` Kafka producer + streaming-inference notebook).
 
 The reference consumed a Kafka topic inside Spark streaming, ran the model
-per micro-batch, and wrote predictions back. Without Kafka, the same shape
-is a producer thread feeding a queue and a consumer loop running the jitted
-predictor per micro-batch — swap the queue for a Kafka consumer in
-production, nothing else changes.
+per micro-batch, and wrote predictions back. Here a producer process
+streams framed micro-batches over TCP into a
+:class:`~distkeras_tpu.data.streaming.SocketSource`; swap it for
+``KafkaSource`` against a real broker and nothing else changes.
 
 Run: python examples/streaming_inference.py [--batches 20]
 """
 
 import argparse
-import queue
 import threading
 import time
 
@@ -19,16 +18,6 @@ import numpy as np
 
 import distkeras_tpu as dk
 from distkeras_tpu.models import mnist_mlp
-
-
-def producer(q: queue.Queue, batches: int, batch_size: int, stop):
-    rng = np.random.default_rng(1)
-    for i in range(batches):
-        if stop.is_set():
-            break
-        q.put(rng.uniform(0, 1, size=(batch_size, 784)).astype(np.float32))
-        time.sleep(0.01)  # simulated arrival cadence
-    q.put(None)  # end-of-stream
 
 
 def main():
@@ -48,29 +37,42 @@ def main():
     trained = dk.SingleTrainer(
         mnist_mlp(), worker_optimizer="adam", batch_size=128, num_epoch=1
     ).train(ds)
-    predictor = dk.ModelPredictor(trained, batch_size=args.batch_size)
 
-    q: queue.Queue = queue.Queue(maxsize=8)
-    stop = threading.Event()
-    t = threading.Thread(target=producer, args=(q, args.batches, args.batch_size, stop))
+    # The broker-shaped path: a producer streams framed batches over TCP
+    # into a SocketSource (swap for KafkaSource against a real broker);
+    # StreamingPredictor pads each micro-batch to one fixed XLA shape.
+    import socket as socketlib
+
+    from distkeras_tpu.data.streaming import (
+        SocketSource,
+        StreamingPredictor,
+        send_stream_batch,
+    )
+
+    src = SocketSource(port=0)
+
+    def tcp_producer():
+        s = socketlib.create_connection((src.host, src.port))
+        rng2 = np.random.default_rng(1)
+        for _ in range(args.batches):
+            send_stream_batch(
+                s, rng2.uniform(0, 1, size=(args.batch_size, 784)).astype(np.float32)
+            )
+            time.sleep(0.01)  # simulated arrival cadence
+        send_stream_batch(s, None)
+        s.close()
+
+    t = threading.Thread(target=tcp_producer, daemon=True)
     t.start()
 
-    done, t0 = 0, time.time()
-    latencies = []
-    while True:
-        chunk = q.get()
-        if chunk is None:
-            break
-        t1 = time.time()
-        out = predictor.predict(dk.Dataset.from_arrays(features=chunk))
-        idx = dk.LabelIndexTransformer(input_col="prediction").transform(out)
-        _ = idx["prediction_index"]
-        latencies.append(time.time() - t1)
-        done += 1
-    t.join()
-    wall = time.time() - t0
-    print(f"streamed {done} micro-batches ({done * args.batch_size} rows) "
-          f"in {wall:.2f}s; p50 latency {sorted(latencies)[len(latencies)//2]*1e3:.1f}ms")
+    def sink(x, preds):
+        _ = preds.argmax(-1)  # LabelIndex step of the reference notebook
+
+    stats = StreamingPredictor(trained, max_batch=args.batch_size).run(src, sink)
+    t.join(timeout=30)
+    print(f"streamed {stats['batches']} micro-batches ({stats['rows']} rows) "
+          f"in {stats['wall_s']:.2f}s over TCP; "
+          f"{stats['rows_per_sec']:.0f} rows/s")
 
 
 if __name__ == "__main__":

@@ -29,12 +29,15 @@ def test_golden_single_trainer(golden_problem):
                          batch_size=32, num_epoch=5, seed=7)
     trained = t.train(golden_problem, shuffle=True)
     hist = t.get_history()
-    # recorded 2026-07-29 (jax 0.9.0, CPU): loss 0.043859, acc 1.0
-    assert hist[-1]["loss"] == pytest.approx(0.043859, abs=0.02)
-    assert hist[-1]["accuracy"] >= 0.97
+    # recorded 2026-07-29 (jax 0.9.0, CPU): loss 0.0438593, acc 1.0.
+    # ~5% relative tolerance: survives XLA fusion-order drift across
+    # versions, catches any semantic change (rng threading, shuffle order,
+    # optimizer wiring) — those shift the loss by far more.
+    assert hist[-1]["loss"] == pytest.approx(0.0438593, rel=0.05)
+    assert hist[-1]["accuracy"] >= 0.99
     m = t.evaluate(trained, golden_problem)
-    assert m["accuracy"] == pytest.approx(0.998047, abs=0.01)
-    assert m["loss"] == pytest.approx(0.050688, abs=0.02)
+    assert m["accuracy"] == pytest.approx(0.998047, abs=0.004)
+    assert m["loss"] == pytest.approx(0.0506882, rel=0.05)
 
 
 def test_golden_deterministic_across_runs(golden_problem):

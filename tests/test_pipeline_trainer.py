@@ -1,0 +1,130 @@
+"""PipelineTrainer: pp as a trainer capability (VERDICT r1 weakness 7).
+
+Covers: (a) gradient equivalence of the pipelined forward vs the plain
+sequential model, (b) end-to-end pp(+dp) training reaching parity accuracy
+with the dp path on the same model/data, (c) params round-trip back to the
+standard layout so the returned TrainedModel predicts.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models.bert import BertConfig, _make
+
+VOCAB, SEQ = 64, 16
+
+
+def _tiny_model():
+    # dropout 0: the pipelined trunk is deterministic (no per-stage rng
+    # streams), so exact-parity checks need the plain path deterministic too.
+    cfg = BertConfig(
+        vocab_size=VOCAB, hidden_size=32, num_layers=2, num_heads=2,
+        mlp_dim=64, max_seq_len=SEQ, dropout_rate=0.0,
+    )
+    return _make(cfg, SEQ, "bert_pico")
+
+
+def _copy_task(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, VOCAB, size=(n, SEQ)).astype(np.int32)
+    return dk.Dataset.from_arrays(features=x, label=x)
+
+
+@pytest.mark.parametrize("remat", [False, True], ids=["plain", "remat"])
+def test_pipeline_forward_matches_sequential(remat):
+    model = _tiny_model()
+    trainer = dk.PipelineTrainer(model, num_stages=2, num_microbatches=2,
+                                 batch_size=8, remat=remat)
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    variables = model.init(0)
+    train_params, per_stage = trainer._split_params(variables["params"], 2)
+    forward = trainer._make_forward(mesh, per_stage)
+
+    rng = np.random.default_rng(1)
+    batch = {
+        "features": rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32),
+        "label": rng.integers(0, VOCAB, size=(8, SEQ)).astype(np.int32),
+    }
+    loss_pp, _ = forward(train_params, batch)
+
+    def plain_loss(params):
+        from distkeras_tpu.ops.losses import get_loss
+
+        logits, _ = model.apply({"params": params}, batch["features"], train=False)
+        return get_loss("categorical_crossentropy")(logits, batch["label"])
+
+    loss_plain = plain_loss(variables["params"])
+    np.testing.assert_allclose(
+        float(loss_pp), float(loss_plain), rtol=2e-2, atol=2e-2
+    )
+
+    # Gradient equivalence on the first stage's attention query kernel and
+    # the (non-pipelined) embedding.
+    g_pp = jax.grad(lambda tp: forward(tp, batch)[0])(train_params)
+    g_plain = jax.grad(plain_loss)(variables["params"])
+    np.testing.assert_allclose(
+        np.asarray(g_pp["rest"]["token_embed"]["embedding"], np.float32),
+        np.asarray(g_plain["token_embed"]["embedding"], np.float32),
+        rtol=5e-2, atol=5e-3,
+    )
+    q_pp = np.asarray(
+        g_pp["stages"]["sub_0"]["attention"]["query"]["kernel"], np.float32
+    )
+    np.testing.assert_allclose(
+        q_pp[0],
+        np.asarray(g_plain["layer_0"]["attention"]["query"]["kernel"], np.float32),
+        rtol=5e-2, atol=5e-3,
+    )
+    np.testing.assert_allclose(
+        q_pp[1],
+        np.asarray(g_plain["layer_1"]["attention"]["query"]["kernel"], np.float32),
+        rtol=5e-2, atol=5e-3,
+    )
+
+
+def test_pipeline_training_parity_with_dp():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device mesh")
+    ds = _copy_task(256)
+    kwargs = dict(worker_optimizer="adam", learning_rate=3e-3, num_epoch=12, seed=0)
+    pp = dk.PipelineTrainer(
+        _tiny_model(), num_stages=2, num_microbatches=4, batch_size=64, **kwargs
+    )  # auto mesh: dp=4 x pp=2 over 8 devices
+    trained_pp = pp.train(ds)
+    # Same GLOBAL batch of 64 (sync batch_size is per-worker: 8 x 8 devices).
+    # NOTE: per-device batch 4 on this model hits a flaky XLA:CPU
+    # ThunkExecutor abort on the virtual mesh (pre-existing, dp-only, not
+    # TPU-relevant) — keep the per-device batch at 8.
+    dp = dk.SynchronousDistributedTrainer(_tiny_model(), batch_size=8, **kwargs)
+    trained_dp = dp.train(ds)
+
+    acc_pp = pp.get_averaged_history()["accuracy"]
+    acc_dp = dp.get_averaged_history()["accuracy"]
+    # Both learn the copy task; pp matches dp within noise.
+    assert pp.history[-1]["loss"] < pp.history[0]["loss"] * 0.5
+    assert abs(acc_pp - acc_dp) < 0.15, (acc_pp, acc_dp)
+
+    # Round-tripped params predict in the standard layout.
+    x = np.asarray(ds["features"][:4])
+    preds = trained_pp.predict(x)
+    assert preds.shape == (4, SEQ, VOCAB)
+    assert np.isfinite(preds).all()
+    assert np.isfinite(trained_dp.predict(x)).all()
+
+
+def test_pipeline_rejects_bad_shapes():
+    model = _tiny_model()
+    with pytest.raises(ValueError, match="not divisible into"):
+        t = dk.PipelineTrainer(model, num_stages=2, num_microbatches=3,
+                               batch_size=32)
+        t._split_params(model.init(0)["params"], 3)  # 2 layers / 3 stages
+    with pytest.raises(ValueError, match="needs a transformer-family"):
+        from distkeras_tpu.models.mlp import mnist_mlp
+
+        dk.PipelineTrainer(mnist_mlp())
