@@ -17,7 +17,7 @@ import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
 
-__all__ = ["minibatches", "window_batches", "DeviceFeed"]
+__all__ = ["minibatches", "window_batches", "index_windows", "DeviceFeed"]
 
 Batch = dict[str, np.ndarray]
 
@@ -76,6 +76,45 @@ def window_batches(batches: Iterator[Batch], window: int) -> Iterator[Batch]:
             buf = []
     for b in buf:
         yield _stack([b])
+
+
+def index_windows(
+    n: int,
+    batch_size: int,
+    window: int,
+    num_epoch: int = 1,
+    seed: int | None = None,
+) -> Iterator[np.ndarray]:
+    """Yield ``[W, B]`` int32 row-index arrays with the same cadence as
+    ``window_batches(minibatches(...))`` — per-epoch reshuffle when seeded,
+    dropped remainder, tail emitted as ``[1, B]`` singles. For the
+    device-cached feed: the data lives in HBM whole and only these index
+    arrays (W·B·4 bytes) cross the host→device boundary per window."""
+
+    if n < batch_size:
+        # Same contract as minibatches(drop_remainder=True): a too-small
+        # partition is an explicit error, never a silent zero-step worker.
+        raise ValueError(f"partition of {n} rows < batch_size {batch_size}")
+
+    def batches():
+        for epoch in range(num_epoch):
+            order = (
+                np.random.default_rng(seed + epoch).permutation(n)
+                if seed is not None
+                else np.arange(n)
+            )
+            stop = (n // batch_size) * batch_size
+            for lo in range(0, stop, batch_size):
+                yield order[lo : lo + batch_size].astype(np.int32)
+
+    buf: list[np.ndarray] = []
+    for b in batches():
+        buf.append(b)
+        if len(buf) == window:
+            yield np.stack(buf)
+            buf = []
+    for b in buf:
+        yield b[None]
 
 
 class DeviceFeed:

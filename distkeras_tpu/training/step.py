@@ -228,6 +228,40 @@ def make_window_train_step(
     return jax.jit(window, donate_argnums=(0,) if donate else ())
 
 
+def make_cached_window_train_step(
+    model: Model,
+    optimizer: optax.GradientTransformation,
+    loss: str | Callable,
+    metrics: tuple[str, ...] = ("accuracy",),
+    donate: bool = False,
+    **step_kwargs,
+):
+    """Window step over a device-resident dataset: ``window(state, xcol,
+    ycol, idx)`` where ``xcol``/``ycol`` are the WHOLE partition living in
+    HBM and ``idx`` is ``[W, B]`` int32 row indices (shuffling = a fresh
+    permutation on the host, bytes-per-window = W·B·4 instead of the full
+    batch tensors). The scan body gathers its minibatch on device — zero
+    host→HBM feature traffic in the steady state. Worth it whenever the
+    partition fits HBM comfortably (MNIST/CIFAR-scale; the async trainers
+    auto-enable it under ``device_cache="auto"``).
+    """
+    base = make_train_step(
+        model, optimizer, loss, metrics, jit=False, donate=False, **step_kwargs
+    )
+
+    def window(state: TrainState, xcol, ycol, idx) -> tuple[TrainState, dict]:
+        def body(s, ix):
+            batch = {
+                "features": jnp.take(xcol, ix, axis=0),
+                "label": jnp.take(ycol, ix, axis=0),
+            }
+            return base(s, batch)
+
+        return jax.lax.scan(body, state, idx)
+
+    return jax.jit(window, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(model: Model, loss: str | Callable | None = None, jit: bool = True):
     """Build ``eval_step(variables, batch) -> metrics_dict`` (no grad)."""
     loss_fn = get_loss(loss) if loss is not None else None

@@ -36,7 +36,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
-from distkeras_tpu.data.feed import DeviceFeed, minibatches, window_batches
+from distkeras_tpu.data.feed import (
+    DeviceFeed,
+    index_windows as _index_windows,
+    minibatches,
+    window_batches,
+)
 from distkeras_tpu.models.core import Model, TrainedModel
 from distkeras_tpu.ops.losses import get_optimizer
 from distkeras_tpu.parallel.mesh import best_mesh, data_parallel_shardings
@@ -51,6 +56,7 @@ from distkeras_tpu.parallel.protocols import (
 from distkeras_tpu.parallel.ps import ParameterServerService
 from distkeras_tpu.training.step import (
     TrainState,
+    make_cached_window_train_step,
     make_train_step,
     make_window_train_step,
 )
@@ -309,19 +315,29 @@ class _VmappedReplicasTrainer(Trainer):
         )
         vstep = jax.jit(jax.vmap(step_fn), donate_argnums=(0,))
 
+        # Pad the replica axis up to a device-count multiple so the stack
+        # ALWAYS shards over devices (round 1 fell back to one device with
+        # N× memory whenever N % ndev != 0); padded replicas train on
+        # recycled partitions and are dropped at unstack time.
+        devices = jax.devices()
+        ndev = len(devices)
+        n_padded = self.num_models
+        if ndev > 1 and self.num_models % ndev:
+            n_padded = ((self.num_models + ndev - 1) // ndev) * ndev
+        self._n_padded = n_padded
+
         # One TrainState per replica, stacked on a leading axis.
         states = [
             TrainState.create(self.model, optimizer, rng=worker_seed(self.seed, i))
-            for i in range(self.num_models)
+            for i in range(n_padded)
         ]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
-        # Shard the replica axis over devices when it divides evenly: N
-        # models train on N chips as one XLA program (the TPU-first form of
-        # the reference's N-executor fan-out).
+        # Shard the replica axis over devices: N models train on the mesh
+        # as one XLA program (the TPU-first form of the reference's
+        # N-executor fan-out).
         replica_sharding = None
-        devices = jax.devices()
-        if len(devices) > 1 and self.num_models % len(devices) == 0:
+        if ndev > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             mesh = best_mesh()
@@ -331,14 +347,14 @@ class _VmappedReplicasTrainer(Trainer):
         parts = dataset.partitions(self.num_models)
         iters = [
             minibatches(
-                p,
+                parts[i % self.num_models],
                 self.batch_size,
                 self.features_col,
                 self.label_col,
                 num_epoch=self.num_epoch,
                 seed=worker_seed(self.seed, i) if shuffle else None,
             )
-            for i, p in enumerate(parts)
+            for i in range(n_padded)
         ]
         self.history = []
         while True:
@@ -357,8 +373,10 @@ class _VmappedReplicasTrainer(Trainer):
                 }
             stacked, m = vstep(stacked, batch)
             self.history.append(m)
+        # Drop padded replicas from metrics (they trained on recycled data).
         self.history = [
-            {k: np.asarray(v) for k, v in h.items()} for h in self.history
+            {k: np.asarray(v)[: self.num_models] for k, v in h.items()}
+            for h in self.history
         ]
         return jax.device_get(stacked)
 
@@ -396,8 +414,10 @@ class AveragingTrainer(_VmappedReplicasTrainer):
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
         stacked = self._train_replicas(dataset, shuffle)
+        # Mean over the REQUESTED replicas only — the stack may carry
+        # padded throwaway replicas for device-count alignment.
         averaged = jax.tree.map(
-            lambda x: np.mean(x, axis=0),
+            lambda x: np.mean(x[: self.num_models], axis=0),
             {"params": stacked.params, **stacked.model_state},
         )
         self.record_training_stop()
@@ -552,6 +572,7 @@ class AsynchronousDistributedTrainer(Trainer):
         resume: bool = False,
         compress_deltas: bool = False,
         overlap_window: bool = True,
+        device_cache: bool | str = "auto",
         loss_weights=None,
         metric_stream=None,
         **protocol_kwargs,
@@ -586,6 +607,9 @@ class AsynchronousDistributedTrainer(Trainer):
         # reply is rebased onto the advanced params (VERDICT r1 weakness 3 —
         # the synchronous exchange made the async step 5.3x the sync step).
         self.overlap_window = bool(overlap_window)
+        # "auto": keep a worker's partition resident in HBM (and gather
+        # batches on device from index arrays) when it fits comfortably.
+        self.device_cache = device_cache
         if communication_window is not None:
             protocol_kwargs["communication_window"] = communication_window
         self.protocol = self._allocate_protocol(**protocol_kwargs)
@@ -594,6 +618,19 @@ class AsynchronousDistributedTrainer(Trainer):
 
     def _allocate_protocol(self, **kwargs) -> AsyncProtocol:
         return self.protocol_cls(**kwargs)
+
+    _DEVICE_CACHE_LIMIT = 256 * 1024 * 1024  # bytes per partition, "auto"
+
+    def _use_device_cache(self, part: Dataset) -> bool:
+        if not self.device_cache:
+            return False
+        if self.device_cache == "auto":
+            size = sum(
+                np.asarray(part[c]).nbytes
+                for c in (self.features_col, self.label_col)
+            )
+            return size < self._DEVICE_CACHE_LIMIT
+        return True
 
     # reference API parity: DistributedTrainer.service()/stop_service()
     def service(self, center_params):
@@ -642,6 +679,9 @@ class AsynchronousDistributedTrainer(Trainer):
         # crunches. donate=False: the params snapshot taken at the exchange
         # launch must stay valid while the next window computes.
         window_fn = make_window_train_step(
+            self.model, optimizer, self.loss, self.metrics, donate=False
+        )
+        cached_window_fn = make_cached_window_train_step(
             self.model, optimizer, self.loss, self.metrics, donate=False
         )
         init_state = TrainState.create(self.model, optimizer, rng=self.seed)
@@ -792,49 +832,87 @@ class AsynchronousDistributedTrainer(Trainer):
                         new_carry,
                     )
 
+                def _drive(state, carry, pending, windows, exec_window):
+                    """One window at a time: compute, record, rebase the
+                    previous exchange, launch the next."""
+                    for item in windows:
+                        state, ms, wsize = exec_window(state, item)
+                        jax.block_until_ready(ms["loss"])
+                        win_histories[widx].append((ms, wsize, time.time()))
+                        if pending is not None:
+                            state, carry = _rebase(state, pending)
+                            pending = None
+                        if exchanger is not None:
+                            snap = state.params
+                            pending = (
+                                exchanger.submit(
+                                    self.protocol.worker_window,
+                                    snap,
+                                    carry,
+                                    client,
+                                ),
+                                snap,
+                            )
+                        else:
+                            new_params, carry = self.protocol.worker_window(
+                                state.params, carry, client
+                            )
+                            state = state.replace(params=put_state(new_params))
+                    return state, carry, pending
+
+                seed_w = worker_seed(self.seed, widx) if shuffle else None
                 try:
                     for part in my_parts:
-                        feed = DeviceFeed(
-                            window_batches(
-                                minibatches(
-                                    part,
-                                    self.batch_size * dpw,
-                                    self.features_col,
-                                    self.label_col,
-                                    num_epoch=self.num_epoch,
-                                    seed=worker_seed(self.seed, widx)
-                                    if shuffle
-                                    else None,
+                        if dpw == 1 and self._use_device_cache(part):
+                            # Partition lives in HBM whole; the scanned
+                            # window gathers batches on device from [W, B]
+                            # index arrays — no per-window host feature
+                            # traffic (NOTES_ROUND1 perf hypothesis).
+                            xcol = jax.device_put(
+                                np.ascontiguousarray(part[self.features_col]),
+                                batch_placement,
+                            )
+                            ycol = jax.device_put(
+                                np.asarray(part[self.label_col]), batch_placement
+                            )
+
+                            def exec_cached(state, idx):
+                                idx_dev = jax.device_put(idx, batch_placement)
+                                s, ms = cached_window_fn(state, xcol, ycol, idx_dev)
+                                return s, ms, int(idx.shape[0])
+
+                            state, carry, pending = _drive(
+                                state, carry, pending,
+                                _index_windows(
+                                    part.num_rows, self.batch_size, window,
+                                    self.num_epoch, seed_w,
                                 ),
-                                window,
-                            ),
-                            sharding=batch_placement,
-                            buffer_size=2,
-                        )
-                        for wbatch in feed:
-                            wsize = int(wbatch["features"].shape[0])
-                            state, ms = window_fn(state, wbatch)
-                            jax.block_until_ready(ms["loss"])
-                            win_histories[widx].append((ms, wsize, time.time()))
-                            if pending is not None:
-                                state, carry = _rebase(state, pending)
-                                pending = None
-                            if exchanger is not None:
-                                snap = state.params
-                                pending = (
-                                    exchanger.submit(
-                                        self.protocol.worker_window,
-                                        snap,
-                                        carry,
-                                        client,
+                                exec_cached,
+                            )
+                        else:
+                            feed = DeviceFeed(
+                                window_batches(
+                                    minibatches(
+                                        part,
+                                        self.batch_size * dpw,
+                                        self.features_col,
+                                        self.label_col,
+                                        num_epoch=self.num_epoch,
+                                        seed=seed_w,
                                     ),
-                                    snap,
-                                )
-                            else:
-                                new_params, carry = self.protocol.worker_window(
-                                    state.params, carry, client
-                                )
-                                state = state.replace(params=put_state(new_params))
+                                    window,
+                                ),
+                                sharding=batch_placement,
+                                buffer_size=2,
+                            )
+
+                            def exec_fed(state, wbatch):
+                                s, ms = window_fn(state, wbatch)
+                                return s, ms, int(wbatch["features"].shape[0])
+
+                            state, carry, pending = _drive(
+                                state, carry, pending, feed, exec_fed
+                            )
                     if pending is not None:
                         state, carry = _rebase(state, pending)
                         pending = None

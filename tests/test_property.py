@@ -114,3 +114,43 @@ def test_grpc_frame_decoder_rejects_garbage(blob):
             decoder(blob)
         except Exception as e:
             assert not isinstance(e, (SystemExit, KeyboardInterrupt, MemoryError))
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    st.lists(
+        st.tuples(st.floats(-50, 50, width=32), st.integers(0, 4), st.booleans()),
+        min_size=1, max_size=25,
+    )
+)
+def test_fused_exchange_dedupe_exactly_once(events):
+    """A stream of fused commit_pull exchanges with injected replays: each
+    unique commit applies exactly once, every exchange (fresh or replayed)
+    still gets a reply, and the final center equals the sum of unique
+    deltas (DOWNPOUR)."""
+    from distkeras_tpu.parallel.ps import ParameterServerService
+
+    p = DOWNPOURProtocol()
+    svc = ParameterServerService(p, {"w": np.zeros(1, np.float32)}, 1)
+    svc.start()
+    try:
+        client = svc.client()
+        expected = 0.0
+        seen = set()
+        for d, worker, replay in events:
+            cid = f"w{worker}:{len(seen) if not replay else 0}"
+            payload = {
+                "delta": {"w": np.full(1, d, np.float32)},
+                "last_update": 0,
+                "commit_id": cid,
+            }
+            center, _ = client.commit_pull(payload)
+            assert np.isfinite(center["w"]).all()
+            if cid not in seen:
+                seen.add(cid)
+                expected += np.float32(d)
+        final = svc.get_model()
+        np.testing.assert_allclose(final["w"][0], expected, rtol=1e-3, atol=1e-3)
+        assert svc.num_commits == len(seen)
+    finally:
+        svc.stop()
