@@ -43,13 +43,17 @@ while true; do
       echo "$(date +%FT%T) warming bert" >> "$LOG"
       run_bench bert 5400 .bench_bert.json && echo "$(date +%FT%T) bert done: $(cat .bench_bert.json)" >> "$LOG"
     fi
-    if [ -s .bench_bert.json ] && [ ! -s .bench_kernels.json ]; then
+    if [ -s .bench_bert.json ] && [ ! -s .bench_kernels.json ] \
+        && [ "$(cat .bench_kernels.attempts 2>/dev/null || echo 0)" -lt 3 ]; then
+      echo "$(( $(cat .bench_kernels.attempts 2>/dev/null || echo 0) + 1 ))" > .bench_kernels.attempts
       echo "$(date +%FT%T) running pallas kernel bench" >> "$LOG"
       PYTHONPATH=/root/repo flock "$LOCK" timeout --signal=KILL 5400 \
         python benchmarks/kernel_bench.py > .bench_kernels.json 2> .bench_kernels.json.err \
         && echo "$(date +%FT%T) kernels done: $(cat .bench_kernels.json)" >> "$LOG"
     fi
-    if [ -s .bench_kernels.json ] && [ ! -s .bench_resnet50.json ]; then
+    # resnet50 gates on bert only — a failing kernel bench must not block
+    # the BASELINE flagship model's number forever.
+    if [ -s .bench_bert.json ] && [ ! -s .bench_resnet50.json ]; then
       echo "$(date +%FT%T) warming resnet50 (long compile)" >> "$LOG"
       run_bench resnet50 10800 .bench_resnet50.json && echo "$(date +%FT%T) resnet50 done: $(cat .bench_resnet50.json)" >> "$LOG"
     fi

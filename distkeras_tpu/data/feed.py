@@ -22,6 +22,49 @@ __all__ = ["minibatches", "window_batches", "index_windows", "DeviceFeed"]
 Batch = dict[str, np.ndarray]
 
 
+def _epoch_batch_indices(
+    n: int,
+    batch_size: int,
+    num_epoch: int,
+    seed: int | None,
+    drop_remainder: bool = True,
+) -> Iterator[np.ndarray]:
+    """The ONE source of batch order: yield per-batch row-index arrays with
+    per-epoch reshuffle (``default_rng(seed + epoch)``) and remainder
+    handling. Both the host feed (:func:`minibatches`) and the device-cache
+    feed (:func:`index_windows`) draw from this, so their orders match
+    batch-for-batch by construction — the cached/host interchangeability
+    the trainers rely on."""
+    if n < batch_size and drop_remainder:
+        raise ValueError(f"partition of {n} rows < batch_size {batch_size}")
+    for epoch in range(num_epoch):
+        order = (
+            np.random.default_rng(seed + epoch).permutation(n)
+            if seed is not None
+            else np.arange(n)
+        )
+        stop = (n // batch_size) * batch_size if drop_remainder else n
+        for lo in range(0, stop, batch_size):
+            hi = min(lo + batch_size, n)
+            yield order[lo:hi].astype(np.int32)
+
+
+def _window_group(items, window: int, stack):
+    """Group ``window`` consecutive items with ``stack``; the tail is emitted
+    as ``stack([item])`` singles rather than one ``[W', ...]`` group: the
+    scanned program is compiled per distinct leading length, so singles bound
+    the compile count at two programs (full window + single) instead of one
+    per distinct tail length."""
+    buf = []
+    for b in items:
+        buf.append(b)
+        if len(buf) == window:
+            yield stack(buf)
+            buf = []
+    for b in buf:
+        yield stack([b])
+
+
 def minibatches(
     dataset: Dataset,
     batch_size: int,
@@ -40,42 +83,20 @@ def minibatches(
     x = np.asarray(dataset[features_col])
     y = np.asarray(dataset[label_col])
     n = x.shape[0]
-    if n < batch_size and drop_remainder:
-        raise ValueError(f"partition of {n} rows < batch_size {batch_size}")
-    for epoch in range(num_epoch):
-        if seed is not None:
-            perm = np.random.default_rng(seed + epoch).permutation(n)
-            xe, ye = x[perm], y[perm]
-        else:
-            xe, ye = x, y
-        stop = (n // batch_size) * batch_size if drop_remainder else n
-        for lo in range(0, stop, batch_size):
-            hi = min(lo + batch_size, n)
-            yield {"features": xe[lo:hi], "label": ye[lo:hi]}
+    for idx in _epoch_batch_indices(n, batch_size, num_epoch, seed,
+                                    drop_remainder):
+        yield {"features": x[idx], "label": y[idx]}
 
 
 def window_batches(batches: Iterator[Batch], window: int) -> Iterator[Batch]:
     """Group ``window`` consecutive minibatches into one stacked batch with a
     leading window axis (``[W, B, ...]``) for the scanned window step
-    (:func:`distkeras_tpu.training.step.make_window_train_step`).
-
-    The dataset tail is emitted as ``[1, B, ...]`` singles rather than one
-    ``[W', B, ...]`` group: the scanned program is compiled per distinct
-    leading length, so singles bound the compile count at two programs
-    (full window + single) instead of one per distinct tail length.
-    """
+    (:func:`distkeras_tpu.training.step.make_window_train_step`)."""
 
     def _stack(buf: list[Batch]) -> Batch:
         return {k: np.stack([b[k] for b in buf]) for k in buf[0]}
 
-    buf: list[Batch] = []
-    for b in batches:
-        buf.append(b)
-        if len(buf) == window:
-            yield _stack(buf)
-            buf = []
-    for b in buf:
-        yield _stack([b])
+    return _window_group(batches, window, _stack)
 
 
 def index_windows(
@@ -86,35 +107,13 @@ def index_windows(
     seed: int | None = None,
 ) -> Iterator[np.ndarray]:
     """Yield ``[W, B]`` int32 row-index arrays with the same cadence as
-    ``window_batches(minibatches(...))`` — per-epoch reshuffle when seeded,
-    dropped remainder, tail emitted as ``[1, B]`` singles. For the
+    ``window_batches(minibatches(...))`` — identical by construction: both
+    draw from :func:`_epoch_batch_indices` and :func:`_window_group`. For the
     device-cached feed: the data lives in HBM whole and only these index
     arrays (W·B·4 bytes) cross the host→device boundary per window."""
-
-    if n < batch_size:
-        # Same contract as minibatches(drop_remainder=True): a too-small
-        # partition is an explicit error, never a silent zero-step worker.
-        raise ValueError(f"partition of {n} rows < batch_size {batch_size}")
-
-    def batches():
-        for epoch in range(num_epoch):
-            order = (
-                np.random.default_rng(seed + epoch).permutation(n)
-                if seed is not None
-                else np.arange(n)
-            )
-            stop = (n // batch_size) * batch_size
-            for lo in range(0, stop, batch_size):
-                yield order[lo : lo + batch_size].astype(np.int32)
-
-    buf: list[np.ndarray] = []
-    for b in batches():
-        buf.append(b)
-        if len(buf) == window:
-            yield np.stack(buf)
-            buf = []
-    for b in buf:
-        yield b[None]
+    return _window_group(
+        _epoch_batch_indices(n, batch_size, num_epoch, seed), window, np.stack
+    )
 
 
 class DeviceFeed:

@@ -50,10 +50,14 @@ class BertConfig:
     # Causal (decoder/GPT-style) attention masking.
     causal: bool = False
     # Sequence-parallel attention: a jax.sharding.Mesh (hashable, so valid
-    # as static config) + axis name routes attention through
-    # ring_flash_attention — the sequence dimension never gathers.
+    # as static config) + axis name routes attention through a
+    # sequence-parallel kernel — the sequence dimension never gathers.
     ring_mesh: object = None
     ring_axis: str = "sp"
+    # Which sequence-parallel strategy when ring_mesh is set: "ring"
+    # (ppermute K/V stream, ops/ring_flash.py) or "ulysses" (all-to-all
+    # head re-sharding, ops/ulysses.py; needs num_heads % sp == 0).
+    sp_impl: str = "ring"
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -82,9 +86,15 @@ class SelfAttention(nn.Module):
         B, S = x.shape[0], x.shape[1]
         shape = (B, S, cfg.num_heads, head_dim)
         if cfg.ring_mesh is not None and mask is None:
-            from distkeras_tpu.ops.ring_flash import ring_flash_attention
-
-            out = ring_flash_attention(
+            if cfg.sp_impl == "ulysses":
+                from distkeras_tpu.ops.ulysses import ulysses_self_attention as sp_fn
+            elif cfg.sp_impl == "ring":
+                from distkeras_tpu.ops.ring_flash import ring_flash_attention as sp_fn
+            else:
+                raise ValueError(
+                    f"unknown sp_impl {cfg.sp_impl!r}: expected 'ring' or 'ulysses'"
+                )
+            out = sp_fn(
                 q.reshape(shape), k.reshape(shape), v.reshape(shape),
                 cfg.ring_mesh, seq_axis=cfg.ring_axis, causal=cfg.causal,
             )

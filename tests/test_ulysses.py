@@ -1,0 +1,92 @@
+"""Ulysses all-to-all sequence parallelism: forward/gradients verified
+against dense attention; e2e BERT training on a dp x sp mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.attention import dot_product_attention
+from distkeras_tpu.ops.ulysses import ulysses_self_attention
+from distkeras_tpu.parallel.mesh import make_mesh
+
+
+def _qkv(rng, B=2, S=64, H=4, D=8):
+    mk = lambda: np.asarray(rng.normal(size=(B, S, H, D)), np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(rng, causal):
+    q, k, v = _qkv(rng)
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    out = ulysses_self_attention(q, k, v, mesh, seq_axis="sp", causal=causal)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_gradients_match_dense(rng, causal):
+    q, k, v = _qkv(rng, B=1, S=32, H=8, D=8)
+    mesh = make_mesh({"sp": 8})
+
+    def loss_u(q, k, v):
+        return jnp.mean(
+            ulysses_self_attention(q, k, v, mesh, seq_axis="sp",
+                                   causal=causal) ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.mean(dot_product_attention(q, k, v, causal=causal) ** 2)
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-3)
+
+
+def test_ulysses_rejects_indivisible_heads(rng):
+    q, k, v = _qkv(rng, H=3)
+    mesh = make_mesh({"sp": 8})
+    with pytest.raises(ValueError, match="num_heads"):
+        ulysses_self_attention(q, k, v, mesh, seq_axis="sp")
+
+
+def test_bert_with_ulysses_attention_trains(rng):
+    """BERT with Ulysses attention trains under the sync trainer on a
+    dp x sp mesh, and its forward matches the plain model's."""
+    import dataclasses
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import bert as bert_mod
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    vocab, seq = 64, 32
+    cfg = bert_mod.BertConfig(
+        vocab_size=vocab, hidden_size=64, num_layers=2, num_heads=4,
+        mlp_dim=128, max_seq_len=seq, dropout_rate=0.0,
+        ring_mesh=mesh, ring_axis="sp", sp_impl="ulysses",
+    )
+    model = bert_mod._make(cfg, seq, "bert_ulysses")
+
+    tokens = np.asarray(rng.integers(1, vocab, size=(128, seq)), np.int32)
+    ds = dk.Dataset.from_arrays(features=tokens, label=tokens)
+    trainer = dk.SynchronousDistributedTrainer(
+        model, worker_optimizer="adam", learning_rate=1e-3,
+        batch_size=8, num_epoch=2, mesh=mesh, shard_sequence=True,
+    )
+    trainer.train(ds)
+    hist = trainer.get_history()
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+    plain_cfg = dataclasses.replace(cfg, ring_mesh=None)
+    plain = bert_mod._make(plain_cfg, seq, "bert_plain")
+    variables = model.init(3)
+    x = tokens[:4]
+    o_u, _ = model.apply(variables, x)
+    o_plain, _ = plain.apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(o_u), np.asarray(o_plain), atol=3e-2, rtol=3e-2
+    )
