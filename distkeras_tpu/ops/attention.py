@@ -21,7 +21,27 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["dot_product_attention", "ring_attention", "ring_self_attention"]
+__all__ = [
+    "dot_product_attention",
+    "ring_attention",
+    "ring_self_attention",
+    "sp_batch_spec",
+]
+
+
+def sp_batch_spec(mesh, seq_axis: str, batch_size: int):
+    """The shared ``[B, S, H, D]`` PartitionSpec for every sequence-parallel
+    wrapper (ring, ring-flash, Ulysses): sequence over ``seq_axis``, batch
+    over ``dp`` — but only when the batch divides it (model init traces with
+    a dummy batch of 1; a replicated tiny batch is fine there)."""
+    from jax.sharding import PartitionSpec as P
+
+    batch_axis = (
+        "dp"
+        if "dp" in mesh.axis_names and batch_size % mesh.shape["dp"] == 0
+        else None
+    )
+    return P(batch_axis, seq_axis, None, None)
 
 
 def dot_product_attention(q, k, v, mask=None, causal: bool = False):
@@ -117,11 +137,9 @@ def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp", causal: bool = Fals
     """Convenience wrapper: run :func:`ring_attention` under ``shard_map`` on
     ``mesh``, sharding the sequence dimension of ``[B, S, H, D]`` inputs over
     ``seq_axis`` and the batch over ``dp`` if present."""
-    from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    batch_axis = "dp" if "dp" in mesh.axis_names else None
-    spec = P(batch_axis, seq_axis, None, None)
+    spec = sp_batch_spec(mesh, seq_axis, q.shape[0])
 
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal),

@@ -232,14 +232,9 @@ def ring_flash_attention(
                 f"pad the sequence so each shard is a multiple of 8"
             )
 
-    # Shard the batch over dp only when divisible (model init traces with
-    # a dummy batch of 1; a replicated tiny batch is fine there).
-    batch_axis = (
-        "dp"
-        if "dp" in mesh.axis_names and B % mesh.shape["dp"] == 0
-        else None
-    )
-    spec = P(batch_axis, seq_axis, None, None)
+    from distkeras_tpu.ops.attention import sp_batch_spec
+
+    spec = sp_batch_spec(mesh, seq_axis, B)
     ring = _make_ring(seq_axis, causal, block_q, interpret)
 
     def local(q, k, v):  # per-device [B_loc, S_loc, H, D]

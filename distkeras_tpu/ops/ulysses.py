@@ -83,7 +83,8 @@ def ulysses_self_attention(q, k, v, mesh, seq_axis: str = "sp",
     two strategies are drop-in interchangeable at the model layer.
     """
     from jax import shard_map
-    from jax.sharding import PartitionSpec as P
+
+    from distkeras_tpu.ops.attention import sp_batch_spec
 
     B, S, H, _ = q.shape
     p = mesh.shape[seq_axis]
@@ -95,14 +96,7 @@ def ulysses_self_attention(q, k, v, mesh, seq_axis: str = "sp",
             f"{H} heads over {p} devices — use ring attention for "
             f"head counts that don't divide"
         )
-    # Shard the batch over dp only when divisible (model init traces with
-    # a dummy batch of 1; a replicated tiny batch is fine there).
-    batch_axis = (
-        "dp"
-        if "dp" in mesh.axis_names and B % mesh.shape["dp"] == 0
-        else None
-    )
-    spec = P(batch_axis, seq_axis, None, None)
+    spec = sp_batch_spec(mesh, seq_axis, B)
     fn = shard_map(
         functools.partial(
             ulysses_attention, axis_name=seq_axis, causal=causal,

@@ -1,12 +1,28 @@
-"""Pipeline parallelism over a ``pp`` mesh axis (GPipe-style microbatching).
+"""Pipeline parallelism over a ``pp`` mesh axis (GPipe + interleaved 1F1B-
+style virtual stages).
 
 Absent from the reference (SURVEY §2 parallelism table) but a first-class
 axis here. The design is SPMD, not a scheduler: every device runs the same
 program under ``shard_map``; stage identity comes from ``lax.axis_index``.
-Per tick, each device applies *its* stage to its current activation and
-rotates activations one hop forward with ``lax.ppermute`` (ICI neighbor
-traffic only). A pipeline of P stages fed M microbatches drains in
-``M + P - 1`` ticks — the classic GPipe bubble of (P-1)/(M+P-1).
+Per tick, each device applies one of its stages to its current activation
+and rotates activations one hop forward with ``lax.ppermute`` (ICI neighbor
+traffic only).
+
+**Schedule.** With ``V = virtual_stages`` chunks per device (Megatron-style
+interleaving), the ``L = V·P`` logical stages are laid out round-robin:
+device ``d`` owns logical stages ``{d, P+d, …, (V-1)·P+d}``. Microbatches
+inject in groups of ``P`` at ticks ``inj(m) = (m//P)·V·P + m%P``; an
+activation processed on device ``P-1`` for chunk ``v`` re-enters device 0
+for chunk ``v+1`` on the very next tick, so nothing ever queues and the
+lock-step rotation stays exact. Device ``d`` is busy every tick of
+``[d, d+M·V)`` processing chunk ``v(τ) = (τ//P) mod V`` of microbatch
+``m(τ) = (τ//(V·P))·P + τ%P`` where ``τ = t - d``. When ``P | M`` the total
+is ``M·V + P - 1`` ticks and the fill/drain bubble is ``(P-1)/(M·V+P-1)`` —
+**V× smaller per unit work** than the V=1 GPipe schedule's ``(P-1)/(M+P-1)``
+(same-depth model, stages V× shallower). A ragged last group (``P ∤ M``)
+still computes correctly but stalls up to one extra V·P round
+(T = ((M-1)//P)·V·P + (M-1)%P + V·P); size ``M`` as a multiple of ``P`` to
+get the advertised bubble. V=1 reduces to plain GPipe.
 
 Constraints (by construction of the rotation): every stage maps activations
 of one shape to the same shape — the transformer-block case. Embedding/head
@@ -14,7 +30,9 @@ layers stay outside the pipelined trunk.
 
 The whole schedule is a ``lax.scan``, so it differentiates: gradients flow
 back through the ppermutes (reverse hops) and the per-stage applications,
-giving pipeline-parallel *training*, not just inference.
+giving pipeline-parallel *training*, not just inference. (The backward is
+the scan's time-reversal — activation memory is the remat lever on
+``stage_fn``, not the schedule; see PipelineTrainer's ``remat``.)
 """
 
 from __future__ import annotations
@@ -29,9 +47,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["pipeline_apply", "stack_stage_params", "pipeline_shardings"]
 
 
-def stack_stage_params(stage_params_list):
+def stack_stage_params(stage_params_list, virtual_stages: int = 1):
     """Stack per-stage parameter PyTrees on a leading 'stage' axis
-    ([P, ...] leaves) — shard that axis over ``pp``."""
+    ([L, ...] leaves) — shard that axis over ``pp``.
+
+    ``stage_params_list`` is in **logical order** (stage 0 first). With
+    ``virtual_stages=V > 1`` the stack is permuted to the round-robin device
+    layout the interleaved schedule expects: position ``d·V + v`` holds
+    logical stage ``v·P + d``, so the pp-sharding's contiguous split hands
+    device ``d`` exactly its V chunks, indexable by ``v``.
+    """
+    L = len(stage_params_list)
+    if L % virtual_stages:
+        raise ValueError(
+            f"{L} stages not divisible by virtual_stages={virtual_stages}"
+        )
+    if virtual_stages > 1:
+        num_devices = L // virtual_stages
+        order = [
+            v * num_devices + d
+            for d in range(num_devices)
+            for v in range(virtual_stages)
+        ]
+        stage_params_list = [stage_params_list[i] for i in order]
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params_list)
 
 
@@ -52,21 +90,29 @@ def pipeline_shardings(mesh: Mesh):
 
 
 def _pipeline_local(
-    stage_fn, stacked_params, microbatches, axis_name: str, varying_axes=()
+    stage_fn, stacked_params, microbatches, axis_name: str,
+    virtual_stages: int, varying_axes=(),
 ):
     """Per-device body (inside shard_map).
 
-    ``stacked_params``: this device's stage params ([1, ...] leaves —
-    the 'pp'-sharded stack). ``microbatches``: [M, B, D] (replicated).
-    Returns [M, B, D]: outputs of the final stage (valid on every device:
-    results are rotated full-circle so the scan output lands everywhere).
+    ``stacked_params``: this device's chunk params ([V, ...] leaves — the
+    'pp'-sharded round-robin stack). ``microbatches``: [M, B, D]. Returns
+    [M, B, D]: final-stage outputs (valid on every device: one psum after
+    the scan broadcasts them, keeping collectives off the scan's critical
+    path).
     """
-    p = lax.axis_index(axis_name)
-    num_stages = lax.axis_size(axis_name)
-    my_params = jax.tree.map(lambda x: x[0], stacked_params)
+    d = lax.axis_index(axis_name)
+    num_devices = lax.axis_size(axis_name)
+    V = virtual_stages
     M, B = microbatches.shape[0], microbatches.shape[1]
     feat_shape = microbatches.shape[2:]
-    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    perm = [(i, (i + 1) % num_devices) for i in range(num_devices)]
+
+    def v_of(tau):
+        return (tau // num_devices) % V
+
+    def m_of(tau):
+        return (tau // (V * num_devices)) * num_devices + tau % num_devices
 
     # The carry must be device-varying over the pp axis from the start
     # (ppermute outputs are varying; scan carries must type-match) — and
@@ -74,26 +120,43 @@ def _pipeline_local(
     # the ingested state dp-varying too).
     zeros = jnp.zeros((B, *feat_shape), microbatches.dtype)
     state = lax.pcast(zeros, (axis_name, *varying_axes), to="varying")
+    out_buf = lax.pcast(
+        jnp.zeros((M, B, *feat_shape), microbatches.dtype),
+        (axis_name, *varying_axes),
+        to="varying",
+    )
 
     def tick(carry, t):
-        state = carry
-        # stage 0 ingests microbatch t (clamped; masked when t >= M)
+        state, out_buf = carry
+        tau = t - d
+        v = v_of(tau)
+        m = m_of(tau)
+        m_clip = jnp.clip(m, 0, M - 1)
+        # device 0 ingests microbatch m when it starts chunk 0
         x_in = lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+            microbatches, m_clip, axis=0, keepdims=False
         )
-        state = jnp.where(p == 0, jnp.where(t < M, x_in, state), state)
+        ingest = (d == 0) & (v == 0) & (tau >= 0) & (m < M)
+        state = jnp.where(ingest, x_in, state)
+        my_params = jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, v, axis=0, keepdims=False),
+            stacked_params,
+        )
         y = stage_fn(my_params, state)
-        # the last stage owns microbatch (t - P + 1)'s final output; other
-        # devices contribute zeros and ONE psum after the scan broadcasts
-        # the results (keeping collectives off the scan's critical path).
-        emitted = jnp.where(p == num_stages - 1, y, jnp.zeros_like(y))
+        # the last device at its last chunk owns microbatch m's final output
+        emit = (d == num_devices - 1) & (v == V - 1) & (tau >= 0) & (m < M)
+        emitted = jnp.where(emit, y, jnp.zeros_like(y))
+        out_buf = out_buf.at[m_clip].add(emitted)
         state = lax.ppermute(y, axis_name, perm)
-        return state, emitted
+        return (state, out_buf), None
 
-    _, emitted_seq = lax.scan(tick, state, jnp.arange(M + num_stages - 1))
-    emitted_seq = lax.psum(emitted_seq, axis_name)
-    # microbatch m is emitted at tick m + P - 1
-    return emitted_seq[num_stages - 1 :]
+    # Static tick count: last microbatch M-1 emits at inj(M-1) + V·P - 1
+    # (axis_size of a mesh axis is a static int, so T is trace-time known).
+    T = ((M - 1) // num_devices) * V * num_devices + (
+        (M - 1) % num_devices
+    ) + V * num_devices
+    (_, out_buf), _ = lax.scan(tick, (state, out_buf), jnp.arange(T))
+    return lax.psum(out_buf, axis_name)
 
 
 def pipeline_apply(
@@ -103,15 +166,20 @@ def pipeline_apply(
     mesh: Mesh,
     axis_name: str = "pp",
     io_spec: P | None = None,
+    virtual_stages: int = 1,
 ):
-    """Run a P-stage pipeline over ``mesh[axis_name]``.
+    """Run an ``L``-stage pipeline over ``mesh[axis_name]``.
 
     - ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``;
     - ``stacked_params``: PyTree with leading stage axis (see
-      :func:`stack_stage_params`), sharded over ``axis_name``;
+      :func:`stack_stage_params` — pass it the same ``virtual_stages`` so
+      the round-robin layout matches), sharded over ``axis_name``;
     - ``microbatches``: ``[M, B, ...]`` array. By default the batch axis
       shards over the mesh's ``dp`` axis when present (each dp slice runs
       its own pipeline replica); pass ``io_spec`` to override.
+    - ``virtual_stages``: chunks per device (interleaved schedule); the
+      fill/drain bubble shrinks ~V× at the cost of V× more (shallower)
+      stage applications per tick window.
 
     Returns ``[M, B, ...]`` — the final stage's outputs. Differentiable
     end-to-end.
@@ -131,7 +199,7 @@ def pipeline_apply(
     fn = shard_map(
         partial(
             _pipeline_local, stage_fn, axis_name=axis_name,
-            varying_axes=varying_axes,
+            virtual_stages=virtual_stages, varying_axes=varying_axes,
         ),
         mesh=mesh,
         in_specs=(jax.tree.map(lambda _: spec_params, stacked_params), io_spec),
@@ -139,4 +207,13 @@ def pipeline_apply(
     )
     if microbatches.shape[0] < 1:
         raise ValueError("need at least one microbatch")
+    expected = virtual_stages * mesh.shape[axis_name]
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != expected:
+        raise ValueError(
+            f"stacked params have {lead} stages but mesh {axis_name}="
+            f"{mesh.shape[axis_name]} x virtual_stages={virtual_stages} "
+            f"needs {expected} — pass the same virtual_stages to "
+            f"stack_stage_params and pipeline_apply"
+        )
     return fn(stacked_params, microbatches)

@@ -12,7 +12,9 @@ head outside the trunk. Microbatch IO shards over ``dp`` when the mesh has
 one (each dp slice runs its own pipeline replica; XLA psums the gradients).
 
 GPipe fill/drain bubble: (P-1)/(M+P-1) of the schedule per direction —
-raise ``num_microbatches`` to amortize. Dropout inside the pipelined trunk
+raise ``num_microbatches`` to amortize, or set ``virtual_stages=V`` for the
+Megatron-style interleaved schedule (V chunks per device; bubble shrinks
+~V×). Dropout inside the pipelined trunk
 is disabled (the stage rotation carries no per-stage rng streams yet);
 models trained here should use ``dropout_rate=0`` configs.
 """
@@ -55,6 +57,7 @@ class PipelineTrainer(Trainer):
         metrics=("accuracy",),
         num_stages: int | None = None,
         num_microbatches: int = 4,
+        virtual_stages: int = 1,
         remat: bool = False,
         batch_size: int = 32,
         features_col: str = "features",
@@ -93,6 +96,9 @@ class PipelineTrainer(Trainer):
         self.cfg = cfg
         self.num_stages = num_stages
         self.num_microbatches = int(num_microbatches)
+        # Interleaved (Megatron-style) schedule: V chunks per device cut the
+        # fill/drain bubble ~V× — see parallel/pipeline.py's schedule note.
+        self.virtual_stages = int(virtual_stages)
         # Rematerialize stage activations in the backward pass: the scanned
         # GPipe schedule otherwise saves every (stage, tick) activation —
         # O(M·P) residency. With remat the backward recomputes them, the
@@ -108,31 +114,44 @@ class PipelineTrainer(Trainer):
     # -- model surgery -------------------------------------------------------
 
     def _split_params(self, params: dict, num_stages: int):
+        """Split layers into ``num_stages * virtual_stages`` logical stages
+        and stack in the round-robin layout the interleaved schedule expects
+        (a no-op permutation at virtual_stages=1)."""
         L = self.cfg.num_layers
-        if L % num_stages:
-            raise ValueError(f"{L} layers not divisible into {num_stages} stages")
-        per_stage = L // num_stages
+        V = self.virtual_stages
+        num_logical = num_stages * V
+        if L % num_logical:
+            raise ValueError(
+                f"{L} layers not divisible into {num_stages} stages x "
+                f"{V} virtual chunks"
+            )
+        per_stage = L // num_logical
         layer_names = [f"layer_{i}" for i in range(L)]
         stage_groups = [
             {
                 f"sub_{j}": params[layer_names[s * per_stage + j]]
                 for j in range(per_stage)
             }
-            for s in range(num_stages)
+            for s in range(num_logical)
         ]
         rest = {k: v for k, v in params.items() if k not in layer_names}
-        return {"stages": stack_stage_params(stage_groups), "rest": rest}, per_stage
+        stacked = stack_stage_params(stage_groups, virtual_stages=V)
+        return {"stages": stacked, "rest": rest}, per_stage
 
     def _merge_params(self, train_params: dict, num_stages: int, per_stage: int):
         """Back to the standard variables layout so the returned
-        TrainedModel predicts/saves like any other."""
+        TrainedModel predicts/saves like any other. Inverts the round-robin
+        stack: position ``d*V + v`` holds logical stage ``v*P + d``."""
         merged = dict(train_params["rest"])
         stages = train_params["stages"]
-        for s in range(num_stages):
-            for j in range(per_stage):
-                merged[f"layer_{s * per_stage + j}"] = jax.tree.map(
-                    lambda x: x[s], stages[f"sub_{j}"]
-                )
+        V = self.virtual_stages
+        for d in range(num_stages):
+            for v in range(V):
+                s = v * num_stages + d
+                for j in range(per_stage):
+                    merged[f"layer_{s * per_stage + j}"] = jax.tree.map(
+                        lambda x: x[d * V + v], stages[f"sub_{j}"]
+                    )
         return merged
 
     def _make_forward(self, mesh, per_stage: int):
@@ -169,7 +188,10 @@ class PipelineTrainer(Trainer):
             if B % M:
                 raise ValueError(f"batch {B} not divisible into {M} microbatches")
             mb = x.reshape(M, B // M, S, x.shape[-1])
-            y = pipeline_apply(stage_fn, train_params["stages"], mb, mesh)
+            y = pipeline_apply(
+                stage_fn, train_params["stages"], mb, mesh,
+                virtual_stages=self.virtual_stages,
+            )
             x = y.reshape(B, S, y.shape[-1])
             x = ln_final.apply({"params": rest["ln_final"]}, x)
             logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T

@@ -76,3 +76,50 @@ def test_pipeline_sharded_params_layout(rng):
     placed = jax.device_put(stacked, p_sh)
     # each device holds exactly one stage's weights
     assert {s.data.shape for s in placed["w"].addressable_shards} == {(1, D, D)}
+
+@pytest.mark.parametrize("P,V,M", [(4, 2, 4), (2, 4, 6), (4, 2, 5), (2, 2, 2)])
+def test_interleaved_pipeline_matches_sequential(rng, P, V, M):
+    """virtual_stages=V: the round-robin stack + group-staggered injection
+    must reproduce plain sequential application (incl. partial last group)."""
+    D, B = 16, 8
+    mesh = make_mesh({"dp": 8 // P, "pp": P} if P < 8 else {"pp": P})
+    stages = _stages(rng, P * V, D)
+    stacked = stack_stage_params(stages, virtual_stages=V)
+    x = rng.normal(size=(M, B, D)).astype(np.float32)
+    out = pipeline_apply(_stage_fn, stacked, x, mesh, virtual_stages=V)
+    ref = np.stack([_sequential(stages, x[m]) for m in range(M)])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_pipeline_gradients(rng):
+    P, V, M, D, B = 4, 2, 4, 8, 2
+    mesh = make_mesh({"pp": P})
+    stages = _stages(rng, P * V, D)
+    stacked = stack_stage_params(stages, virtual_stages=V)
+    x = rng.normal(size=(M, B, D)).astype(np.float32)
+
+    def loss_pipe(sp):
+        return jnp.mean(
+            pipeline_apply(_stage_fn, sp, x, mesh, virtual_stages=V) ** 2
+        )
+
+    def loss_seq(ws):
+        out = jnp.stack([_sequential(ws, x[m]) for m in range(M)])
+        return jnp.mean(out ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stages)
+    # stacked position d*V + v holds logical stage v*P + d
+    for d in range(P):
+        for v in range(V):
+            for key in ("w", "b"):
+                np.testing.assert_allclose(
+                    np.asarray(g_pipe[key][d * V + v]),
+                    np.asarray(g_seq[v * P + d][key]),
+                    atol=1e-5, rtol=1e-4,
+                )
+
+
+def test_stack_stage_params_rejects_indivisible(rng):
+    with pytest.raises(ValueError, match="virtual_stages"):
+        stack_stage_params(_stages(rng, 6, 4), virtual_stages=4)
