@@ -56,6 +56,7 @@ from distkeras_tpu.inference.evaluators import (
     ConfusionMatrixEvaluator,
     PrecisionRecallEvaluator,
 )
+from distkeras_tpu.inference.generate import Generator, generate
 from distkeras_tpu.utils.config import TrainerConfig
 
 __all__ = [
@@ -84,5 +85,7 @@ __all__ = [
     "AccuracyEvaluator",
     "PrecisionRecallEvaluator",
     "ConfusionMatrixEvaluator",
+    "generate",
+    "Generator",
     "TrainerConfig",
 ]

@@ -1,4 +1,11 @@
 from distkeras_tpu.inference.evaluators import AccuracyEvaluator
+from distkeras_tpu.inference.generate import Generator, generate
 from distkeras_tpu.inference.predictors import ModelPredictor, Predictor
 
-__all__ = ["Predictor", "ModelPredictor", "AccuracyEvaluator"]
+__all__ = [
+    "Predictor",
+    "ModelPredictor",
+    "AccuracyEvaluator",
+    "generate",
+    "Generator",
+]

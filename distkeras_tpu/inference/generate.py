@@ -1,0 +1,165 @@
+"""Autoregressive generation with KV caches for the causal-LM family.
+
+Beyond the reference (its predictors are batch-transform only —
+``distkeras/predictors.py`` § ``ModelPredictor`` maps a fixed model over
+rows); generation is table-stakes for the GPT models this framework adds,
+so it is first-class here.
+
+TPU-first shape discipline: everything is static. The KV caches are
+``[B, max_seq_len, H, D]`` buffers written through ``dynamic_update_slice``
+at a cache index; **prefill** runs the whole prompt in ONE forward (big
+MXU matmuls, causal-masked, filling the caches), then the **decode loop**
+is a single ``lax.scan`` of per-token steps — one compiled program for any
+prompt, no per-step retracing, no growing shapes.
+
+Sampling: greedy, temperature, and top-k (all inside the scan;
+``jax.random.categorical`` over masked logits).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["generate", "Generator"]
+
+
+def _decode_module(model):
+    from distkeras_tpu.models.bert import Bert, BertConfig
+
+    cfg = getattr(model, "config", None)
+    if not isinstance(cfg, BertConfig):
+        raise ValueError(
+            "generate() needs a causal model from the distkeras_tpu.models."
+            f"bert zoo (gpt_tiny/gpt_small/...); got {getattr(model, 'name', model)!r}"
+        )
+    if not cfg.causal:
+        raise ValueError(
+            f"model {model.name!r} is not causal (BertConfig.causal=False); "
+            "generation requires a decoder LM"
+        )
+    dec_cfg = dataclasses.replace(
+        cfg, decode=True, dropout_rate=0.0, ring_mesh=None,
+        use_flash_attention=False,
+    )
+    return Bert(dec_cfg), dec_cfg
+
+
+def _empty_cache(module, batch_size: int):
+    """Cache PyTree of zeros, derived via eval_shape (never materializes a
+    throwaway set of params)."""
+    shapes = jax.eval_shape(
+        lambda r: module.init(r, jnp.zeros((batch_size, 1), jnp.int32),
+                              train=False),
+        jax.random.PRNGKey(0),
+    )["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("module", "max_new_tokens", "top_k", "greedy"),
+)
+def _generate_jit(module, params, prompt, rng, max_new_tokens, temperature,
+                  top_k, greedy):
+    B = prompt.shape[0]
+    cache = _empty_cache(module, B)
+
+    def sample(logits, key):
+        logits = logits.astype(jnp.float32)
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / jnp.maximum(temperature, 1e-6)
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    # Prefill: one big forward over the whole prompt fills every layer's
+    # KV cache and yields the first next-token distribution.
+    logits, mut = module.apply(
+        {"params": params, "cache": cache}, prompt, train=False,
+        mutable=["cache"],
+    )
+    cache = mut["cache"]
+    rng, key = jax.random.split(rng)
+    tok = sample(logits[:, -1], key)
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        logits, mut = module.apply(
+            {"params": params, "cache": cache}, tok[:, None], train=False,
+            mutable=["cache"],
+        )
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits[:, -1], key)
+        return (mut["cache"], nxt, rng), nxt
+
+    if max_new_tokens == 1:
+        return tok[:, None]
+    (_, _, _), rest = jax.lax.scan(
+        step, (cache, tok, rng), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate([tok[:, None], rest.T], axis=1)
+
+
+def generate(
+    model,
+    variables,
+    prompt,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    greedy: bool = False,
+    seed: int = 0,
+):
+    """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, S0]``.
+
+    Returns an int32 ``[B, max_new_tokens]`` array of sampled token ids.
+    One jitted program per (module, max_new_tokens, top_k, greedy) — reruns
+    with different prompts/temperatures/seeds reuse the compilation.
+    """
+    module, dec_cfg = _decode_module(model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [B, S0]; got {prompt.shape}")
+    S0 = prompt.shape[1]
+    # Bound by the TRAINED context length, not the cache capacity: factory
+    # configs can have max_seq_len > the seq_len training ever touched, and
+    # positions past it hold randomly-initialized positional embeddings.
+    trained_len = getattr(model, "input_shape", (dec_cfg.max_seq_len,))[0]
+    limit = min(dec_cfg.max_seq_len, trained_len)
+    if S0 + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"{limit} (= min(max_seq_len {dec_cfg.max_seq_len}, trained "
+            f"context {trained_len})); positions past the trained context "
+            f"have untrained positional embeddings — build the model with a "
+            f"larger seq_len to decode further"
+        )
+    if top_k is not None and not 1 <= top_k <= dec_cfg.vocab_size:
+        raise ValueError(
+            f"top_k={top_k} outside [1, vocab_size={dec_cfg.vocab_size}]"
+        )
+    out = _generate_jit(
+        module, variables["params"], prompt, jax.random.PRNGKey(seed),
+        max_new_tokens, jnp.float32(temperature), top_k, greedy,
+    )
+    return np.asarray(out)
+
+
+class Generator:
+    """Stateful convenience wrapper around :func:`generate` holding the
+    model + trained variables (mirrors the Predictor surface)."""
+
+    def __init__(self, model, variables):
+        self.model = model
+        self.variables = variables
+
+    def __call__(self, prompt, max_new_tokens: int, **kw):
+        return generate(self.model, self.variables, prompt, max_new_tokens,
+                        **kw)

@@ -58,6 +58,12 @@ class BertConfig:
     # (ppermute K/V stream, ops/ring_flash.py) or "ulysses" (all-to-all
     # head re-sharding, ops/ulysses.py; needs num_heads % sp == 0).
     sp_impl: str = "ring"
+    # Incremental decoding: attention layers keep K/V caches of length
+    # max_seq_len in a mutable "cache" collection, and positions advance a
+    # cache index — the autoregressive-generation config
+    # (inference/generate.py). Params are layout-identical to the
+    # decode=False model, so trained weights drop in.
+    decode: bool = False
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -85,7 +91,11 @@ class SelfAttention(nn.Module):
         v = _dense(cfg.hidden_size, qkv_axes, "value", cfg.dtype)(x)
         B, S = x.shape[0], x.shape[1]
         shape = (B, S, cfg.num_heads, head_dim)
-        if cfg.ring_mesh is not None and mask is None:
+        if cfg.decode:
+            out = self._decode_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape)
+            )
+        elif cfg.ring_mesh is not None and mask is None:
             if cfg.sp_impl == "ulysses":
                 from distkeras_tpu.ops.ulysses import ulysses_self_attention as sp_fn
             elif cfg.sp_impl == "ring":
@@ -112,6 +122,36 @@ class SelfAttention(nn.Module):
             )
         out = out.reshape(B, S, cfg.hidden_size)
         return _dense(cfg.hidden_size, ("heads", "embed"), "out", cfg.dtype)(out)
+
+    def _decode_attention(self, q, k, v):
+        """KV-cache attention for incremental decoding. One generic path
+        serves prefill (S = prompt length, cache index 0) and per-token
+        decode (S = 1): new K/V write at the cache index, the query attends
+        to the full fixed-length cache under a global-position mask, and the
+        index advances by S — every shape static for XLA."""
+        import jax.lax as lax
+
+        cfg = self.cfg
+        B, S, H, D = q.shape
+        L = cfg.max_seq_len
+        ck = self.variable("cache", "cached_key", jnp.zeros, (B, L, H, D), cfg.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros, (B, L, H, D), cfg.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        if self.is_initializing():
+            return dot_product_attention(q, k, v, causal=True)
+        idx = ci.value
+        ck.value = lax.dynamic_update_slice(
+            ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
+        )
+        cv.value = lax.dynamic_update_slice(
+            cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
+        )
+        ci.value = idx + S
+        q_pos = idx + jnp.arange(S)  # global positions of these queries
+        k_pos = jnp.arange(L)
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,S,L]
+        return dot_product_attention(q, ck.value, cv.value, mask=mask)
 
 
 class EncoderLayer(nn.Module):
@@ -174,7 +214,25 @@ class Bert(nn.Module):
             jnp.float32,
         )
         S = token_ids.shape[1]
-        x = embed(token_ids) + pos_embed[:, :S].astype(cfg.dtype)
+        if cfg.decode:
+            # Positions advance with the KV caches: a cache-collection
+            # counter offsets the positional slice per apply.
+            pi = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if self.is_initializing():
+                pos = pos_embed[:, :S]
+            else:
+                import jax.lax as lax
+
+                pos = lax.dynamic_slice(
+                    pos_embed, (0, pi.value, 0),
+                    (1, S, cfg.hidden_size),
+                )
+                pi.value = pi.value + S
+            x = embed(token_ids) + pos.astype(cfg.dtype)
+        else:
+            x = embed(token_ids) + pos_embed[:, :S].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
         for i in range(cfg.num_layers):
             x = EncoderLayer(cfg, name=f"layer_{i}")(x, train=train)

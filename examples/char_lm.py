@@ -66,21 +66,18 @@ def main():
     print(f"loss {hist[0]['loss']:.3f} -> {hist[-1]['loss']:.3f} "
           f"({len(hist)} steps, {time.time()-t0:.1f}s)")
 
-    # greedy sampling from a seed
+    # KV-cache sampling (inference/generate.py): one jitted program —
+    # prefill over the seed, then a lax.scan of single-token decode steps.
     seed = "The reference "
-    ctx = [stoi.get(c, 0) for c in seed][-SEQ:]
-    out = list(seed)
-    rng = np.random.default_rng(0)
-    for _ in range(args.sample):
-        window = np.zeros((1, SEQ), np.int32)
-        window[0, -len(ctx):] = ctx[-SEQ:]
-        logits = trained.predict(window)[0, -1]
-        probs = np.exp(logits - logits.max())
-        probs = probs / probs.sum()
-        nxt = int(rng.choice(vocab, p=probs))
-        out.append(chars[nxt])
-        ctx.append(nxt)
-    print("sample:", "".join(out).replace("\n", "\\n")[:300])
+    prompt = np.asarray([[stoi.get(c, 0) for c in seed]], np.int32)
+    n = min(args.sample, SEQ - prompt.shape[1])
+    if n < args.sample:
+        print(f"note: capping --sample {args.sample} -> {n} "
+              f"(trained context {SEQ} - {prompt.shape[1]}-char seed)")
+    toks = dk.generate(trained.model, trained.variables, prompt, n,
+                       temperature=0.9, top_k=20, seed=0)
+    out = seed + "".join(chars[t] for t in toks[0])
+    print("sample:", out.replace("\n", "\\n")[:300])
 
 
 if __name__ == "__main__":
