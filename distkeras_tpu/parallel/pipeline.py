@@ -90,7 +90,7 @@ def pipeline_shardings(mesh: Mesh):
 
 
 def _pipeline_local(
-    stage_fn, stacked_params, microbatches, axis_name: str,
+    stage_fn, stacked_params, microbatches, rng, axis_name: str,
     virtual_stages: int, varying_axes=(),
 ):
     """Per-device body (inside shard_map).
@@ -142,7 +142,13 @@ def _pipeline_local(
             lambda x: lax.dynamic_index_in_dim(x, v, axis=0, keepdims=False),
             stacked_params,
         )
-        y = stage_fn(my_params, state)
+        if rng is None:
+            y = stage_fn(my_params, state)
+        else:
+            # unique stream per (tick, device): stochastic layers (dropout)
+            # get fresh masks for every stage application of every microbatch
+            key = jax.random.fold_in(jax.random.fold_in(rng, t), d)
+            y = stage_fn(my_params, state, key)
         # the last device at its last chunk owns microbatch m's final output
         emit = (d == num_devices - 1) & (v == V - 1) & (tau >= 0) & (m < M)
         emitted = jnp.where(emit, y, jnp.zeros_like(y))
@@ -167,6 +173,7 @@ def pipeline_apply(
     axis_name: str = "pp",
     io_spec: P | None = None,
     virtual_stages: int = 1,
+    rng=None,
 ):
     """Run an ``L``-stage pipeline over ``mesh[axis_name]``.
 
@@ -180,6 +187,9 @@ def pipeline_apply(
     - ``virtual_stages``: chunks per device (interleaved schedule); the
       fill/drain bubble shrinks ~V× at the cost of V× more (shallower)
       stage applications per tick window.
+    - ``rng``: optional PRNG key. When given, ``stage_fn`` is called as
+      ``stage_fn(params, x, key)`` with a key unique per (tick, device) —
+      the hook for stochastic layers (dropout) inside the pipelined trunk.
 
     Returns ``[M, B, ...]`` — the final stage's outputs. Differentiable
     end-to-end.
@@ -202,7 +212,11 @@ def pipeline_apply(
             virtual_stages=virtual_stages, varying_axes=varying_axes,
         ),
         mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: spec_params, stacked_params), io_spec),
+        in_specs=(
+            jax.tree.map(lambda _: spec_params, stacked_params),
+            io_spec,
+            P(),
+        ),
         out_specs=io_spec,
     )
     if microbatches.shape[0] < 1:
@@ -216,4 +230,4 @@ def pipeline_apply(
             f"needs {expected} — pass the same virtual_stages to "
             f"stack_stage_params and pipeline_apply"
         )
-    return fn(stacked_params, microbatches)
+    return fn(stacked_params, microbatches, rng)

@@ -14,9 +14,9 @@ one (each dp slice runs its own pipeline replica; XLA psums the gradients).
 GPipe fill/drain bubble: (P-1)/(M+P-1) of the schedule per direction —
 raise ``num_microbatches`` to amortize, or set ``virtual_stages=V`` for the
 Megatron-style interleaved schedule (V chunks per device; bubble shrinks
-~V×). Dropout inside the pipelined trunk
-is disabled (the stage rotation carries no per-stage rng streams yet);
-models trained here should use ``dropout_rate=0`` configs.
+~V×). Dropout inside the trunk works: each (tick, device) stage
+application gets a unique rng stream (``pipeline_apply(rng=...)``).
+Embedding-level dropout stays off (the embed/head run outside the pipe).
 """
 
 from __future__ import annotations
@@ -79,15 +79,8 @@ class PipelineTrainer(Trainer):
                 ".config (distkeras_tpu.models.bert zoo); got "
                 f"{self.model.name!r}"
             )
-        # Fail loudly on configs the pipelined trunk cannot honor: the stage
-        # rotation carries no per-stage rng streams (dropout would silently
-        # disable) and no sown-collection plumbing (MoE aux losses would
-        # silently drop).
-        if getattr(cfg, "dropout_rate", 0.0) > 0.0:
-            raise ValueError(
-                "PipelineTrainer runs the trunk deterministically; use a "
-                f"dropout_rate=0 config (got {cfg.dropout_rate})"
-            )
+        # Fail loudly on configs the pipelined trunk cannot honor: no
+        # sown-collection plumbing (MoE aux losses would silently drop).
         if getattr(cfg, "moe_experts", 0) > 0:
             raise ValueError(
                 "PipelineTrainer does not plumb MoE aux losses through the "
@@ -166,18 +159,30 @@ class PipelineTrainer(Trainer):
         M = self.num_microbatches
         want_acc = "accuracy" in self.metrics
 
-        def stage_fn(stage_params, x):
-            # Deterministic trunk (no dropout rng streams in the rotation).
-            for j in range(per_stage):
-                x = layer_mod.apply(
-                    {"params": stage_params[f"sub_{j}"]}, x, train=False
-                )
-            return x
+        dropout = getattr(cfg, "dropout_rate", 0.0) > 0.0
+
+        if dropout:
+            # Stochastic trunk: pipeline_apply hands each (tick, device)
+            # application a unique key; sub-layers fold in their index.
+            def stage_fn(stage_params, x, key):
+                for j in range(per_stage):
+                    x = layer_mod.apply(
+                        {"params": stage_params[f"sub_{j}"]}, x, train=True,
+                        rngs={"dropout": jax.random.fold_in(key, j)},
+                    )
+                return x
+        else:
+            def stage_fn(stage_params, x):
+                for j in range(per_stage):
+                    x = layer_mod.apply(
+                        {"params": stage_params[f"sub_{j}"]}, x, train=False
+                    )
+                return x
 
         if self.remat:
             stage_fn = jax.checkpoint(stage_fn)
 
-        def forward(train_params, batch):
+        def forward(train_params, batch, rng=None):
             rest = train_params["rest"]
             tokens = batch["features"].astype(jnp.int32)
             labels = batch["label"]
@@ -190,7 +195,7 @@ class PipelineTrainer(Trainer):
             mb = x.reshape(M, B // M, S, x.shape[-1])
             y = pipeline_apply(
                 stage_fn, train_params["stages"], mb, mesh,
-                virtual_stages=self.virtual_stages,
+                virtual_stages=self.virtual_stages, rng=rng,
             )
             x = y.reshape(B, S, y.shape[-1])
             x = ln_final.apply({"params": rest["ln_final"]}, x)
@@ -245,9 +250,9 @@ class PipelineTrainer(Trainer):
         forward = self._make_forward(mesh, per_stage)
 
         @jax.jit
-        def step(train_params, opt_state, batch):
+        def step(train_params, opt_state, batch, rng):
             (_, metrics), grads = jax.value_and_grad(forward, has_aux=True)(
-                train_params, batch
+                train_params, batch, rng
             )
             updates, opt_state = optimizer.update(grads, opt_state, train_params)
             train_params = optax.apply_updates(train_params, updates)
@@ -272,8 +277,12 @@ class PipelineTrainer(Trainer):
             sharding=batch_sh,
             buffer_size=2,
         )
-        for batch in feed:
-            train_params, opt_state, m = step(train_params, opt_state, batch)
+        dropout = getattr(self.cfg, "dropout_rate", 0.0) > 0.0
+        base_key = jax.random.PRNGKey(self.seed)
+        for i, batch in enumerate(feed):
+            rng = jax.random.fold_in(base_key, i) if dropout else None
+            train_params, opt_state, m = step(train_params, opt_state, batch,
+                                              rng)
             self.history.append(m)
         self.history = [{k: float(v) for k, v in h.items()} for h in self.history]
         self._emit_history()
