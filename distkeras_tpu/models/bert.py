@@ -181,7 +181,10 @@ class EncoderLayer(nn.Module):
             y = nn.gelu(y)
             y = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_out", cfg.dtype)(y)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
-        return x + y
+        # Keep the residual stream in the compute dtype: the MoE block takes
+        # the float32 LayerNorm output and would otherwise promote the whole
+        # downstream stack to f32 (off the bf16 MXU path).
+        return x + y.astype(x.dtype)
 
 
 class Bert(nn.Module):

@@ -91,7 +91,7 @@ def pipeline_shardings(mesh: Mesh):
 
 def _pipeline_local(
     stage_fn, stacked_params, microbatches, rng, axis_name: str,
-    virtual_stages: int, varying_axes=(),
+    virtual_stages: int, varying_axes=(), with_aux: bool = False,
 ):
     """Per-device body (inside shard_map).
 
@@ -125,9 +125,12 @@ def _pipeline_local(
         (axis_name, *varying_axes),
         to="varying",
     )
+    aux_acc = lax.pcast(
+        jnp.zeros((), jnp.float32), (axis_name, *varying_axes), to="varying"
+    )
 
     def tick(carry, t):
-        state, out_buf = carry
+        state, out_buf, aux_acc = carry
         tau = t - d
         v = v_of(tau)
         m = m_of(tau)
@@ -149,20 +152,37 @@ def _pipeline_local(
             # get fresh masks for every stage application of every microbatch
             key = jax.random.fold_in(jax.random.fold_in(rng, t), d)
             y = stage_fn(my_params, state, key)
+        if with_aux:
+            y, aux = y
+            # only real (stage, microbatch) applications contribute — the
+            # fill/drain garbage ticks run on zero states and are masked out
+            valid = (tau >= 0) & (m < M)
+            aux_acc = aux_acc + jnp.where(valid, aux.astype(jnp.float32), 0.0)
         # the last device at its last chunk owns microbatch m's final output
         emit = (d == num_devices - 1) & (v == V - 1) & (tau >= 0) & (m < M)
         emitted = jnp.where(emit, y, jnp.zeros_like(y))
         out_buf = out_buf.at[m_clip].add(emitted)
         state = lax.ppermute(y, axis_name, perm)
-        return (state, out_buf), None
+        return (state, out_buf, aux_acc), None
 
     # Static tick count: last microbatch M-1 emits at inj(M-1) + V·P - 1
     # (axis_size of a mesh axis is a static int, so T is trace-time known).
     T = ((M - 1) // num_devices) * V * num_devices + (
         (M - 1) % num_devices
     ) + V * num_devices
-    (_, out_buf), _ = lax.scan(tick, (state, out_buf), jnp.arange(T))
-    return lax.psum(out_buf, axis_name)
+    (_, out_buf, aux_acc), _ = lax.scan(
+        tick, (state, out_buf, aux_acc), jnp.arange(T)
+    )
+    out = lax.psum(out_buf, axis_name)
+    if not with_aux:
+        return out
+    # every (logical stage, microbatch) pair ran on exactly one device: the
+    # psum over pp is a disjoint sum, and dp replicas (different batch
+    # shards) average.
+    aux = lax.psum(aux_acc, axis_name)
+    for ax in varying_axes:
+        aux = lax.pmean(aux, ax)
+    return out, aux
 
 
 def pipeline_apply(
@@ -174,6 +194,7 @@ def pipeline_apply(
     io_spec: P | None = None,
     virtual_stages: int = 1,
     rng=None,
+    with_aux: bool = False,
 ):
     """Run an ``L``-stage pipeline over ``mesh[axis_name]``.
 
@@ -190,9 +211,14 @@ def pipeline_apply(
     - ``rng``: optional PRNG key. When given, ``stage_fn`` is called as
       ``stage_fn(params, x, key)`` with a key unique per (tick, device) —
       the hook for stochastic layers (dropout) inside the pipelined trunk.
+    - ``with_aux``: ``stage_fn`` returns ``(y, aux_scalar)``; the scalars
+      from every real stage application are summed across stages, summed
+      across microbatches, and averaged over dp replicas — the MoE
+      load-balance-loss plumbing. Returns ``(outputs, aux_sum)``; divide by
+      M for the per-batch mean.
 
-    Returns ``[M, B, ...]`` — the final stage's outputs. Differentiable
-    end-to-end.
+    Returns ``[M, B, ...]`` — the final stage's outputs (plus the aux sum
+    when ``with_aux``). Differentiable end-to-end.
     """
     from jax import shard_map
 
@@ -210,6 +236,7 @@ def pipeline_apply(
         partial(
             _pipeline_local, stage_fn, axis_name=axis_name,
             virtual_stages=virtual_stages, varying_axes=varying_axes,
+            with_aux=with_aux,
         ),
         mesh=mesh,
         in_specs=(
@@ -217,7 +244,7 @@ def pipeline_apply(
             io_spec,
             P(),
         ),
-        out_specs=io_spec,
+        out_specs=(io_spec, P()) if with_aux else io_spec,
     )
     if microbatches.shape[0] < 1:
         raise ValueError("need at least one microbatch")

@@ -68,6 +68,7 @@ class PipelineTrainer(Trainer):
         mesh=None,
         loss_weights=None,
         metric_stream=None,
+        aux_loss_weight: float = 0.01,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate=learning_rate, seed=seed,
@@ -78,13 +79,6 @@ class PipelineTrainer(Trainer):
                 "PipelineTrainer needs a transformer-family model with a "
                 ".config (distkeras_tpu.models.bert zoo); got "
                 f"{self.model.name!r}"
-            )
-        # Fail loudly on configs the pipelined trunk cannot honor: no
-        # sown-collection plumbing (MoE aux losses would silently drop).
-        if getattr(cfg, "moe_experts", 0) > 0:
-            raise ValueError(
-                "PipelineTrainer does not plumb MoE aux losses through the "
-                "pipe; use a dense-MLP config"
             )
         self.cfg = cfg
         self.num_stages = num_stages
@@ -103,6 +97,13 @@ class PipelineTrainer(Trainer):
         self.label_col = label_col
         self.num_epoch = int(num_epoch)
         self.mesh = mesh
+        # Weight on the MoE load-balance loss summed through the pipe
+        # (MoE configs only; experts are replicated within each stage — the
+        # PipelineTrainer mesh has no ep axis).
+        self.aux_loss_weight = float(aux_loss_weight)
+        # Derived once; _make_forward and train() must agree on these.
+        self._dropout = getattr(cfg, "dropout_rate", 0.0) > 0.0
+        self._moe = getattr(cfg, "moe_experts", 0) > 0
 
     # -- model surgery -------------------------------------------------------
 
@@ -159,25 +160,37 @@ class PipelineTrainer(Trainer):
         M = self.num_microbatches
         want_acc = "accuracy" in self.metrics
 
-        dropout = getattr(cfg, "dropout_rate", 0.0) > 0.0
+        dropout = self._dropout
+        moe = self._moe
+
+        def _run_sublayers(stage_params, x, key):
+            """Apply this stage's layers; collect sown MoE aux losses."""
+            aux = jnp.float32(0.0)
+            for j in range(per_stage):
+                scope = {"params": stage_params[f"sub_{j}"]}
+                rngs = (
+                    {"dropout": jax.random.fold_in(key, j)} if dropout else None
+                )
+                if moe:
+                    x, st = layer_mod.apply(
+                        scope, x, train=dropout, rngs=rngs,
+                        mutable=["aux_loss"],
+                    )
+                    aux = aux + sum(
+                        jnp.sum(leaf) for leaf in jax.tree.leaves(st["aux_loss"])
+                    )
+                else:
+                    x = layer_mod.apply(scope, x, train=dropout, rngs=rngs)
+            return (x, aux) if moe else x
 
         if dropout:
             # Stochastic trunk: pipeline_apply hands each (tick, device)
             # application a unique key; sub-layers fold in their index.
             def stage_fn(stage_params, x, key):
-                for j in range(per_stage):
-                    x = layer_mod.apply(
-                        {"params": stage_params[f"sub_{j}"]}, x, train=True,
-                        rngs={"dropout": jax.random.fold_in(key, j)},
-                    )
-                return x
+                return _run_sublayers(stage_params, x, key)
         else:
             def stage_fn(stage_params, x):
-                for j in range(per_stage):
-                    x = layer_mod.apply(
-                        {"params": stage_params[f"sub_{j}"]}, x, train=False
-                    )
-                return x
+                return _run_sublayers(stage_params, x, None)
 
         if self.remat:
             stage_fn = jax.checkpoint(stage_fn)
@@ -195,14 +208,20 @@ class PipelineTrainer(Trainer):
             mb = x.reshape(M, B // M, S, x.shape[-1])
             y = pipeline_apply(
                 stage_fn, train_params["stages"], mb, mesh,
-                virtual_stages=self.virtual_stages, rng=rng,
+                virtual_stages=self.virtual_stages, rng=rng, with_aux=moe,
             )
+            if moe:
+                y, aux_sum = y
+                aux = aux_sum / M  # per-microbatch means -> batch mean
             x = y.reshape(B, S, y.shape[-1])
             x = ln_final.apply({"params": rest["ln_final"]}, x)
             logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T
             logits = logits + rest["mlm_bias"]
             loss = loss_fn(logits, labels)
             metrics = {"loss": loss}
+            if moe:
+                loss = loss + self.aux_loss_weight * aux
+                metrics["aux_loss"] = aux
             if want_acc:
                 from distkeras_tpu.ops.metrics import accuracy
 
@@ -277,10 +296,9 @@ class PipelineTrainer(Trainer):
             sharding=batch_sh,
             buffer_size=2,
         )
-        dropout = getattr(self.cfg, "dropout_rate", 0.0) > 0.0
         base_key = jax.random.PRNGKey(self.seed)
         for i, batch in enumerate(feed):
-            rng = jax.random.fold_in(base_key, i) if dropout else None
+            rng = jax.random.fold_in(base_key, i) if self._dropout else None
             train_params, opt_state, m = step(train_params, opt_state, batch,
                                               rng)
             self.history.append(m)

@@ -23,6 +23,9 @@ def main():
     ap.add_argument("--causal", action="store_true")
     ap.add_argument("--flash", action="store_true",
                     help="use ring_flash_attention (Pallas kernels per hop)")
+    ap.add_argument("--ulysses", action="store_true",
+                    help="all-to-all sequence parallelism (ops/ulysses.py) "
+                         "instead of the K/V ring; needs heads %% devices == 0")
     from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
     add_platform_flag(ap)
     args = ap.parse_args()
@@ -54,16 +57,24 @@ def main():
           f"ring peak {ring_bytes/1e9:.2f} GB across all devices")
 
     t0 = time.time()
-    if args.flash:
+    if args.ulysses:
+        from distkeras_tpu.ops.ulysses import ulysses_self_attention
+
+        kind = "ulysses"
+        out = ulysses_self_attention(q, k, v, mesh, seq_axis="sp",
+                                     causal=args.causal)
+    elif args.flash:
         from distkeras_tpu.ops.ring_flash import ring_flash_attention
 
+        kind = "ring-flash"
         out = ring_flash_attention(q, k, v, mesh, seq_axis="sp",
                                    causal=args.causal)
     else:
+        kind = "ring"
         out = ring_self_attention(q, k, v, mesh, seq_axis="sp",
                                   causal=args.causal)
     out = np.asarray(out)
-    print(f"ring attention done in {time.time()-t0:.1f}s "
+    print(f"{kind} attention done in {time.time()-t0:.1f}s "
           f"out={out.shape} finite={np.isfinite(out).all()}")
 
 
