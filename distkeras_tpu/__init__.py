@@ -56,7 +56,7 @@ from distkeras_tpu.inference.evaluators import (
     ConfusionMatrixEvaluator,
     PrecisionRecallEvaluator,
 )
-from distkeras_tpu.inference.generate import Generator, generate
+from distkeras_tpu.inference.generate import Generator, beam_search, generate
 from distkeras_tpu.utils.config import TrainerConfig
 
 __all__ = [
@@ -86,6 +86,7 @@ __all__ = [
     "PrecisionRecallEvaluator",
     "ConfusionMatrixEvaluator",
     "generate",
+    "beam_search",
     "Generator",
     "TrainerConfig",
 ]

@@ -1,5 +1,5 @@
 from distkeras_tpu.inference.evaluators import AccuracyEvaluator
-from distkeras_tpu.inference.generate import Generator, generate
+from distkeras_tpu.inference.generate import Generator, beam_search, generate
 from distkeras_tpu.inference.predictors import ModelPredictor, Predictor
 
 __all__ = [
@@ -7,5 +7,6 @@ __all__ = [
     "ModelPredictor",
     "AccuracyEvaluator",
     "generate",
+    "beam_search",
     "Generator",
 ]

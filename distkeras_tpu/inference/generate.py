@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["generate", "Generator"]
+__all__ = ["generate", "beam_search", "Generator"]
 
 
 def _decode_module(model):
@@ -152,6 +152,90 @@ def generate(
     return np.asarray(out)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("module", "max_new_tokens", "num_beams")
+)
+def _beam_jit(module, params, prompt, max_new_tokens, num_beams):
+    from jax import lax
+
+    K = num_beams
+    B = prompt.shape[0]
+    N = max_new_tokens
+
+    def apply(cache, tokens):
+        logits, mut = module.apply(
+            {"params": params, "cache": cache}, tokens, train=False,
+            mutable=["cache"],
+        )
+        return jax.nn.log_softmax(logits[:, -1].astype(jnp.float32)), mut["cache"]
+
+    # Prefill on the un-replicated batch, then fan each item out to K beams
+    # (cache leaves with a batch dim repeat; per-layer index scalars are
+    # beam-invariant and stay shared).
+    logp, cache = apply(_empty_cache(module, B), prompt)  # [B, V]
+    V = logp.shape[-1]
+    scores, toks = lax.top_k(logp, K)  # [B, K]
+    rep = jnp.repeat(jnp.arange(B), K)
+    cache = jax.tree.map(lambda c: c[rep] if c.ndim > 0 else c, cache)
+    hist = jnp.zeros((B, K, N), jnp.int32).at[:, :, 0].set(toks)
+
+    def step(carry, i):
+        cache, tok, scores, hist = carry
+        logp, cache = apply(cache, tok.reshape(B * K, 1))  # [B*K, V]
+        cand = scores[:, :, None] + logp.reshape(B, K, V)
+        new_scores, idx = lax.top_k(cand.reshape(B, K * V), K)  # [B, K]
+        parent = idx // V
+        new_tok = (idx % V).astype(jnp.int32)
+        gather = (jnp.arange(B)[:, None] * K + parent).reshape(-1)  # [B*K]
+        cache = jax.tree.map(lambda c: c[gather] if c.ndim > 0 else c, cache)
+        hist = jnp.take_along_axis(hist, parent[:, :, None], axis=1)
+        hist = hist.at[:, :, i].set(new_tok)
+        return (cache, new_tok, new_scores, hist), None
+
+    if N > 1:
+        (cache, _, scores, hist), _ = lax.scan(
+            step, (cache, toks, scores, hist), jnp.arange(1, N)
+        )
+    return hist, scores
+
+
+def beam_search(
+    model,
+    variables,
+    prompt,
+    max_new_tokens: int,
+    num_beams: int = 4,
+):
+    """Fixed-length beam search: decode ``max_new_tokens`` keeping the
+    ``num_beams`` highest-total-log-probability continuations per batch
+    item. Each beam carries its own KV cache; beam reordering gathers the
+    caches along the (flattened) beam axis inside one ``lax.scan``.
+
+    Returns ``(sequences, scores)``: ``[B, num_beams, max_new_tokens]``
+    int32 tokens sorted by score, and ``[B, num_beams]`` float32 total
+    log-probabilities. ``sequences[:, 0]`` is the best beam. No EOS
+    handling (the model zoo has no reserved EOS semantics) — decode is
+    fixed-length.
+    """
+    module, dec_cfg = _decode_module(model)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [B, S0]; got {prompt.shape}")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    trained_len = getattr(model, "input_shape", (dec_cfg.max_seq_len,))[0]
+    limit = min(dec_cfg.max_seq_len, trained_len)
+    if prompt.shape[1] + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds {limit} (trained context)"
+        )
+    seqs, scores = _beam_jit(
+        module, variables["params"], prompt, max_new_tokens, num_beams
+    )
+    return np.asarray(seqs), np.asarray(scores)
+
+
 class Generator:
     """Stateful convenience wrapper around :func:`generate` holding the
     model + trained variables (mirrors the Predictor surface)."""
@@ -163,3 +247,7 @@ class Generator:
     def __call__(self, prompt, max_new_tokens: int, **kw):
         return generate(self.model, self.variables, prompt, max_new_tokens,
                         **kw)
+
+    def beam(self, prompt, max_new_tokens: int, num_beams: int = 4):
+        return beam_search(self.model, self.variables, prompt,
+                           max_new_tokens, num_beams=num_beams)

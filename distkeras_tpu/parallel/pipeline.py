@@ -1,5 +1,6 @@
-"""Pipeline parallelism over a ``pp`` mesh axis (GPipe + interleaved 1F1B-
-style virtual stages).
+"""Pipeline parallelism over a ``pp`` mesh axis (GPipe + Megatron-style
+interleaved virtual stages; the backward is the scan's autodiff
+time-reversal — GPipe-ordered, not 1F1B).
 
 Absent from the reference (SURVEY §2 parallelism table) but a first-class
 axis here. The design is SPMD, not a scheduler: every device runs the same

@@ -123,3 +123,20 @@ def test_interleaved_pipeline_gradients(rng):
 def test_stack_stage_params_rejects_indivisible(rng):
     with pytest.raises(ValueError, match="virtual_stages"):
         stack_stage_params(_stages(rng, 6, 4), virtual_stages=4)
+
+def test_pipeline_schedule_property(rng):
+    """Schedule invariant over (P, V, M): the interleaved rotation equals
+    sequential application for every divisor mesh and ragged microbatch
+    count (keep the sweep small — each case is a fresh XLA compile)."""
+    D, B = 8, 8
+    for P, V, M in [(2, 1, 3), (2, 3, 4), (8, 2, 3), (4, 4, 9), (2, 2, 7)]:
+        mesh = make_mesh({"dp": 8 // P, "pp": P} if P < 8 else {"pp": P})
+        stages = _stages(rng, P * V, D)
+        x = rng.normal(size=(M, B, D)).astype(np.float32)
+        out = pipeline_apply(
+            _stage_fn, stack_stage_params(stages, virtual_stages=V), x, mesh,
+            virtual_stages=V,
+        )
+        ref = np.stack([_sequential(stages, x[m]) for m in range(M)])
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5,
+                                   rtol=1e-5, err_msg=f"P={P} V={V} M={M}")
