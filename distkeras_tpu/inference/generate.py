@@ -49,6 +49,28 @@ def _decode_module(model):
     return Bert(dec_cfg), dec_cfg
 
 
+def _check_context(model, dec_cfg, prompt, max_new_tokens: int):
+    """Shared validation for generate()/beam_search(): bound decoding by
+    the TRAINED context length — factory configs can have cache capacity
+    (max_seq_len) beyond the seq_len training ever touched, and positions
+    past it hold randomly-initialized positional embeddings."""
+    if prompt.ndim != 2:
+        raise ValueError(f"prompt must be [B, S0]; got {prompt.shape}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    S0 = prompt.shape[1]
+    trained_len = getattr(model, "input_shape", (dec_cfg.max_seq_len,))[0]
+    limit = min(dec_cfg.max_seq_len, trained_len)
+    if S0 + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"{limit} (= min(max_seq_len {dec_cfg.max_seq_len}, trained "
+            f"context {trained_len})); positions past the trained context "
+            f"have untrained positional embeddings — build the model with a "
+            f"larger seq_len to decode further"
+        )
+
+
 def _empty_cache(module, batch_size: int):
     """Cache PyTree of zeros, derived via eval_shape (never materializes a
     throwaway set of params)."""
@@ -125,22 +147,7 @@ def generate(
     """
     module, dec_cfg = _decode_module(model)
     prompt = jnp.asarray(prompt, jnp.int32)
-    if prompt.ndim != 2:
-        raise ValueError(f"prompt must be [B, S0]; got {prompt.shape}")
-    S0 = prompt.shape[1]
-    # Bound by the TRAINED context length, not the cache capacity: factory
-    # configs can have max_seq_len > the seq_len training ever touched, and
-    # positions past it hold randomly-initialized positional embeddings.
-    trained_len = getattr(model, "input_shape", (dec_cfg.max_seq_len,))[0]
-    limit = min(dec_cfg.max_seq_len, trained_len)
-    if S0 + max_new_tokens > limit:
-        raise ValueError(
-            f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"{limit} (= min(max_seq_len {dec_cfg.max_seq_len}, trained "
-            f"context {trained_len})); positions past the trained context "
-            f"have untrained positional embeddings — build the model with a "
-            f"larger seq_len to decode further"
-        )
+    _check_context(model, dec_cfg, prompt, max_new_tokens)
     if top_k is not None and not 1 <= top_k <= dec_cfg.vocab_size:
         raise ValueError(
             f"top_k={top_k} outside [1, vocab_size={dec_cfg.vocab_size}]"
@@ -219,17 +226,9 @@ def beam_search(
     """
     module, dec_cfg = _decode_module(model)
     prompt = jnp.asarray(prompt, jnp.int32)
-    if prompt.ndim != 2:
-        raise ValueError(f"prompt must be [B, S0]; got {prompt.shape}")
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
-    trained_len = getattr(model, "input_shape", (dec_cfg.max_seq_len,))[0]
-    limit = min(dec_cfg.max_seq_len, trained_len)
-    if prompt.shape[1] + max_new_tokens > limit:
-        raise ValueError(
-            f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
-            f"exceeds {limit} (trained context)"
-        )
+    _check_context(model, dec_cfg, prompt, max_new_tokens)
     seqs, scores = _beam_jit(
         module, variables["params"], prompt, max_new_tokens, num_beams
     )

@@ -35,7 +35,7 @@ from distkeras_tpu.parallel.pipeline import (
     pipeline_shardings,
     stack_stage_params,
 )
-from distkeras_tpu.training.trainers import Trainer
+from distkeras_tpu.training.trainers import Trainer, _StepCheckpointer
 
 __all__ = ["PipelineTrainer"]
 
@@ -69,6 +69,9 @@ class PipelineTrainer(Trainer):
         loss_weights=None,
         metric_stream=None,
         aux_loss_weight: float = 0.01,
+        checkpoint_dir: str | None = None,
+        checkpoint_interval_s: float = 60.0,
+        resume: bool = False,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate=learning_rate, seed=seed,
@@ -101,6 +104,11 @@ class PipelineTrainer(Trainer):
         # (MoE configs only; experts are replicated within each stage — the
         # PipelineTrainer mesh has no ep axis).
         self.aux_loss_weight = float(aux_loss_weight)
+        # Orbax step checkpoints (same contract as the sync trainer): timed
+        # saves + a final save; resume fast-forwards the deterministic feed.
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_interval_s = float(checkpoint_interval_s)
+        self.resume = bool(resume)
         # Derived once; _make_forward and train() must agree on these.
         self._dropout = getattr(cfg, "dropout_rate", 0.0) > 0.0
         self._moe = getattr(cfg, "moe_experts", 0) > 0
@@ -284,24 +292,56 @@ class PipelineTrainer(Trainer):
         batch_sh = NamedSharding(mesh, batch_spec)
 
         self.history = []
-        feed = DeviceFeed(
-            minibatches(
-                dataset,
-                self.batch_size,
-                self.features_col,
-                self.label_col,
-                num_epoch=self.num_epoch,
-                seed=self.seed if shuffle else None,
-            ),
-            sharding=batch_sh,
-            buffer_size=2,
+        live = {"params": train_params, "opt": opt_state}
+        # Re-place restored leaves on the live template's mesh shardings:
+        # restored arrays come back committed, so every leaf must land on
+        # the SAME device set — mesh-sharded leaves keep their sharding,
+        # everything else replicates over the mesh.
+        repl_all = NamedSharding(mesh, P())
+
+        def _place(restored):
+            return jax.tree.map(
+                lambda l, n: jax.device_put(
+                    n,
+                    l.sharding
+                    if isinstance(getattr(l, "sharding", None), NamedSharding)
+                    else repl_all,
+                ),
+                live,
+                restored,
+            )
+
+        ck = _StepCheckpointer(
+            self.checkpoint_dir, self.checkpoint_interval_s, self.resume,
+            like=live, place=_place,
         )
+        if ck.state is not None:
+            train_params, opt_state = ck.state["params"], ck.state["opt"]
+
+        batches = ck.skip_consumed(minibatches(
+            dataset,
+            self.batch_size,
+            self.features_col,
+            self.label_col,
+            num_epoch=self.num_epoch,
+            seed=self.seed if shuffle else None,
+        ))
+        feed = DeviceFeed(batches, sharding=batch_sh, buffer_size=2)
         base_key = jax.random.PRNGKey(self.seed)
-        for i, batch in enumerate(feed):
-            rng = jax.random.fold_in(base_key, i) if self._dropout else None
-            train_params, opt_state, m = step(train_params, opt_state, batch,
-                                              rng)
-            self.history.append(m)
+        step_no = ck.start_step
+        try:
+            for i, batch in enumerate(feed, start=ck.start_step):
+                rng = jax.random.fold_in(base_key, i) if self._dropout else None
+                train_params, opt_state, m = step(train_params, opt_state,
+                                                  batch, rng)
+                self.history.append(m)
+                step_no = i + 1
+                ck.maybe_save(
+                    step_no, {"params": train_params, "opt": opt_state}
+                )
+            ck.finalize(step_no, {"params": train_params, "opt": opt_state})
+        finally:
+            ck.close()
         self.history = [{k: float(v) for k, v in h.items()} for h in self.history]
         self._emit_history()
         self.record_training_stop()

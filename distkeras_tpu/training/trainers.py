@@ -26,6 +26,7 @@ the engine underneath:
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -81,6 +82,57 @@ def _as_model(model) -> Model:
     if isinstance(model, Model):
         return model
     return Model.from_keras(model)
+
+
+class _StepCheckpointer:
+    """Shared save/resume scaffold for step-loop trainers (sync + pipeline).
+
+    One copy of the protocol: restore the latest step into the live state
+    template (optionally re-placed via ``place``), timed ``wait=False``
+    saves during the loop, a final blocking save, and a ``close()`` that is
+    safe to call from ``finally`` — so a crash mid-train still finalizes
+    any in-flight async save instead of leaving an unfinalized tmp step.
+    """
+
+    def __init__(self, directory, interval_s, resume, like, place=None):
+        self.mgr = None
+        self.start_step = 0
+        self.state = None
+        self.interval_s = float(interval_s)
+        if directory is None:
+            return
+        from distkeras_tpu.checkpoint import CheckpointManager
+
+        self.mgr = CheckpointManager(directory)
+        if resume and self.mgr.latest_step() is not None:
+            restored = self.mgr.restore(like={"state": like})["state"]
+            self.state = place(restored) if place is not None else restored
+            self.start_step = self.mgr.latest_step()
+        self._last = time.monotonic()
+
+    def skip_consumed(self, batches):
+        """Fast-forward the deterministic batch stream past the restored
+        step."""
+        if self.start_step:
+            return itertools.islice(batches, self.start_step, None)
+        return batches
+
+    def maybe_save(self, step, state):
+        if (
+            self.mgr is not None
+            and time.monotonic() - self._last >= self.interval_s
+        ):
+            self.mgr.save(step, state=state, wait=False)
+            self._last = time.monotonic()
+
+    def finalize(self, step, state):
+        if self.mgr is not None and step > self.start_step:
+            self.mgr.save(step, state=state)
+
+    def close(self):
+        if self.mgr is not None:
+            self.mgr.close()
+            self.mgr = None
 
 
 class Trainer:
@@ -448,6 +500,9 @@ class SynchronousDistributedTrainer(Trainer):
         zero1: bool = False,
         shard_sequence: bool = False,
         aux_loss_weight: float = 0.01,
+        checkpoint_dir: str | None = None,
+        checkpoint_interval_s: float = 60.0,
+        resume: bool = False,
         loss_weights=None,
         metric_stream=None,
     ):
@@ -461,6 +516,13 @@ class SynchronousDistributedTrainer(Trainer):
         self.num_epoch = int(num_epoch)
         self.mesh = mesh
         self.zero1 = bool(zero1)
+        # Orbax step checkpoints (parity with the async family): save every
+        # checkpoint_interval_s plus a final save; resume=True restores the
+        # latest step and fast-forwards the deterministic batch stream past
+        # it, so a resumed run reproduces the uninterrupted one.
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_interval_s = float(checkpoint_interval_s)
+        self.resume = bool(resume)
         # Shard the sequence dimension of [B, S] batches over the mesh's sp
         # axis (XLA inserts the activation collectives; ring attention is the
         # shard_map alternative for attention itself).
@@ -513,22 +575,35 @@ class SynchronousDistributedTrainer(Trainer):
                 k: jax.device_put(v, batch_sharding) for k, v in b.items()
             }
 
-        self.history = []
-        feed = DeviceFeed(
-            minibatches(
-                dataset,
-                global_batch,
-                self.features_col,
-                self.label_col,
-                num_epoch=self.num_epoch,
-                seed=self.seed if shuffle else None,
-            ),
-            put_fn=shard_fn,
-            buffer_size=2,
+        # The live state is the restore template: its jax.Arrays carry
+        # shardings, so a GSPMD state restores distributed.
+        ck = _StepCheckpointer(
+            self.checkpoint_dir, self.checkpoint_interval_s, self.resume,
+            like=state,
         )
-        for batch in feed:
-            state, m = step_fn(state, batch)
-            self.history.append(m)
+        if ck.state is not None:
+            state = ck.state
+
+        self.history = []
+        batches = ck.skip_consumed(minibatches(
+            dataset,
+            global_batch,
+            self.features_col,
+            self.label_col,
+            num_epoch=self.num_epoch,
+            seed=self.seed if shuffle else None,
+        ))
+        feed = DeviceFeed(batches, put_fn=shard_fn, buffer_size=2)
+        step_no = ck.start_step
+        try:
+            for i, batch in enumerate(feed, start=ck.start_step):
+                state, m = step_fn(state, batch)
+                self.history.append(m)
+                step_no = i + 1
+                ck.maybe_save(step_no, state)
+            ck.finalize(step_no, state)
+        finally:
+            ck.close()
         self.history = [{k: float(v) for k, v in h.items()} for h in self.history]
         self._emit_history()
         self.record_training_stop()
