@@ -138,16 +138,32 @@ def generate(
     top_k: int | None = None,
     greedy: bool = False,
     seed: int = 0,
+    mesh=None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, S0]``.
 
     Returns an int32 ``[B, max_new_tokens]`` array of sampled token ids.
     One jitted program per (module, max_new_tokens, top_k, greedy) — reruns
     with different prompts/temperatures/seeds reuse the compilation.
+
+    ``mesh``: batch-parallel decoding — the prompt shards over the mesh's
+    ``dp`` axis (``B`` must divide it) and GSPMD propagates the sharding
+    through the KV caches and the whole decode loop; each dp slice decodes
+    its rows with no cross-slice communication.
     """
     module, dec_cfg = _decode_module(model)
     prompt = jnp.asarray(prompt, jnp.int32)
     _check_context(model, dec_cfg, prompt, max_new_tokens)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+        if prompt.shape[0] % mesh.shape[axis]:
+            raise ValueError(
+                f"batch {prompt.shape[0]} not divisible by mesh "
+                f"{axis}={mesh.shape[axis]}"
+            )
+        prompt = jax.device_put(prompt, NamedSharding(mesh, P(axis)))
     if top_k is not None and not 1 <= top_k <= dec_cfg.vocab_size:
         raise ValueError(
             f"top_k={top_k} outside [1, vocab_size={dec_cfg.vocab_size}]"

@@ -120,3 +120,20 @@ def test_beam_search_scores_exact_and_sorted(lm, rng):
 
     greedy = dk.generate(model, variables, prompt, n, greedy=True)
     assert scores[0, 0] >= true_logprob(greedy[0]) - 0.05
+
+
+def test_generate_dp_sharded_matches_unsharded(lm, rng):
+    """Batch-parallel decoding on a dp mesh produces the same greedy tokens
+    as the single-device path (GSPMD propagates the batch sharding through
+    the KV caches)."""
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    model, variables = lm
+    prompt = np.asarray(rng.integers(0, 64, size=(8, 4)), np.int32)
+    plain = dk.generate(model, variables, prompt, 5, greedy=True)
+    mesh = make_mesh({"dp": 8})
+    sharded = dk.generate(model, variables, prompt, 5, greedy=True, mesh=mesh)
+    np.testing.assert_array_equal(plain, sharded)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        dk.generate(model, variables, prompt[:3], 5, greedy=True, mesh=mesh)
