@@ -25,9 +25,47 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+
+def _serialize_chip_access():
+    """Hold the repo-wide TPU lock for the life of this process: the
+    .tpu_watch.sh watcher serializes every chip touch through it (the axon
+    tunnel is single-client; two processes on the chip wedged it in round
+    1). Blocks until the watcher's current window ends."""
+    try:
+        import fcntl
+
+        fh = open(os.path.join(os.path.dirname(__file__) or ".", ".tpu.lock"), "w")
+        fcntl.flock(fh, fcntl.LOCK_EX)
+        return fh  # released on process exit
+    except Exception:
+        return None
+
+
+def _tpu_healthy(timeout_s: int = 300) -> bool:
+    """Probe TPU init in a SUBPROCESS with a hard timeout — a wedged chip
+    hangs `jax.devices()` forever in-process, which is unrecoverable once
+    attempted (round-1 postmortem: BENCH_r01 died exactly this way)."""
+    code = (
+        "import jax\n"
+        "ds = jax.devices()\n"
+        "assert ds[0].platform != 'cpu'\n"
+        "import jax.numpy as jnp\n"
+        "(jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def _model_and_batch(kind: str, batch: int):
@@ -71,6 +109,18 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
+    _lock = _serialize_chip_access()  # noqa: F841 — held until process exit
+    tpu_unavailable = False
+    if os.environ.get("BENCH_FORCE_CPU") or not _tpu_healthy():
+        # A wedged/absent chip must not hang the whole bench with nothing
+        # printed (round-1 failure mode): fall back to an honest CPU
+        # measurement, flagged so the driver/judge can tell it apart.
+        tpu_unavailable = not os.environ.get("BENCH_FORCE_CPU")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("bench: TPU backend unavailable; measuring on CPU",
+              file=sys.stderr)
     import jax
 
     # Persistent compile cache: first compile through the remote-compile
@@ -133,6 +183,7 @@ def main() -> None:
         "vs_baseline": round(mfu / 0.35, 4) if mfu else None,
         "detail": {
             "mfu": round(mfu, 4),
+            "tpu_unavailable": tpu_unavailable,
             "model": model.name,
             "batch_size": batch,
             "step_time_mean_s": round(summary["step_time_mean_s"], 5),
