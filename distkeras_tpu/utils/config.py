@@ -20,6 +20,7 @@ _TRAINER_NAMES = (
     "EnsembleTrainer",
     "AveragingTrainer",
     "SynchronousDistributedTrainer",
+    "PipelineTrainer",
     "DOWNPOUR",
     "ADAG",
     "AEASGD",

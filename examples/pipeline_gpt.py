@@ -1,0 +1,79 @@
+"""Pipeline-parallel GPT training + KV-cache generation, end to end.
+
+A 4-layer causal LM trains with its trunk pipelined over a `pp` mesh axis
+(optionally interleaved: 2 virtual chunks per device), then the trained
+weights drive beam-search generation through the KV-cache decoder — the
+two headline round-2 capabilities in one script. The reference framework
+has neither (SURVEY §2: pipeline absent; predictors are batch-transform
+only).
+
+Run (8 virtual devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/pipeline_gpt.py --platform cpu --devices 8
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument("--virtual-stages", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=32)
+    from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
+    add_platform_flag(ap)
+    args = ap.parse_args()
+    apply_platform_args(args)
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.bert import BertConfig, _make
+
+    vocab, seq = 64, 32
+    cfg = BertConfig(
+        vocab_size=vocab, hidden_size=64, num_layers=4, num_heads=4,
+        mlp_dim=128, max_seq_len=seq, dropout_rate=0.0, causal=True,
+    )
+    model = _make(cfg, seq, "gpt_pipe")
+
+    # Cyclic-sequence next-token task (loss collapses if training works).
+    base = np.arange(4096) % vocab
+    windows = np.stack([base[i : i + seq] for i in range(512)])
+    features = windows.astype(np.int32)
+    labels = np.roll(windows, -1, axis=1).astype(np.int32)
+    ds = dk.Dataset.from_arrays(features=features, label=labels)
+
+    trainer = dk.PipelineTrainer(
+        model, worker_optimizer="adam", learning_rate=3e-3,
+        num_stages=args.stages, virtual_stages=args.virtual_stages,
+        num_microbatches=4, batch_size=args.batch_size,
+        num_epoch=args.epochs, seed=0,
+    )
+    t0 = time.time()
+    trained = trainer.train(ds, shuffle=True)
+    hist = trainer.get_history()
+    print(
+        f"pp={args.stages} V={args.virtual_stages}: loss "
+        f"{hist[0]['loss']:.3f} -> {hist[-1]['loss']:.3f} "
+        f"({len(hist)} steps, {time.time()-t0:.1f}s)"
+    )
+
+    prompt = features[:1, :8]
+    greedy = dk.generate(trained.model, trained.variables, prompt, 12,
+                         greedy=True)
+    seqs, scores = dk.beam_search(trained.model, trained.variables, prompt,
+                                  12, num_beams=4)
+    expect = labels[0, 7:19]
+    print("prompt:     ", prompt[0].tolist())
+    print("greedy:     ", greedy[0].tolist())
+    print("beam best:  ", seqs[0, 0].tolist(), f"(score {scores[0,0]:.2f})")
+    print("ground truth:", expect.tolist())
+    acc = float(np.mean(greedy[0] == expect))
+    print(f"greedy continuation accuracy vs cycle: {acc:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,3 +40,23 @@ def test_build_rejects_inapplicable_kwargs():
     cfg = TrainerConfig(trainer="SingleTrainer", num_workers=4)
     with pytest.raises(ValueError, match="num_workers"):
         cfg.build(_model())
+
+
+def test_build_pipeline_trainer():
+    from distkeras_tpu.models.bert import BertConfig, _make
+
+    cfg = TrainerConfig(
+        trainer="PipelineTrainer", worker_optimizer="adam",
+        learning_rate=1e-3, batch_size=16, num_epoch=1,
+    )
+    bcfg = BertConfig(
+        vocab_size=32, hidden_size=32, num_layers=2, num_heads=2,
+        mlp_dim=64, max_seq_len=16, dropout_rate=0.0,
+    )
+    trainer = cfg.build(_make(bcfg, 16, "bp_cfg"))
+    assert isinstance(trainer, dk.PipelineTrainer)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 32, size=(64, 16)).astype(np.int32)
+    trainer.num_stages = 2
+    trained = trainer.train(dk.Dataset.from_arrays(features=toks, label=toks))
+    assert np.isfinite(trained.predict(toks[:2])).all()
