@@ -25,7 +25,10 @@ EOF
 }
 
 run_bench() {  # $1 model  $2 timeout  $3 outfile
-  BENCH_MODEL="$1" flock "$LOCK" timeout --signal=KILL "$2" \
+  # TPU_LOCK_HELD: tell bench.py the flock is already held by this wrapper
+  # so it skips its own LOCK_EX (same-file flock across two open file
+  # descriptions self-deadlocks even within one process tree).
+  BENCH_MODEL="$1" TPU_LOCK_HELD=1 flock "$LOCK" timeout --signal=KILL "$2" \
     python bench.py > "$3" 2> "$3.err"
 }
 

@@ -87,6 +87,9 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
     def all_steps(self) -> list[int]:
         return list(self._mgr.all_steps())
 

@@ -28,23 +28,34 @@ def _epoch_batch_indices(
     num_epoch: int,
     seed: int | None,
     drop_remainder: bool = True,
+    start_batch: int = 0,
 ) -> Iterator[np.ndarray]:
     """The ONE source of batch order: yield per-batch row-index arrays with
     per-epoch reshuffle (``default_rng(seed + epoch)``) and remainder
     handling. Both the host feed (:func:`minibatches`) and the device-cache
     feed (:func:`index_windows`) draw from this, so their orders match
     batch-for-batch by construction — the cached/host interchangeability
-    the trainers rely on."""
+    the trainers rely on.
+
+    ``start_batch`` fast-forwards the stream arithmetically — resume after
+    N consumed steps starts at the exact (epoch, offset) position without
+    materializing (or gathering data for) any skipped batch."""
     if n < batch_size and drop_remainder:
         raise ValueError(f"partition of {n} rows < batch_size {batch_size}")
-    for epoch in range(num_epoch):
+    per_epoch = (
+        n // batch_size if drop_remainder else -(-n // batch_size)
+    )
+    start_epoch = start_batch // per_epoch if per_epoch else num_epoch
+    skip_in_epoch = start_batch - start_epoch * per_epoch
+    for epoch in range(min(start_epoch, num_epoch), num_epoch):
         order = (
             np.random.default_rng(seed + epoch).permutation(n)
             if seed is not None
             else np.arange(n)
         )
         stop = (n // batch_size) * batch_size if drop_remainder else n
-        for lo in range(0, stop, batch_size):
+        first = skip_in_epoch * batch_size if epoch == start_epoch else 0
+        for lo in range(first, stop, batch_size):
             hi = min(lo + batch_size, n)
             yield order[lo:hi].astype(np.int32)
 
@@ -73,18 +84,20 @@ def minibatches(
     num_epoch: int = 1,
     seed: int | None = None,
     drop_remainder: bool = True,
+    start_batch: int = 0,
 ) -> Iterator[Batch]:
     """Yield ``{"features": x, "label": y}`` numpy minibatches.
 
     ``features_col`` / ``label_col`` follow the reference worker kwargs
     (``distkeras/workers.py`` § ``Worker``). With ``seed`` set, rows are
     re-shuffled each epoch; ``drop_remainder`` keeps shapes static for XLA.
+    ``start_batch`` resumes mid-stream at O(1) cost (no skipped gathers).
     """
     x = np.asarray(dataset[features_col])
     y = np.asarray(dataset[label_col])
     n = x.shape[0]
     for idx in _epoch_batch_indices(n, batch_size, num_epoch, seed,
-                                    drop_remainder):
+                                    drop_remainder, start_batch):
         yield {"features": x[idx], "label": y[idx]}
 
 

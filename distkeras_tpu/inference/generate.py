@@ -59,7 +59,10 @@ def _check_context(model, dec_cfg, prompt, max_new_tokens: int):
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     S0 = prompt.shape[1]
-    trained_len = getattr(model, "input_shape", (dec_cfg.max_seq_len,))[0]
+    # `or` (not a getattr default): Model allows input_shape=None (e.g.
+    # from_keras with no input shape) — falsy values fall back too.
+    trained_shape = getattr(model, "input_shape", None) or (dec_cfg.max_seq_len,)
+    trained_len = trained_shape[0]
     limit = min(dec_cfg.max_seq_len, trained_len)
     if S0 + max_new_tokens > limit:
         raise ValueError(
@@ -69,6 +72,26 @@ def _check_context(model, dec_cfg, prompt, max_new_tokens: int):
             f"have untrained positional embeddings — build the model with a "
             f"larger seq_len to decode further"
         )
+
+
+def _shard_prompt(mesh, prompt):
+    """Batch-parallel decoding: shard the prompt over the mesh's ``dp``
+    axis and let GSPMD propagate the sharding through the KV caches and
+    the whole decode loop — each dp slice decodes its rows with no
+    cross-slice communication. Shared by generate() and beam_search()
+    (the beam-flattened ``B*K`` batch inherits the sharding through the
+    ``jnp.repeat`` fan-out the same way)."""
+    if mesh is None:
+        return prompt
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+    if prompt.shape[0] % mesh.shape[axis]:
+        raise ValueError(
+            f"batch {prompt.shape[0]} not divisible by mesh "
+            f"{axis}={mesh.shape[axis]}"
+        )
+    return jax.device_put(prompt, NamedSharding(mesh, P(axis)))
 
 
 def _empty_cache(module, batch_size: int):
@@ -154,16 +177,7 @@ def generate(
     module, dec_cfg = _decode_module(model)
     prompt = jnp.asarray(prompt, jnp.int32)
     _check_context(model, dec_cfg, prompt, max_new_tokens)
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
-        if prompt.shape[0] % mesh.shape[axis]:
-            raise ValueError(
-                f"batch {prompt.shape[0]} not divisible by mesh "
-                f"{axis}={mesh.shape[axis]}"
-            )
-        prompt = jax.device_put(prompt, NamedSharding(mesh, P(axis)))
+    prompt = _shard_prompt(mesh, prompt)
     if top_k is not None and not 1 <= top_k <= dec_cfg.vocab_size:
         raise ValueError(
             f"top_k={top_k} outside [1, vocab_size={dec_cfg.vocab_size}]"
@@ -228,6 +242,7 @@ def beam_search(
     prompt,
     max_new_tokens: int,
     num_beams: int = 4,
+    mesh=None,
 ):
     """Fixed-length beam search: decode ``max_new_tokens`` keeping the
     ``num_beams`` highest-total-log-probability continuations per batch
@@ -239,12 +254,17 @@ def beam_search(
     log-probabilities. ``sequences[:, 0]`` is the best beam. No EOS
     handling (the model zoo has no reserved EOS semantics) — decode is
     fixed-length.
+
+    ``mesh``: dp batch-parallel decoding, same contract as
+    :func:`generate` (``B`` must divide the dp axis; per-item beams stay
+    with their dp slice, so beam reordering is slice-local).
     """
     module, dec_cfg = _decode_module(model)
     prompt = jnp.asarray(prompt, jnp.int32)
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
     _check_context(model, dec_cfg, prompt, max_new_tokens)
+    prompt = _shard_prompt(mesh, prompt)
     seqs, scores = _beam_jit(
         module, variables["params"], prompt, max_new_tokens, num_beams
     )

@@ -318,14 +318,17 @@ class PipelineTrainer(Trainer):
         if ck.state is not None:
             train_params, opt_state = ck.state["params"], ck.state["opt"]
 
-        batches = ck.skip_consumed(minibatches(
+        # start_batch fast-forwards the deterministic stream past the
+        # restored step arithmetically (no skipped-batch gathers).
+        batches = minibatches(
             dataset,
             self.batch_size,
             self.features_col,
             self.label_col,
             num_epoch=self.num_epoch,
             seed=self.seed if shuffle else None,
-        ))
+            start_batch=ck.start_step,
+        )
         feed = DeviceFeed(batches, sharding=batch_sh, buffer_size=2)
         base_key = jax.random.PRNGKey(self.seed)
         step_no = ck.start_step

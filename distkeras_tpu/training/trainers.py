@@ -110,13 +110,6 @@ class _StepCheckpointer:
             self.start_step = self.mgr.latest_step()
         self._last = time.monotonic()
 
-    def skip_consumed(self, batches):
-        """Fast-forward the deterministic batch stream past the restored
-        step."""
-        if self.start_step:
-            return itertools.islice(batches, self.start_step, None)
-        return batches
-
     def maybe_save(self, step, state):
         if (
             self.mgr is not None
@@ -127,7 +120,14 @@ class _StepCheckpointer:
 
     def finalize(self, step, state):
         if self.mgr is not None and step > self.start_step:
-            self.mgr.save(step, state=state)
+            if self.mgr.latest_step() == step:
+                # maybe_save already persisted this very step (wait=False
+                # async); a second save of the same step raises orbax's
+                # StepAlreadyExists and would crash the run at the finish
+                # line — just drain the in-flight write instead.
+                self.mgr.wait_until_finished()
+            else:
+                self.mgr.save(step, state=state)
 
     def close(self):
         if self.mgr is not None:
@@ -408,6 +408,17 @@ class _VmappedReplicasTrainer(Trainer):
             )
             for i in range(n_padded)
         ]
+        # Lock-step vmapped stepping consumes min(len(iter)) groups: with
+        # uneven partitions the longer replicas' tail batches are never
+        # stepped. Keep the truncation (the alternative — recycling short
+        # streams — silently trains on repeated data) but make it LOUD:
+        # expected counts are arithmetic (rows // batch per epoch), so the
+        # per-replica drop count costs nothing to compute.
+        expected = [
+            self.num_epoch
+            * (parts[i % self.num_models].num_rows // self.batch_size)
+            for i in range(n_padded)
+        ]
         self.history = []
         while True:
             batch_group = []
@@ -425,6 +436,18 @@ class _VmappedReplicasTrainer(Trainer):
                 }
             stacked, m = vstep(stacked, batch)
             self.history.append(m)
+        steps = len(self.history)
+        self.dropped_batches = [e - steps for e in expected[: self.num_models]]
+        if any(self.dropped_batches):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "replica lock-step stopped at %d steps; tail batches dropped "
+                "per replica: %s (uneven partitions — replica i gets "
+                "rows//batch_size=%s batches/epoch)",
+                steps, self.dropped_batches,
+                [e // max(self.num_epoch, 1) for e in expected[: self.num_models]],
+            )
         # Drop padded replicas from metrics (they trained on recycled data).
         self.history = [
             {k: np.asarray(v)[: self.num_models] for k, v in h.items()}
@@ -585,14 +608,17 @@ class SynchronousDistributedTrainer(Trainer):
             state = ck.state
 
         self.history = []
-        batches = ck.skip_consumed(minibatches(
+        # start_batch fast-forwards the deterministic stream past the
+        # restored step arithmetically (no skipped-batch gathers).
+        batches = minibatches(
             dataset,
             global_batch,
             self.features_col,
             self.label_col,
             num_epoch=self.num_epoch,
             seed=self.seed if shuffle else None,
-        ))
+            start_batch=ck.start_step,
+        )
         feed = DeviceFeed(batches, put_fn=shard_fn, buffer_size=2)
         step_no = ck.start_step
         try:

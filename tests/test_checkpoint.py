@@ -169,3 +169,20 @@ def test_pipeline_trainer_checkpoint_resume(tmp_path, rng):
         np.asarray(trained_a.params["layer_0"]["attention"]["query"]["kernel"]),
         atol=1e-5, rtol=1e-5,
     )
+
+
+def test_finalize_after_interval_save_same_step(tmp_path):
+    """A zero checkpoint interval makes maybe_save persist the final step
+    right before finalize sees it; finalize must drain the async write, not
+    re-save (orbax raises StepAlreadyExists on a duplicate save)."""
+    from distkeras_tpu.training.trainers import _StepCheckpointer
+
+    _, _, state = _state()
+    ck = _StepCheckpointer(str(tmp_path / "ck"), 0.0, False, like=state)
+    for step in (1, 2, 3):
+        ck.maybe_save(step, state)
+    ck.finalize(3, state)  # same step maybe_save just saved
+    ck.close()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_step() == 3
+    mgr.close()

@@ -67,3 +67,20 @@ def test_device_feed_put_fn():
     feed = DeviceFeed(minibatches(_ds(), 16), put_fn=put)
     out = list(feed)
     assert len(out) == 4 and len(calls) == 4
+
+
+def test_minibatches_start_batch_matches_islice():
+    """Arithmetic resume fast-forward: start_batch=k yields exactly the
+    stream islice(full, k, None) — across epoch boundaries, with shuffle."""
+    import itertools
+
+    full = list(minibatches(_ds(40), 16, num_epoch=3, seed=5))
+    for k in (0, 1, 2, 3, 5, len(full)):
+        skipped = list(
+            minibatches(_ds(40), 16, num_epoch=3, seed=5, start_batch=k)
+        )
+        want = list(itertools.islice(iter(full), k, None))
+        assert len(skipped) == len(want)
+        for a, b in zip(skipped, want):
+            np.testing.assert_array_equal(a["features"], b["features"])
+            np.testing.assert_array_equal(a["label"], b["label"])

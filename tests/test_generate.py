@@ -137,3 +137,35 @@ def test_generate_dp_sharded_matches_unsharded(lm, rng):
 
     with pytest.raises(ValueError, match="not divisible"):
         dk.generate(model, variables, prompt[:3], 5, greedy=True, mesh=mesh)
+
+
+def test_generate_with_none_input_shape(lm, rng):
+    """Model.input_shape=None (e.g. from_keras without an input shape) must
+    fall back to the config's max_seq_len bound, not crash subscripting."""
+    import copy
+
+    model, variables = lm
+    m2 = copy.copy(model)
+    m2.input_shape = None
+    prompt = np.asarray(rng.integers(0, 64, size=(2, 4)), np.int32)
+    got = dk.generate(m2, variables, prompt, 5, greedy=True)
+    want = dk.generate(model, variables, prompt, 5, greedy=True)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_search_dp_sharded_matches_unsharded(lm, rng):
+    """beam_search(mesh=...) mirrors generate's dp batch-parallel contract."""
+    from distkeras_tpu.parallel.mesh import make_mesh
+
+    model, variables = lm
+    prompt = np.asarray(rng.integers(0, 64, size=(8, 4)), np.int32)
+    seqs, scores = dk.beam_search(model, variables, prompt, 4, num_beams=3)
+    mesh = make_mesh({"dp": 8})
+    s_seqs, s_scores = dk.beam_search(
+        model, variables, prompt, 4, num_beams=3, mesh=mesh
+    )
+    np.testing.assert_array_equal(seqs, s_seqs)
+    np.testing.assert_allclose(scores, s_scores, atol=1e-5)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        dk.beam_search(model, variables, prompt[:3], 4, num_beams=3, mesh=mesh)

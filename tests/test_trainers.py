@@ -349,3 +349,30 @@ def test_averaging_ignores_padded_replicas(toy_classification):
     assert np.asarray(stacked.params["Dense_0"]["kernel"]).shape[0] == n_padded
     assert manual.shape == np.asarray(trained.params["Dense_0"]["kernel"]).shape
     assert np.isfinite(np.asarray(trained.params["Dense_0"]["kernel"])).all()
+
+
+def test_ensemble_uneven_partitions_reports_drop_count(rng):
+    """Uneven partitions: lock-step vmapped stepping stops at the shortest
+    replica stream; the tail drop must be explicit (dropped_batches), never
+    silent. 70 rows // 3 -> partitions of 23/23/24 (linspace bounds); batch
+    8 -> 2/2/3 batches, so replica 2 drops exactly 1."""
+    x = np.asarray(rng.normal(size=(70, 16)), np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = dk.Dataset.from_arrays(features=x, label=y)
+    trainer = dk.EnsembleTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01, num_models=3,
+        batch_size=8, num_epoch=1,
+    )
+    models = trainer.train(ds)
+    assert len(models) == 3
+    assert len(trainer.history) == 2  # min over replicas
+    assert trainer.dropped_batches == [0, 0, 1]
+
+
+def test_ensemble_even_partitions_drop_free(toy_classification):
+    trainer = dk.EnsembleTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01, num_models=4,
+        batch_size=16, num_epoch=2,
+    )
+    trainer.train(toy_classification)  # 512 rows -> 4x128 -> 8 batches each
+    assert trainer.dropped_batches == [0, 0, 0, 0]
