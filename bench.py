@@ -120,7 +120,12 @@ def _fallback_line(reason: str, tpu_unavailable: bool) -> str:
         "value": 0.0,
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
-        "detail": {"error": reason, "tpu_unavailable": tpu_unavailable},
+        # ``infrastructure_failure`` distinguishes "the harness was killed /
+        # nothing could run" from a genuine zero-throughput measurement, so
+        # consumers need not parse the free-text ``error`` to tell them
+        # apart (a value=0 line with this flag is NOT a perf result).
+        "detail": {"error": reason, "tpu_unavailable": tpu_unavailable,
+                   "infrastructure_failure": True},
     })
 
 

@@ -40,6 +40,8 @@ def _epoch_batch_indices(
     ``start_batch`` fast-forwards the stream arithmetically — resume after
     N consumed steps starts at the exact (epoch, offset) position without
     materializing (or gathering data for) any skipped batch."""
+    if start_batch < 0:
+        raise ValueError(f"start_batch must be >= 0, got {start_batch}")
     if n < batch_size and drop_remainder:
         raise ValueError(f"partition of {n} rows < batch_size {batch_size}")
     per_epoch = (

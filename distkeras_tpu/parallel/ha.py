@@ -135,9 +135,11 @@ class CompressingClient:
         self._client.commit({**payload, "delta": self._bf16(payload["delta"])})
 
     def commit_pull(self, payload: dict):
-        # Only deltas are compressed; a fused elastic exchange ships "local"
-        # params, whose absolute values don't tolerate bf16 truncation the
-        # way near-zero deltas do.
+        # Only deltas are compressed. A fused elastic exchange compresses
+        # itself at the protocol layer (AEASGD ships bf16 mirror-diffs in
+        # steady state; its bootstrap "local" frame must stay full precision
+        # — absolute weights don't tolerate bf16 truncation the way
+        # near-zero deltas do).
         if "delta" in payload:
             payload = {**payload, "delta": self._bf16(payload["delta"])}
         return self._client.commit_pull(payload)

@@ -35,7 +35,9 @@ DynSGD     staleness-aware: pull returns ``(center, num_updates)``; commit
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import uuid
 from typing import Any
 
 import optax
@@ -53,6 +55,10 @@ __all__ = [
 
 PyTree = Any
 
+# High bit of the fused-exchange reply counter: "the PS lost your mirror —
+# re-bootstrap with full params" (fits the wire's u64 counter field).
+_REBOOTSTRAP = 1 << 63
+
 
 @dataclasses.dataclass
 class WorkerCarry:
@@ -60,6 +66,8 @@ class WorkerCarry:
 
     window_start: PyTree | None = None  # params snapshot at window start
     last_update: int = 0  # DynSGD: server counter seen at last pull
+    worker_id: str = ""  # elastic family: keys the server-side mirror
+    mirror: PyTree | None = None  # elastic family: shared worker/PS mirror
 
 
 class AsyncProtocol:
@@ -136,6 +144,47 @@ def _device_delta(params, base):
 _delta_jit = None
 
 
+def _wire_bf16(tree):
+    """Cast wide float leaves to bfloat16 for the wire (half of f32 bytes);
+    everything else ships unchanged. Exact for trees already in bf16.
+    Host-side ml_dtypes cast (round-to-nearest-even, same as XLA) — the PS
+    loop must never bounce trees through a device (ps.py design note)."""
+    import jax
+    import ml_dtypes
+    import numpy as np
+
+    def cast(x):
+        a = np.asarray(x)
+        if a.dtype.kind == "f" and a.dtype.itemsize > 2:
+            return a.astype(ml_dtypes.bfloat16)
+        return a
+
+    return jax.tree.map(cast, tree)
+
+
+def _wire_f32(tree):
+    """Upcast bf16 wire leaves back to float32 (exact — bf16 is a prefix of
+    f32); other leaves pass through."""
+    import jax
+    import numpy as np
+
+    def up(x):
+        a = np.asarray(x)
+        if a.dtype.name == "bfloat16":
+            return a.astype(np.float32)
+        return a
+
+    return jax.tree.map(up, tree)
+
+
+def _host_tree(tree):
+    """Materialize params on host (the elastic mirror math runs in host
+    numpy on both sides so it stays bit-identical)."""
+    from distkeras_tpu.utils.pytree import pytree_to_host
+
+    return pytree_to_host(tree)
+
+
 class _DeltaWindowMixin:
     """Commit accumulated window delta and receive the fresh center in one
     fused exchange — the DOWNPOUR/ADAG/DynSGD worker cadence (SURVEY §3.1 hot
@@ -180,7 +229,27 @@ class ADAGProtocol(_DeltaWindowMixin, AsyncProtocol):
 
 class AEASGDProtocol(AsyncProtocol):
     """Asynchronous Elastic Averaging SGD (Zhang et al.; reference ``AEASGD``
-    trainer). ``rho`` and ``learning_rate`` follow the reference kwargs."""
+    trainer). ``rho`` and ``learning_rate`` follow the reference kwargs.
+
+    Wire format of the fused exchange (one RTT per window, like the
+    reference's pull→compute→commit pair): the first window of each worker
+    bootstraps by shipping its full-precision ``local`` params; every later
+    window ships only ``bf16(local - mirror)``, where ``mirror`` is a
+    per-worker tree maintained **bit-identically** on both sides (both
+    advance it as ``mirror + f32(diff) - f32(e)`` from the very bytes that
+    crossed the wire). The PS reconstructs ``local ≈ mirror + diff``,
+    computes the elastic force against the center *it* owns, applies
+    ``center += e``, and replies ``bf16(e)``. Steady-state wire cost is
+    2 bytes/param each way vs 4+4 for raw f32 — a 2× reduction — and the
+    bf16 rounding only ever touches *differences* of nearby trees (the
+    window's local progress, and the force ``α·(local - center)``), never
+    absolute weights, so the truncation is benign the same way bf16 commit
+    deltas are (see :class:`distkeras_tpu.parallel.ha.CompressingClient`).
+    PS-side cost: up to ``max(2*num_workers, 4)`` tracked incarnations, each
+    holding one f32 mirror tree plus its last reply (f32 model-sized after a
+    bootstrap exchange, bf16 force-sized in steady state) — budget roughly
+    ``2 * num_workers * (4 + 4) bytes/param`` worst-case.
+    """
 
     name = "aeasgd"
 
@@ -193,6 +262,16 @@ class AEASGDProtocol(AsyncProtocol):
         super().__init__(communication_window)
         self.rho = float(rho)
         self.learning_rate = float(learning_rate)
+        # Server-side per-worker state, touched only by the single-owner PS
+        # loop: the shared mirror tree and the last fused reply (replayed
+        # verbatim for a deduped retry — exactly-once answers). LRU-bounded
+        # (see _set_mirror): worker ids are per-incarnation, so restarts
+        # would otherwise leak a model-sized mirror each; evicting a live
+        # worker's mirror is safe — it just re-bootstraps next window.
+        self._mirrors: "collections.OrderedDict[str, PyTree]" = (
+            collections.OrderedDict()
+        )
+        self._last_reply: dict[str, tuple] = {}
 
     def server_commit(self, center, num_updates, payload, num_workers):
         return pytree_add(center, payload["delta"]), num_updates + 1
@@ -202,33 +281,111 @@ class AEASGDProtocol(AsyncProtocol):
         return pytree_scale(pytree_sub(local, center), alpha)
 
     def server_commit_pull(self, center, num_updates, payload, num_workers):
-        # Fused elastic exchange: the worker ships its *local* params; the
-        # PS computes the elastic force against the center it owns, applies
-        # ``center += e``, and replies with ``e`` so the worker applies
-        # ``local -= e``. Exactly the reference's pull→compute→commit
-        # semantics (``distkeras/workers.py`` § ``AEASGDWorker``) collapsed
-        # into one round trip, with both sides using the identical force.
+        # Fused elastic exchange (see class docstring). Two request shapes:
+        # bootstrap ``local`` (full precision) and steady-state
+        # ``elastic_diff`` (bf16 delta against the shared mirror).
+        wid = payload.get("worker_id")
+        if "elastic_diff" in payload:
+            if wid not in self._mirrors:
+                # Mirror lost (PS restarted from checkpoint, or LRU-evicted):
+                # the diff alone cannot reconstruct the worker's local
+                # params. Apply nothing; the flagged counter tells the
+                # worker to re-bootstrap with full params next window.
+                # Nothing is recorded: a deduped retry reconstructs the same
+                # flagged zero reply from its own payload in
+                # server_duplicate_reply (storing it here would leak a
+                # model-sized tree per dead incarnation — the wid is not in
+                # _mirrors, so _set_mirror's eviction can never reach it).
+                zero = pytree_scale(payload["elastic_diff"], 0.0)  # stays bf16: unread
+                return center, num_updates, (zero, _REBOOTSTRAP | num_updates)
+            local_est = pytree_add(
+                self._mirrors[wid], _wire_f32(payload["elastic_diff"])
+            )
+            e_wire = _wire_bf16(self._elastic(local_est, center))
+            e = _wire_f32(e_wire)
+            self._set_mirror(wid, pytree_sub(local_est, e), num_workers)
+            reply = (e_wire, num_updates)
+            self._last_reply[wid] = reply
+            return pytree_add(center, e), num_updates + 1, reply
         if "local" in payload:
-            e = self._elastic(payload["local"], center)
-            return pytree_add(center, e), num_updates + 1, (e, num_updates)
+            local = _host_tree(payload["local"])
+            e = self._elastic(local, center)
+            reply = (e, num_updates)
+            if wid is not None:
+                self._set_mirror(wid, pytree_sub(local, e), num_workers)
+                self._last_reply[wid] = reply
+            return pytree_add(center, e), num_updates + 1, reply
         new_center, new_n = self.server_commit(center, num_updates, payload, num_workers)
         return new_center, new_n, (new_center, new_n)
 
+    def _set_mirror(self, wid, mirror, num_workers):
+        """Store a worker's mirror, LRU-evicting stale incarnations beyond
+        2×num_workers (each mirror is a full f32 model copy; worker ids are
+        per-incarnation uuids, so churn would otherwise grow this without
+        bound). An evicted live worker just re-bootstraps next window."""
+        self._mirrors[wid] = mirror
+        self._mirrors.move_to_end(wid)
+        bound = max(2 * int(num_workers), 4)
+        while len(self._mirrors) > bound:
+            old, _ = self._mirrors.popitem(last=False)
+            self._last_reply.pop(old, None)
+
     def server_duplicate_reply(self, center, num_updates, payload):
         # The original reply was lost in transit after the commit applied;
-        # recompute the force against the (post-apply) center without
-        # re-applying it.
+        # replay the recorded answer (the mirror has already advanced, so
+        # recomputing the force would double-count the diff).
+        wid = payload.get("worker_id")
+        if wid in self._last_reply and ("local" in payload or "elastic_diff" in payload):
+            return self._last_reply[wid]
         if "local" in payload:
-            return self._elastic(payload["local"], center), num_updates
+            return self._elastic(_host_tree(payload["local"]), center), num_updates
+        if "elastic_diff" in payload:
+            # No recorded reply (evicted, or PS restarted between the
+            # original and the retry): never hand back the raw center — the
+            # worker would subtract it as if it were the force. Flag a
+            # re-bootstrap instead.
+            zero = pytree_scale(payload["elastic_diff"], 0.0)  # stays bf16: unread
+            return zero, _REBOOTSTRAP | num_updates
         return center, num_updates
 
     def worker_window(self, params, carry, client):
         fused = getattr(client, "commit_pull", None)
         if fused is not None:
-            e, num_updates = fused({"local": params, "last_update": carry.last_update})
+            wid = carry.worker_id or uuid.uuid4().hex
+            local = _host_tree(params)
+            if carry.mirror is None:
+                # Bootstrap window: full-precision local; both sides then
+                # hold the identical mirror ``local - e``.
+                e, num_updates = fused(
+                    {"local": local, "worker_id": wid,
+                     "last_update": carry.last_update}
+                )
+                e = _wire_f32(e)
+                mirror = pytree_sub(local, e)
+            else:
+                diff_wire = _wire_bf16(pytree_sub(local, carry.mirror))
+                e_wire, num_updates = fused(
+                    {"elastic_diff": diff_wire, "worker_id": wid,
+                     "last_update": carry.last_update}
+                )
+                if num_updates & _REBOOTSTRAP:
+                    # PS lost the mirror; nothing was applied. Skip this
+                    # window's exchange and re-bootstrap on the next one.
+                    return params, WorkerCarry(
+                        window_start=params,
+                        last_update=num_updates & ~_REBOOTSTRAP,
+                        worker_id=wid, mirror=None,
+                    )
+                e = _wire_f32(e_wire)
+                # Advance the shared mirror from the wire bytes — the same
+                # arithmetic, in the same order, as the PS.
+                mirror = pytree_sub(
+                    pytree_add(carry.mirror, _wire_f32(diff_wire)), e
+                )
             new_params = pytree_sub(params, e)
             return new_params, WorkerCarry(
-                window_start=new_params, last_update=num_updates
+                window_start=new_params, last_update=num_updates,
+                worker_id=wid, mirror=mirror,
             )
         center, num_updates = client.pull()
         elastic = self._elastic(params, center)

@@ -41,11 +41,7 @@ _COMMIT_PULL = "commit_pull"
 _STOP = "stop"
 
 
-def _to_host(tree: PyTree) -> PyTree:
-    """Materialize a PyTree as host numpy arrays, preserving leaf dtypes
-    (param dtype must round-trip unchanged or worker step functions would
-    retrace every window)."""
-    return jax.tree.map(np.asarray, tree)
+from distkeras_tpu.utils.pytree import pytree_to_host as _to_host
 
 
 class ParameterServerService:
@@ -226,6 +222,6 @@ class InProcessClient:
 
 def _host_payload(payload: dict) -> dict:
     return {
-        k: (_to_host(v) if k in ("delta", "local") else v)
+        k: (_to_host(v) if k in ("delta", "local", "elastic_diff") else v)
         for k, v in payload.items()
     }

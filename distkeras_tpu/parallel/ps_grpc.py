@@ -62,8 +62,11 @@ def determine_host_address() -> str:
 # commit_pull request: same frame as commit -> reply: same frame as pull reply
 #
 # The commit tree may be wrapped in a dict carrying out-of-band markers as
-# extra npz leaves: "__commit_id__" (dedupe stamp) and "__local__" (the tree
-# is the worker's local params for a fused elastic exchange, not a delta).
+# extra npz leaves: "__commit_id__" (dedupe stamp), "__local__" (the tree is
+# the worker's local params for a fused elastic exchange, not a delta),
+# "__elastic_diff__" (the tree is a bf16 delta against the worker's shared
+# mirror — AEASGD steady-state), and "__worker_id__" (keys the PS-side
+# mirror for the elastic family).
 
 
 def _encode_pull_reply(center: Any, num_updates: int) -> bytes:
@@ -80,13 +83,21 @@ def _encode_commit(payload: dict) -> bytes:
     (keys: delta|local, optional commit_id, last_update)."""
     import jax
 
-    key = "local" if "local" in payload else "delta"
+    key = (
+        "local" if "local" in payload
+        else "elastic_diff" if "elastic_diff" in payload
+        else "delta"
+    )
     tree = jax.tree.map(np.asarray, payload[key])
     markers = {}
     if "commit_id" in payload:
         markers["__commit_id__"] = _id_to_array(payload["commit_id"])
+    if "worker_id" in payload:
+        markers["__worker_id__"] = _id_to_array(payload["worker_id"])
     if key == "local":
         markers["__local__"] = np.ones((1,), np.uint8)
+    elif key == "elastic_diff":
+        markers["__elastic_diff__"] = np.ones((1,), np.uint8)
     if markers:
         tree = {"d": tree, **markers}
     return struct.pack("<Q", int(payload.get("last_update", 0))) + serialize_pytree(
@@ -99,11 +110,16 @@ def _decode_commit(data: bytes) -> dict:
     tree = deserialize_pytree(data[8:])
     out = {"last_update": int(last_update)}
     key = "delta"
-    if isinstance(tree, dict) and ("__commit_id__" in tree or "__local__" in tree):
+    _markers = ("__commit_id__", "__local__", "__elastic_diff__", "__worker_id__")
+    if isinstance(tree, dict) and any(m in tree for m in _markers):
         if "__commit_id__" in tree:
             out["commit_id"] = _array_to_id(tree["__commit_id__"])
+        if "__worker_id__" in tree:
+            out["worker_id"] = _array_to_id(tree["__worker_id__"])
         if "__local__" in tree:
             key = "local"
+        elif "__elastic_diff__" in tree:
+            key = "elastic_diff"
         tree = tree["d"]
     out[key] = tree
     return out

@@ -26,7 +26,6 @@ the engine underneath:
 
 from __future__ import annotations
 
-import itertools
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor

@@ -31,6 +31,14 @@ def _np_leaf(x) -> bool:
     return isinstance(x, np.ndarray) or np.isscalar(x)
 
 
+def pytree_to_host(tree: Any) -> Any:
+    """Materialize a PyTree as host numpy arrays, preserving leaf dtypes
+    (param dtype must round-trip unchanged or jitted consumers retrace).
+    The one shared host-materialization helper: the PS loop and the
+    protocol layer must agree on it bit-for-bit."""
+    return jax.tree.map(np.asarray, tree)
+
+
 def pytree_add(a: Any, b: Any) -> Any:
     """``a + b`` leaf-wise."""
     return jax.tree.map(

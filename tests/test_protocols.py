@@ -114,3 +114,172 @@ def test_dynsgd_damps_stale_worker_end_to_end():
         assert np.isclose(after - before, 1.0)  # 6 / (5+1)
     finally:
         ps.stop()
+
+
+class _FusedFakePS:
+    """In-process stand-in for the PS loop: routes commit_pull through the
+    protocol's real server hooks against a center it owns."""
+
+    def __init__(self, protocol, center, num_workers=2):
+        self.protocol = protocol
+        self.center = center
+        self.num_updates = 0
+        self.num_workers = num_workers
+
+    def pull(self):
+        return self.center, self.num_updates
+
+    def commit_pull(self, payload):
+        self.center, self.num_updates, reply = self.protocol.server_commit_pull(
+            self.center, self.num_updates, payload, self.num_workers
+        )
+        return reply
+
+
+def _perturb(tree, seed, scale=1e-3):
+    """Simulate a window of local training: small parameter drift."""
+    rng = np.random.default_rng(seed)
+    return {k: v + scale * rng.normal(size=v.shape).astype(v.dtype)
+            for k, v in tree.items()}
+
+
+def test_aeasgd_fused_mirror_stays_bit_identical():
+    """Steady-state elastic exchange: worker and PS advance the shared
+    mirror from the same wire bytes, so the two copies never diverge."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    ps = _FusedFakePS(p, {"w": np.zeros(64, np.float32)})
+    params, carry = p.worker_begin(ps, None)
+    for seed in range(4):
+        params = _perturb(params, seed)
+        params, carry = p.worker_window(params, carry, ps)
+        assert carry.worker_id in p._mirrors
+        server_mirror = p._mirrors[carry.worker_id]
+        for k in server_mirror:
+            assert np.array_equal(
+                np.asarray(server_mirror[k]), np.asarray(carry.mirror[k])
+            ), "worker/PS mirror copies diverged"
+
+
+def test_aeasgd_fused_mirror_force_matches_exact():
+    """The bf16 mirror encoding perturbs the elastic force only at bf16
+    rounding scale: compare against an exact full-precision replica."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    center0 = {"w": np.linspace(-1, 1, 128).astype(np.float32)}
+    ps = _FusedFakePS(p, {k: v.copy() for k, v in center0.items()})
+    params, carry = p.worker_begin(ps, None)
+
+    exact_center = {k: v.copy() for k, v in center0.items()}
+    exact_params = {k: np.asarray(v).copy() for k, v in params.items()}
+    alpha = p.rho * p.learning_rate
+    for seed in range(3):
+        params = _perturb(params, seed)
+        exact_params = _perturb(exact_params, seed)
+        params, carry = p.worker_window(params, carry, ps)
+        e = {k: alpha * (exact_params[k] - exact_center[k]) for k in exact_params}
+        exact_params = {k: exact_params[k] - e[k] for k in exact_params}
+        exact_center = {k: exact_center[k] + e[k] for k in exact_center}
+    got = np.asarray(params["w"])
+    want = exact_params["w"]
+    # bf16 has 8 mantissa bits (~2^-9 relative); a handful of windows keeps
+    # the accumulated wire-rounding well under 1e-2 absolute on O(1) weights.
+    assert np.max(np.abs(got - want)) < 1e-2
+    assert np.max(np.abs(np.asarray(ps.center["w"]) - exact_center["w"])) < 1e-2
+
+
+def test_aeasgd_rebootstrap_after_mirror_loss():
+    """A PS that lost its per-worker mirror (restart) answers with the
+    re-bootstrap flag: the worker skips the window, then re-sends full
+    params and the exchange resumes."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    ps = _FusedFakePS(p, {"w": np.zeros(8, np.float32)})
+    params, carry = p.worker_begin(ps, None)
+    params, carry = p.worker_window(_perturb(params, 0), carry, ps)
+    assert carry.mirror is not None
+
+    p._mirrors.clear()  # simulate PS restart from checkpoint
+    before = {k: np.asarray(v).copy() for k, v in params.items()}
+    n_before = ps.num_updates
+    params, carry = p.worker_window(params, carry, ps)
+    assert carry.mirror is None  # told to re-bootstrap
+    assert np.array_equal(np.asarray(params["w"]), before["w"])  # no-op window
+    assert ps.num_updates == n_before  # nothing applied server-side
+
+    params, carry = p.worker_window(_perturb(params, 1), carry, ps)
+    assert carry.mirror is not None and carry.worker_id in p._mirrors
+
+
+def test_aeasgd_duplicate_reply_is_replayed_verbatim():
+    """A deduped fused retry gets the recorded reply, not a recomputed force
+    (the mirror already advanced when the original commit applied)."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    center = {"w": np.zeros(16, np.float32)}
+    local = {"w": np.full(16, 2.0, np.float32)}
+    payload = {"local": local, "worker_id": "w0", "last_update": 0}
+    center, n, reply = p.server_commit_pull(center, 0, payload, 2)
+    replay, counter = p.server_duplicate_reply(center, n, payload)
+    assert counter == reply[1]
+    assert np.array_equal(np.asarray(replay["w"]), np.asarray(reply[0]["w"]))
+
+
+def test_aeasgd_rebootstrap_duplicate_replays_flag():
+    """A deduped retry of a rebootstrap-flagged exchange must replay the
+    flagged counter — never the raw center (which the worker would subtract
+    as if it were the elastic force)."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    center = {"w": np.full(8, 7.0, np.float32)}
+    diff = {"w": np.zeros(8, np.float32)}
+    payload = {"elastic_diff": diff, "worker_id": "w-lost", "last_update": 0}
+    # Original exchange against a PS with no mirror for this worker.
+    center2, n2, (tree, counter) = p.server_commit_pull(center, 5, payload, 2)
+    assert counter & (1 << 63)
+    # Retry after the reply was lost: same flagged answer, zero tree.
+    replay, dup_counter = p.server_duplicate_reply(center2, n2, payload)
+    assert dup_counter & (1 << 63)
+    assert np.allclose(np.asarray(replay["w"]), 0.0)
+    # Even with _last_reply wiped (PS restart between original and retry),
+    # the fallback still flags rather than returning the center.
+    p._last_reply.clear()
+    replay2, dup2 = p.server_duplicate_reply(center2, n2, payload)
+    assert dup2 & (1 << 63)
+    assert np.allclose(np.asarray(replay2["w"]), 0.0)
+
+
+def test_aeasgd_mirror_state_is_bounded_under_worker_churn():
+    """Worker ids are per-incarnation; restarts must not leak model-sized
+    mirrors on the PS (LRU eviction beyond 2×num_workers)."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    center = {"w": np.zeros(16, np.float32)}
+    num_workers = 3
+    for i in range(20):  # 20 worker incarnations
+        local = {"w": np.full(16, float(i), np.float32)}
+        center, _, _ = p.server_commit_pull(
+            center, i, {"local": local, "worker_id": f"w{i}", "last_update": 0},
+            num_workers,
+        )
+    assert len(p._mirrors) <= 2 * num_workers
+    assert len(p._last_reply) <= 2 * num_workers
+    # An evicted worker's next diff gets the re-bootstrap flag, not garbage.
+    _, _, (_, counter) = p.server_commit_pull(
+        center, 20,
+        {"elastic_diff": {"w": np.zeros(16, np.float32)},
+         "worker_id": "w0", "last_update": 0},
+        num_workers,
+    )
+    assert counter & (1 << 63)
+
+
+def test_aeasgd_lost_mirror_churn_does_not_grow_reply_state():
+    """Incarnations that never bootstrap (elastic_diff against a lost
+    mirror, then die) must leave no model-sized state behind."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    center = {"w": np.zeros(16, np.float32)}
+    for i in range(50):
+        _, _, (_, counter) = p.server_commit_pull(
+            center, i,
+            {"elastic_diff": {"w": np.zeros(16, np.float32)},
+             "worker_id": f"ghost{i}", "last_update": 0},
+            2,
+        )
+        assert counter & (1 << 63)
+    assert len(p._last_reply) == 0
+    assert len(p._mirrors) == 0
