@@ -156,6 +156,10 @@ class SelfAttention(nn.Module):
 
 class EncoderLayer(nn.Module):
     cfg: BertConfig
+    # Manual expert parallelism for shard_map contexts (the pipelined
+    # trunk): forwarded to MoEMLP. None keeps the GSPMD path.
+    ep_axis: str | None = None
+    ep_size: int = 1
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -174,6 +178,8 @@ class EncoderLayer(nn.Module):
                 dtype=cfg.dtype,
                 residual=False,
                 router_top_k=cfg.moe_top_k,
+                ep_axis=self.ep_axis,
+                ep_size=self.ep_size,
                 name="moe_mlp",
             )(y, train=train)
         else:

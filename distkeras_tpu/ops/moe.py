@@ -47,6 +47,15 @@ class MoEMLP(nn.Module):
     # 1 = Switch-style single expert per token; 2 = GShard top-2 with
     # renormalized gates and second choices dropped first under congestion.
     router_top_k: int = 1
+    # Manual expert parallelism for use under shard_map (where GSPMD's
+    # sharding-constraint-driven all-to-all is unavailable — the pipelined
+    # trunk): each mesh member along ``ep_axis`` holds E/ep_size experts
+    # (w_in/w_out leading dim is LOCAL), computes its experts' outputs for
+    # the full (replicated-over-ep) token set, and a psum over ``ep_axis``
+    # combines. Routing/dispatch stays global; the router is replicated.
+    # Leave ep_axis=None for the GSPMD path (full E, logical-axis rules).
+    ep_axis: str | None = None
+    ep_size: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -119,12 +128,19 @@ class MoEMLP(nn.Module):
             dispatch = d1 + d2
             combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
 
+        if self.ep_axis is not None and E % self.ep_size:
+            raise ValueError(
+                f"num_experts {E} not divisible by ep_size {self.ep_size}"
+            )
+        # Leading dim of the expert weights: local shard in manual-ep mode
+        # (params arrive pre-sliced by shard_map), full E otherwise.
+        E_w = E // self.ep_size if self.ep_axis is not None else E
         w_in = self.param(
             "w_in",
             nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
             ),
-            (E, D, self.mlp_dim),
+            (E_w, D, self.mlp_dim),
             jnp.float32,
         )
         w_out = self.param(
@@ -132,22 +148,34 @@ class MoEMLP(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
             ),
-            (E, self.mlp_dim, D),
+            (E_w, self.mlp_dim, D),
             jnp.float32,
         )
 
-        # dispatch: token layout -> expert layout (all-to-all under ep)
+        if self.ep_axis is not None:
+            # Manual EP: this member computes only its E/ep_size experts
+            # (slice the global dispatch/combine down to the local range),
+            # then a psum over ep combines the disjoint contributions —
+            # tokens are replicated over ep, so no all-to-all is needed.
+            ep_idx = jax.lax.axis_index(self.ep_axis)
+            lo = ep_idx * E_w
+            dispatch = jax.lax.dynamic_slice_in_dim(dispatch, lo, E_w, axis=1)
+            combine = jax.lax.dynamic_slice_in_dim(combine, lo, E_w, axis=1)
+
+        # dispatch: token layout -> expert layout (all-to-all under GSPMD ep)
         expert_in = jnp.einsum(
             "tec,td->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
-        )  # [E, C, D]
+        )  # [E_w, C, D]
         h = jnp.einsum("ecd,edm->ecm", expert_in, w_in.astype(self.dtype))
         h = nn.gelu(h)
         expert_out = jnp.einsum("ecm,emd->ecd", h, w_out.astype(self.dtype))
         # combine: expert layout -> token layout
         y = jnp.einsum(
             "tec,ecd->td", combine.astype(self.dtype), expert_out
-        ).astype(x.dtype)
-        y = y.reshape(B, S, D)
+        )
+        if self.ep_axis is not None:
+            y = jax.lax.psum(y, self.ep_axis)
+        y = y.astype(x.dtype).reshape(B, S, D)
         return x + y if self.residual else y
 
     @staticmethod

@@ -196,6 +196,7 @@ def pipeline_apply(
     virtual_stages: int = 1,
     rng=None,
     with_aux: bool = False,
+    param_specs=None,
 ):
     """Run an ``L``-stage pipeline over ``mesh[axis_name]``.
 
@@ -232,7 +233,12 @@ def pipeline_apply(
         for ax in ((entry,) if isinstance(entry, str) else tuple(entry))
         if ax != axis_name
     )
-    spec_params = P(axis_name)
+    if param_specs is None:
+        # Uniform default: stage axis over pp, everything else replicated.
+        # Callers sharding further axes (e.g. expert dims over ep for a
+        # pipelined MoE — the stage_fn then owns the matching collectives)
+        # pass a per-leaf spec tree.
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     fn = shard_map(
         partial(
             _pipeline_local, stage_fn, axis_name=axis_name,
@@ -240,11 +246,7 @@ def pipeline_apply(
             with_aux=with_aux,
         ),
         mesh=mesh,
-        in_specs=(
-            jax.tree.map(lambda _: spec_params, stacked_params),
-            io_spec,
-            P(),
-        ),
+        in_specs=(param_specs, io_spec, P()),
         out_specs=(io_spec, P()) if with_aux else io_spec,
     )
     if microbatches.shape[0] < 1:

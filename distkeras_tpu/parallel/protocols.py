@@ -40,9 +40,17 @@ import dataclasses
 import uuid
 from typing import Any
 
+import jax
+import ml_dtypes
+import numpy as np
 import optax
 
-from distkeras_tpu.utils.pytree import pytree_add, pytree_scale, pytree_sub
+from distkeras_tpu.utils.pytree import (
+    pytree_add,
+    pytree_scale,
+    pytree_sub,
+    pytree_to_host,
+)
 
 __all__ = [
     "AsyncProtocol",
@@ -128,8 +136,6 @@ def _device_delta(params, base):
     """Whole-tree ``params - base`` as one compiled dispatch when params
     live on device (the per-window worker delta); host numpy trees keep the
     numpy path (the PS loop must not bounce through the accelerator)."""
-    import jax
-
     leaves = jax.tree.leaves(params)
     if leaves and isinstance(leaves[0], jax.Array):
         global _delta_jit
@@ -149,9 +155,6 @@ def _wire_bf16(tree):
     everything else ships unchanged. Exact for trees already in bf16.
     Host-side ml_dtypes cast (round-to-nearest-even, same as XLA) — the PS
     loop must never bounce trees through a device (ps.py design note)."""
-    import jax
-    import ml_dtypes
-    import numpy as np
 
     def cast(x):
         a = np.asarray(x)
@@ -165,8 +168,6 @@ def _wire_bf16(tree):
 def _wire_f32(tree):
     """Upcast bf16 wire leaves back to float32 (exact — bf16 is a prefix of
     f32); other leaves pass through."""
-    import jax
-    import numpy as np
 
     def up(x):
         a = np.asarray(x)
@@ -175,14 +176,6 @@ def _wire_f32(tree):
         return a
 
     return jax.tree.map(up, tree)
-
-
-def _host_tree(tree):
-    """Materialize params on host (the elastic mirror math runs in host
-    numpy on both sides so it stays bit-identical)."""
-    from distkeras_tpu.utils.pytree import pytree_to_host
-
-    return pytree_to_host(tree)
 
 
 class _DeltaWindowMixin:
@@ -308,7 +301,7 @@ class AEASGDProtocol(AsyncProtocol):
             self._last_reply[wid] = reply
             return pytree_add(center, e), num_updates + 1, reply
         if "local" in payload:
-            local = _host_tree(payload["local"])
+            local = pytree_to_host(payload["local"])
             e = self._elastic(local, center)
             reply = (e, num_updates)
             if wid is not None:
@@ -338,7 +331,7 @@ class AEASGDProtocol(AsyncProtocol):
         if wid in self._last_reply and ("local" in payload or "elastic_diff" in payload):
             return self._last_reply[wid]
         if "local" in payload:
-            return self._elastic(_host_tree(payload["local"]), center), num_updates
+            return self._elastic(pytree_to_host(payload["local"]), center), num_updates
         if "elastic_diff" in payload:
             # No recorded reply (evicted, or PS restarted between the
             # original and the retry): never hand back the raw center — the
@@ -352,7 +345,7 @@ class AEASGDProtocol(AsyncProtocol):
         fused = getattr(client, "commit_pull", None)
         if fused is not None:
             wid = carry.worker_id or uuid.uuid4().hex
-            local = _host_tree(params)
+            local = pytree_to_host(params)
             if carry.mirror is None:
                 # Bootstrap window: full-precision local; both sides then
                 # hold the identical mirror ``local - e``.

@@ -128,6 +128,7 @@ class ParameterServerService:
                         self._center, self._num_updates, payload
                     )
                 else:
+                    before = self._num_updates
                     (
                         self._center,
                         self._num_updates,
@@ -135,7 +136,11 @@ class ParameterServerService:
                     ) = self.protocol.server_commit_pull(
                         self._center, self._num_updates, payload, self.num_workers
                     )
-                    self._num_commits += 1
+                    # An unchanged counter means the protocol applied
+                    # nothing (e.g. the elastic re-bootstrap answer) —
+                    # don't report it as progress through health().
+                    if self._num_updates != before:
+                        self._num_commits += 1
                 tree, counter = out
                 reply.put((jax.tree.map(np.copy, tree), counter))
 

@@ -10,6 +10,9 @@ depth, stage weights live stage-sharded over the mesh's ``pp`` axis, and
 each train step scans microbatches through the pipe with embedding and LM
 head outside the trunk. Microbatch IO shards over ``dp`` when the mesh has
 one (each dp slice runs its own pipeline replica; XLA psums the gradients).
+MoE configs can additionally shard experts over an ``ep`` mesh axis
+(``ep=N``): stage expert weights take ``P("pp", "ep")`` and the stage fn
+runs the MoE block in manual-collective mode (see docs/parallel.md).
 
 GPipe fill/drain bubble: (P-1)/(M+P-1) of the schedule per direction —
 raise ``num_microbatches`` to amortize, or set ``virtual_stages=V`` for the
@@ -32,7 +35,6 @@ from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.parallel.mesh import make_mesh
 from distkeras_tpu.parallel.pipeline import (
     pipeline_apply,
-    pipeline_shardings,
     stack_stage_params,
 )
 from distkeras_tpu.training.trainers import Trainer, _StepCheckpointer
@@ -58,6 +60,7 @@ class PipelineTrainer(Trainer):
         num_stages: int | None = None,
         num_microbatches: int = 4,
         virtual_stages: int = 1,
+        ep: int | None = None,
         remat: bool = False,
         batch_size: int = 32,
         features_col: str = "features",
@@ -100,9 +103,13 @@ class PipelineTrainer(Trainer):
         self.label_col = label_col
         self.num_epoch = int(num_epoch)
         self.mesh = mesh
+        # Expert parallelism inside the pipe (MoE configs): the mesh gains
+        # an ``ep`` axis and each stage's expert weights shard over it
+        # (dp × pp × ep) instead of replicating. ``ep=None`` takes the
+        # mesh's ep axis size (1 when absent).
+        self.ep = ep
         # Weight on the MoE load-balance loss summed through the pipe
-        # (MoE configs only; experts are replicated within each stage — the
-        # PipelineTrainer mesh has no ep axis).
+        # (MoE configs only).
         self.aux_loss_weight = float(aux_loss_weight)
         # Orbax step checkpoints (same contract as the sync trainer): timed
         # saves + a final save; resume fast-forwards the deterministic feed.
@@ -156,13 +163,38 @@ class PipelineTrainer(Trainer):
                     )
         return merged
 
-    def _make_forward(self, mesh, per_stage: int):
+    def _stage_specs(self, stacked, ep_size: int):
+        """Per-leaf PartitionSpecs for the stacked stage params: the stage
+        axis shards over ``pp`` everywhere; expert-weight leaves
+        (``moe_mlp/w_in|w_out`` — leading stage dim, then the expert dim)
+        additionally shard their expert dim over ``ep``. The router stays
+        replicated over ep (every member routes the full token set)."""
+        from jax.sharding import PartitionSpec as P
+
+        def spec(path, _leaf):
+            if ep_size > 1:
+                keys = [getattr(k, "key", None) for k in path]
+                if "moe_mlp" in keys and keys[-1] in ("w_in", "w_out"):
+                    return P("pp", "ep")
+            return P("pp")
+
+        return jax.tree_util.tree_map_with_path(spec, stacked)
+
+    def _make_forward(self, mesh, per_stage: int, ep_size: int = 1,
+                      stage_specs=None):
         from flax import linen as nn
 
         from distkeras_tpu.models.bert import EncoderLayer
 
         cfg = self.cfg
-        layer_mod = EncoderLayer(cfg)
+        # ep_size > 1: the layer's MoE block runs in manual-EP mode — its
+        # expert-weight leaves are the LOCAL ep shard and it psums expert
+        # outputs over the mesh's ep axis (shard_map has no GSPMD).
+        layer_mod = EncoderLayer(
+            cfg,
+            ep_axis="ep" if ep_size > 1 else None,
+            ep_size=ep_size if ep_size > 1 else 1,
+        )
         ln_final = nn.LayerNorm(dtype=jnp.float32)
         loss_fn = get_loss(self.loss)
         M = self.num_microbatches
@@ -217,6 +249,7 @@ class PipelineTrainer(Trainer):
             y = pipeline_apply(
                 stage_fn, train_params["stages"], mb, mesh,
                 virtual_stages=self.virtual_stages, rng=rng, with_aux=moe,
+                param_specs=stage_specs,
             )
             if moe:
                 y, aux_sum = y
@@ -246,35 +279,57 @@ class PipelineTrainer(Trainer):
         if mesh is None:
             devices = jax.devices()
             pp = self.num_stages or len(devices)
-            dp = len(devices) // pp
+            ep = self.ep or 1
+            dp = len(devices) // (pp * ep)
             if dp < 1:
                 raise ValueError(
-                    f"num_stages {pp} > {len(devices)} attached devices"
+                    f"num_stages {pp} x ep {ep} > {len(devices)} attached "
+                    "devices"
                 )
-            axes = {"dp": dp, "pp": pp} if dp > 1 else {"pp": pp}
-            mesh = make_mesh(axes, devices=devices[: dp * pp])
+            axes = {"pp": pp}
+            if dp > 1:
+                axes = {"dp": dp, **axes}
+            if ep > 1:
+                axes["ep"] = ep
+            mesh = make_mesh(axes, devices=devices[: dp * pp * ep])
         num_stages = self.num_stages or mesh.shape["pp"]
         if num_stages != mesh.shape["pp"]:
             raise ValueError(
                 f"num_stages {num_stages} != mesh pp axis {mesh.shape['pp']}"
             )
+        ep_size = dict(mesh.shape).get("ep", 1)
+        if self.ep is not None and self.ep != ep_size:
+            raise ValueError(f"ep {self.ep} != mesh ep axis {ep_size}")
+        if ep_size > 1:
+            E = getattr(self.cfg, "moe_experts", 0)
+            if not E:
+                raise ValueError("ep > 1 needs an MoE config (moe_experts > 0)")
+            if E % ep_size:
+                raise ValueError(
+                    f"moe_experts {E} not divisible by ep axis {ep_size}"
+                )
 
         variables = self.model.init(self.seed)
         params = variables["params"]
         train_params, per_stage = self._split_params(params, num_stages)
 
-        stage_sh = pipeline_shardings(mesh)[0]
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        stage_specs = self._stage_specs(train_params["stages"], ep_size)
         repl = NamedSharding(mesh, P())
         train_params = {
-            "stages": jax.device_put(train_params["stages"], stage_sh),
+            "stages": jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                train_params["stages"], stage_specs,
+            ),
             "rest": jax.device_put(train_params["rest"], repl),
         }
 
         optimizer = self._optimizer()
         opt_state = optimizer.init(train_params)
-        forward = self._make_forward(mesh, per_stage)
+        forward = self._make_forward(
+            mesh, per_stage, ep_size=ep_size, stage_specs=stage_specs
+        )
 
         @jax.jit
         def step(train_params, opt_state, batch, rng):

@@ -72,6 +72,7 @@ def test_causal_ring_attention_full_sp(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients_match_dense(rng):
     """Ring attention differentiates through ppermute hops."""
     q, k, v = _qkv(rng, B=2, S=32, H=1, D=8)

@@ -1,6 +1,7 @@
 """Checkpoint/resume tests (a capability the reference lacks — SURVEY §5)."""
 
 import numpy as np
+import pytest
 
 from distkeras_tpu.checkpoint import CheckpointManager
 from distkeras_tpu.models.core import Model
@@ -136,6 +137,7 @@ def test_sync_trainer_checkpoint_resume_matches_uninterrupted(tmp_path, rng):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_checkpoint_resume(tmp_path, rng):
     import distkeras_tpu as dk
     from distkeras_tpu.models.bert import BertConfig, _make

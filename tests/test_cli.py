@@ -24,6 +24,7 @@ def job(tmp_path):
     return data, cfg, tmp_path
 
 
+@pytest.mark.slow
 def test_cli_end_to_end(job):
     data, cfg, tmp = job
     out = tmp / "weights.bin"

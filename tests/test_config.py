@@ -42,6 +42,7 @@ def test_build_rejects_inapplicable_kwargs():
         cfg.build(_model())
 
 
+@pytest.mark.slow
 def test_build_pipeline_trainer():
     from distkeras_tpu.models.bert import BertConfig, _make
 

@@ -1,6 +1,7 @@
 """Causal decoder LM tests."""
 
 import numpy as np
+import pytest
 
 import distkeras_tpu as dk
 from distkeras_tpu.models.bert import gpt_tiny
@@ -21,6 +22,7 @@ def test_causality(rng):
     assert not np.allclose(np.asarray(o1)[0, 10:], np.asarray(o2)[0, 10:])
 
 
+@pytest.mark.slow
 def test_next_token_training_learns(rng):
     """Train on a deterministic cyclic sequence; loss collapses."""
     seq, vocab = 16, 32

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from distkeras_tpu.ops.pallas.fused_xent import fused_softmax_xent
 
@@ -49,6 +50,7 @@ def test_gradients_match_optax(rng):
                                atol=1e-6, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_registered_loss_trains(rng):
     """'fused_categorical_crossentropy' works through the trainer stack."""
     import distkeras_tpu as dk

@@ -26,6 +26,7 @@ def _rollout_nocache(model, variables, prompt, n):
     return np.stack(out, axis=1)
 
 
+@pytest.mark.slow
 def test_greedy_matches_nocache_rollout(lm, rng):
     model, variables = lm
     prompt = np.asarray(rng.integers(0, 64, size=(2, 5)), np.int32)
@@ -80,6 +81,7 @@ def test_generate_rejects_bad_inputs(lm, rng):
     with pytest.raises(ValueError, match="bert zoo"):
         dk.generate(mnist_mlp(), {}, prompt[:, :4], 2)
 
+@pytest.mark.slow
 def test_beam_search_k1_equals_greedy(lm, rng):
     model, variables = lm
     prompt = np.asarray(rng.integers(0, 64, size=(2, 4)), np.int32)
@@ -89,6 +91,7 @@ def test_beam_search_k1_equals_greedy(lm, rng):
     assert scores.shape == (2, 1)
 
 
+@pytest.mark.slow
 def test_beam_search_scores_exact_and_sorted(lm, rng):
     """Returned score must equal the true total log-probability of the
     returned sequence (recomputed with no-cache full forwards), and beams
@@ -122,6 +125,7 @@ def test_beam_search_scores_exact_and_sorted(lm, rng):
     assert scores[0, 0] >= true_logprob(greedy[0]) - 0.05
 
 
+@pytest.mark.slow
 def test_generate_dp_sharded_matches_unsharded(lm, rng):
     """Batch-parallel decoding on a dp mesh produces the same greedy tokens
     as the single-device path (GSPMD propagates the batch sharding through
@@ -153,6 +157,7 @@ def test_generate_with_none_input_shape(lm, rng):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_beam_search_dp_sharded_matches_unsharded(lm, rng):
     """beam_search(mesh=...) mirrors generate's dp batch-parallel contract."""
     from distkeras_tpu.parallel.mesh import make_mesh

@@ -6,6 +6,8 @@ threading, shuffling, optimizer wiring)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # integration-scale; run with `pytest -m ''`
+
 import distkeras_tpu as dk
 from distkeras_tpu.models.core import Model
 from distkeras_tpu.models.mlp import MLP
@@ -30,14 +32,14 @@ def test_golden_single_trainer(golden_problem):
     trained = t.train(golden_problem, shuffle=True)
     hist = t.get_history()
     # recorded 2026-07-29 (jax 0.9.0, CPU): loss 0.0438593, acc 1.0.
-    # ~5% relative tolerance: survives XLA fusion-order drift across
-    # versions, catches any semantic change (rng threading, shuffle order,
-    # optimizer wiring) — those shift the loss by far more.
-    assert hist[-1]["loss"] == pytest.approx(0.0438593, rel=0.05)
+    # 1% relative tolerance (tightened from 5% after two rounds of stable
+    # seeds — VERDICT r3 task 7): survives XLA fusion-order drift, catches
+    # any semantic change (rng threading, shuffle order, optimizer wiring).
+    assert hist[-1]["loss"] == pytest.approx(0.0438593, rel=0.01)
     assert hist[-1]["accuracy"] >= 0.99
     m = t.evaluate(trained, golden_problem)
     assert m["accuracy"] == pytest.approx(0.998047, abs=0.004)
-    assert m["loss"] == pytest.approx(0.0506882, rel=0.05)
+    assert m["loss"] == pytest.approx(0.0506882, rel=0.01)
 
 
 def test_golden_deterministic_across_runs(golden_problem):
@@ -49,3 +51,50 @@ def test_golden_deterministic_across_runs(golden_problem):
         return t.get_history()[-1]["loss"]
 
     assert run() == run()  # bit-identical
+
+
+def test_golden_sync_trainer(golden_problem):
+    """Sync (GSPMD dp) family pin."""
+    t = dk.SynchronousDistributedTrainer(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        num_workers=4, batch_size=32, num_epoch=5, seed=7,
+    )
+    t.train(golden_problem, shuffle=True)
+    hist = t.get_history()
+    # recorded 2026-07-29 (jax 0.9.0, 8-device CPU mesh)
+    assert hist[-1]["loss"] == pytest.approx(0.1608761, rel=0.01)
+
+
+def test_golden_adag_trainer(golden_problem):
+    """Async/ADAG family pin: one worker makes the window/exchange cadence
+    deterministic (single PS committer; the rebase point in the drive loop
+    is fixed), so the protocol math + PS scaffold pin to 1%."""
+    t = dk.ADAG(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        num_workers=1, batch_size=32, num_epoch=5, seed=7,
+        communication_window=4,
+    )
+    t.train(golden_problem, shuffle=True)
+    hist = t.get_history()
+    # recorded 2026-07-29 (jax 0.9.0, 8-device CPU mesh)
+    assert hist[-1]["loss"] == pytest.approx(0.1025242, rel=0.01)
+
+
+def test_golden_pipeline_trainer():
+    """Pipeline family pin: pp=2 BERT copy task, fixed seed, no dropout."""
+    from distkeras_tpu.models.bert import BertConfig, _make
+
+    rng = np.random.default_rng(1234)
+    x = rng.integers(0, 32, size=(64, 8)).astype(np.int32)
+    ds = dk.Dataset.from_arrays(features=x, label=x.copy())
+    cfg = BertConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                     num_heads=2, mlp_dim=32, max_seq_len=8)
+    t = dk.PipelineTrainer(
+        _make(cfg, 8, "golden_pipe"), worker_optimizer="adam",
+        learning_rate=3e-3, num_stages=2, num_microbatches=2,
+        batch_size=16, num_epoch=3, seed=7,
+    )
+    t.train(ds, shuffle=True)
+    hist = t.get_history()
+    # recorded 2026-07-29 (jax 0.9.0, 8-device CPU mesh)
+    assert hist[-1]["loss"] == pytest.approx(3.2478456, rel=0.01)

@@ -128,6 +128,7 @@ def test_watchdog_fires_on_stall():
     assert stalls
 
 
+@pytest.mark.slow
 def test_trainer_ps_checkpoint_and_resume(tmp_path):
     rng = np.random.default_rng(0)
     x = rng.normal(size=(128, 6)).astype(np.float32)
@@ -160,6 +161,7 @@ def test_trainer_ps_checkpoint_and_resume(tmp_path):
     assert acc > 0.8, acc
 
 
+@pytest.mark.slow
 def test_compressed_deltas_train(tmp_path):
     """bf16 delta compression end-to-end, in-process and over gRPC."""
     rng = np.random.default_rng(0)
@@ -179,6 +181,7 @@ def test_compressed_deltas_train(tmp_path):
         assert acc > 0.85, (transport, acc)
 
 
+@pytest.mark.slow
 def test_kitchen_sink_async(tmp_path):
     """Feature interaction: ADAG with islands (2x2 devices), gRPC transport,
     bf16 delta compression, and PS checkpointing — all at once."""

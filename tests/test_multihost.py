@@ -7,6 +7,9 @@ import socket
 import subprocess
 import sys
 import textwrap
+import pytest
+
+pytestmark = pytest.mark.slow  # integration-scale; run with `pytest -m ''`
 
 WORKER = textwrap.dedent(
     """

@@ -91,6 +91,7 @@ def test_interleaved_pipeline_matches_sequential(rng, P, V, M):
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_interleaved_pipeline_gradients(rng):
     P, V, M, D, B = 4, 2, 4, 8, 2
     mesh = make_mesh({"pp": P})
@@ -124,6 +125,7 @@ def test_stack_stage_params_rejects_indivisible(rng):
     with pytest.raises(ValueError, match="virtual_stages"):
         stack_stage_params(_stages(rng, 6, 4), virtual_stages=4)
 
+@pytest.mark.slow
 def test_pipeline_schedule_property(rng):
     """Schedule invariant over (P, V, M): the interleaved rotation equals
     sequential application for every divisor mesh and ragged microbatch

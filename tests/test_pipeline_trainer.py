@@ -12,6 +12,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # integration-scale; run with `pytest -m ''`
+
 import distkeras_tpu as dk
 from distkeras_tpu.models.bert import BertConfig, _make
 
@@ -270,3 +272,61 @@ def test_pipeline_trainer_moe_with_dropout():
     assert all(np.isfinite(h["aux_loss"]) for h in hist)
     preds = trained.predict(np.asarray(ds["features"][:2]))
     assert np.isfinite(preds).all()
+
+
+def _moe_model(name="bert_pico_moe_ep", experts=4):
+    cfg = BertConfig(
+        vocab_size=VOCAB, hidden_size=32, num_layers=2, num_heads=2,
+        mlp_dim=64, max_seq_len=SEQ, moe_experts=experts,
+    )
+    return _make(cfg, SEQ, name)
+
+
+def test_pipeline_ep_stage_specs_shard_expert_dim():
+    """Expert-weight leaves of the stacked stage params shard (pp, ep);
+    everything else (router included) shards pp only — the dryrun-style
+    spec assertion for the pipelined-MoE mesh (VERDICT r3 task 3)."""
+    from jax.sharding import PartitionSpec as P
+
+    model = _moe_model()
+    trainer = dk.PipelineTrainer(model, num_stages=2, ep=2,
+                                 num_microbatches=2, batch_size=8)
+    params = model.init(0)["params"]
+    train_params, _ = trainer._split_params(params, 2)
+    specs = trainer._stage_specs(train_params["stages"], ep_size=2)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(specs)[0]
+    }
+    expert = {k: s for k, s in flat.items() if k.endswith(("w_in", "w_out"))}
+    assert expert and all(s == P("pp", "ep") for s in expert.values()), flat
+    router = {k: s for k, s in flat.items() if k.endswith("router")}
+    assert router and all(s == P("pp") for s in router.values())
+    others = {k: s for k, s in flat.items() if k not in expert}
+    assert all(s == P("pp") for s in others.values())
+
+
+def test_pipeline_trainer_moe_ep_trains_and_matches_replicated():
+    """pp×ep MoE-BERT on the 8-device mesh: aux loss decreases, the run
+    trains, and the ep-sharded expert compute matches the ep=1 (replicated
+    experts) pipeline — the psum over disjoint expert shards is the same
+    sum the single-member einsum computes (bf16 reduction order aside)."""
+    ds = _copy_task(96)
+    kwargs = dict(
+        worker_optimizer="adam", learning_rate=3e-3, num_stages=2,
+        num_microbatches=2, batch_size=32, num_epoch=3, seed=0,
+        aux_loss_weight=0.05,
+    )
+    t_ep = dk.PipelineTrainer(_moe_model(), ep=2, **kwargs)
+    t_ep.train(ds)
+    hist_ep = t_ep.get_history()
+    assert hist_ep[-1]["loss"] < hist_ep[0]["loss"]
+    assert hist_ep[-1]["aux_loss"] < hist_ep[0]["aux_loss"] * 1.05
+    assert all(np.isfinite(h["aux_loss"]) for h in hist_ep)
+
+    t_rep = dk.PipelineTrainer(_moe_model(), **kwargs)
+    t_rep.train(ds)
+    hist_rep = t_rep.get_history()
+    # Identical math modulo bf16 reduction grouping: same loss trajectory.
+    for a, b in zip(hist_ep, hist_rep):
+        assert abs(a["loss"] - b["loss"]) < 5e-2, (a, b)

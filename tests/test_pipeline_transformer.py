@@ -6,6 +6,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
+
+pytestmark = pytest.mark.slow  # integration-scale; run with `pytest -m ''`
 
 from distkeras_tpu.ops.attention import dot_product_attention
 from distkeras_tpu.parallel.mesh import make_mesh

@@ -7,6 +7,8 @@ network egress."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # integration-scale; run with `pytest -m ''`
+
 pytest.importorskip("sklearn")
 
 import distkeras_tpu as dk

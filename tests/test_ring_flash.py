@@ -16,7 +16,7 @@ def _qkv(rng, B=2, S=64, H=2, D=8):
     return mk(), mk(), mk()
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_ring_flash_matches_dense(rng, causal):
     q, k, v = _qkv(rng)
     mesh = make_mesh({"dp": 2, "sp": 4})
@@ -27,7 +27,7 @@ def test_ring_flash_matches_dense(rng, causal):
                                atol=2e-3, rtol=2e-3)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_ring_flash_gradients_match_dense(rng, causal):
     q, k, v = _qkv(rng, B=1, S=32, H=1, D=8)
     mesh = make_mesh({"sp": 8})
@@ -61,6 +61,7 @@ def test_flash_return_lse_matches_manual(rng):
     )
 
 
+@pytest.mark.slow
 def test_bert_with_ring_attention_trains(rng):
     """BERT with ring-flash attention trains under the sync trainer on a
     dp x sp mesh — end-to-end sequence-parallel long-context training."""

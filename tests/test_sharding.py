@@ -117,6 +117,7 @@ def test_gspmd_loss_matches_single_device():
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_sync_trainer_with_model_sharding():
     """SynchronousDistributedTrainer on a dp x tp mesh trains BERT-tiny with
     data+model sharding (BASELINE config #5 shape)."""
@@ -225,6 +226,7 @@ def test_zero1_optimizer_state_sharded():
     assert {s.data.shape for s in mu2.addressable_shards} == {(64, 32)}
 
 
+@pytest.mark.slow
 def test_sync_trainer_sequence_sharded_bert():
     """BERT-tiny with the sequence dimension sharded over sp (XLA-SP)."""
     import distkeras_tpu as dk

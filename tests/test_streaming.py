@@ -95,3 +95,98 @@ def test_kafka_source_gated():
         from distkeras_tpu.data.streaming import KafkaSource
 
         KafkaSource("topic")
+
+
+class _FakeKafkaMessage:
+    def __init__(self, value: bytes):
+        self.value = value
+
+
+class _FakeKafkaConsumer:
+    """In-process stand-in for kafka.KafkaConsumer (VERDICT r3 task 6):
+    replays a canned list of messages for the subscribed topic, records
+    constructor kwargs and close(), so KafkaSource.__iter__'s framing /
+    value_fn / lifecycle logic actually executes under test."""
+
+    messages_by_topic: dict = {}
+    instances: list = []
+
+    def __init__(self, topic, bootstrap_servers=None, **kwargs):
+        self.topic = topic
+        self.bootstrap_servers = bootstrap_servers
+        self.kwargs = kwargs
+        self.closed = False
+        self._msgs = list(self.messages_by_topic.get(topic, []))
+        _FakeKafkaConsumer.instances.append(self)
+
+    def __iter__(self):
+        for m in self._msgs:
+            yield _FakeKafkaMessage(m)
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def fake_kafka(monkeypatch):
+    import sys
+    import types
+
+    mod = types.ModuleType("kafka")
+    mod.KafkaConsumer = _FakeKafkaConsumer
+    _FakeKafkaConsumer.messages_by_topic = {}
+    _FakeKafkaConsumer.instances = []
+    monkeypatch.setitem(sys.modules, "kafka", mod)
+    return _FakeKafkaConsumer
+
+
+def test_kafka_source_iterates_with_default_npz_value_fn(fake_kafka):
+    """Default value_fn is the pickle-free npz PyTree codec: wire frames
+    produced by serialize_pytree round-trip through the consumer."""
+    from distkeras_tpu.data.streaming import KafkaSource
+    from distkeras_tpu.utils.pytree import serialize_pytree
+
+    batches = [
+        {"x": np.arange(6, dtype=np.float32).reshape(2, 3)},
+        {"x": np.ones((1, 3), np.float32)},
+    ]
+    fake_kafka.messages_by_topic["feats"] = [
+        serialize_pytree(b) for b in batches
+    ]
+    src = KafkaSource("feats", bootstrap_servers="broker:9092",
+                     group_id="g1")
+    got = list(src)
+    assert len(got) == 2
+    np.testing.assert_array_equal(got[0]["x"], batches[0]["x"])
+    np.testing.assert_array_equal(got[1]["x"], batches[1]["x"])
+    # constructor kwargs reached the consumer; close() propagates
+    consumer = fake_kafka.instances[-1]
+    assert consumer.bootstrap_servers == "broker:9092"
+    assert consumer.kwargs["group_id"] == "g1"
+    src.close()
+    assert consumer.closed
+
+
+def test_kafka_source_custom_value_fn_feeds_predictor(fake_kafka, trained):
+    """End-to-end: Kafka micro-batches (custom decoder) through the padded
+    StreamingPredictor — the reference's Kafka streaming-inference example
+    (examples/ Kafka notebook), minus the broker."""
+    from distkeras_tpu.data.streaming import KafkaSource
+
+    rng = np.random.default_rng(7)
+    raw = [rng.normal(size=(5, 16)).astype(np.float32) for _ in range(3)]
+    fake_kafka.messages_by_topic["rows"] = [a.tobytes() for a in raw]
+    src = KafkaSource(
+        "rows",
+        value_fn=lambda b: np.frombuffer(b, np.float32).reshape(-1, 16),
+    )
+    outs = []
+    stats = StreamingPredictor(trained, max_batch=8).run(
+        src, lambda x, p: outs.append(p)
+    )
+    assert stats["rows"] == 15 and stats["batches"] == 3
+    np.testing.assert_allclose(
+        np.concatenate(outs),
+        trained.predict(np.concatenate(raw)),
+        atol=1e-5,
+    )

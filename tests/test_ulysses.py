@@ -16,7 +16,7 @@ def _qkv(rng, B=2, S=64, H=4, D=8):
     return mk(), mk(), mk()
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_ulysses_matches_dense(rng, causal):
     q, k, v = _qkv(rng)
     mesh = make_mesh({"dp": 2, "sp": 4})
@@ -26,7 +26,7 @@ def test_ulysses_matches_dense(rng, causal):
                                atol=2e-3, rtol=2e-3)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_ulysses_gradients_match_dense(rng, causal):
     q, k, v = _qkv(rng, B=1, S=32, H=8, D=8)
     mesh = make_mesh({"sp": 8})
@@ -54,6 +54,7 @@ def test_ulysses_rejects_indivisible_heads(rng):
         ulysses_self_attention(q, k, v, mesh, seq_axis="sp")
 
 
+@pytest.mark.slow
 def test_bert_with_ulysses_attention_trains(rng):
     """BERT with Ulysses attention trains under the sync trainer on a
     dp x sp mesh, and its forward matches the plain model's."""
