@@ -48,13 +48,11 @@ while true; do
     # Warm sequence: smallest graph first so each flock window is short.
     if [ ! -s .bench_mlp.json ]; then
       echo "$(date +%FT%T) warming mlp" >> "$LOG"
-      run_bench mlp 1800 .bench_mlp.json && echo "$(date +%FT%T) mlp done: $(cat .bench_mlp.json)" >> "$LOG" \
-        && python scripts/append_baseline.py tpu-mlp .bench_mlp.json >> "$LOG" 2>&1
+      run_bench mlp 1800 .bench_mlp.json && echo "$(date +%FT%T) mlp done: $(cat .bench_mlp.json)" >> "$LOG"
     fi
     if [ -s .bench_mlp.json ] && [ ! -s .bench_bert.json ]; then
       echo "$(date +%FT%T) warming bert" >> "$LOG"
-      run_bench bert 5400 .bench_bert.json && echo "$(date +%FT%T) bert done: $(cat .bench_bert.json)" >> "$LOG" \
-        && python scripts/append_baseline.py tpu-bert-base .bench_bert.json >> "$LOG" 2>&1
+      run_bench bert 5400 .bench_bert.json && echo "$(date +%FT%T) bert done: $(cat .bench_bert.json)" >> "$LOG"
     fi
     if [ -s .bench_bert.json ] && [ ! -s .bench_kernels.json ] \
         && [ "$(cat .bench_kernels.attempts 2>/dev/null || echo 0)" -lt 3 ]; then
@@ -62,16 +60,24 @@ while true; do
       echo "$(date +%FT%T) running pallas kernel bench" >> "$LOG"
       PYTHONPATH=/root/repo flock "$LOCK" timeout --signal=KILL 5400 \
         python benchmarks/kernel_bench.py > .bench_kernels.json 2> .bench_kernels.json.err \
-        && echo "$(date +%FT%T) kernels done: $(cat .bench_kernels.json)" >> "$LOG" \
-        && python scripts/append_baseline.py tpu-pallas-kernels .bench_kernels.json >> "$LOG" 2>&1
+        && echo "$(date +%FT%T) kernels done: $(cat .bench_kernels.json)" >> "$LOG"
     fi
     # resnet50 gates on bert only — a failing kernel bench must not block
     # the BASELINE flagship model's number forever.
     if [ -s .bench_bert.json ] && [ ! -s .bench_resnet50.json ]; then
       echo "$(date +%FT%T) warming resnet50 (long compile)" >> "$LOG"
-      run_bench resnet50 10800 .bench_resnet50.json && echo "$(date +%FT%T) resnet50 done: $(cat .bench_resnet50.json)" >> "$LOG" \
-        && python scripts/append_baseline.py tpu-resnet50 .bench_resnet50.json >> "$LOG" 2>&1
+      run_bench resnet50 10800 .bench_resnet50.json && echo "$(date +%FT%T) resnet50 done: $(cat .bench_resnet50.json)" >> "$LOG"
     fi
+    # Record every existing artifact's row (idempotent: identical rows
+    # dedupe, infrastructure_failure artifacts are refused) — re-running
+    # each healthy loop means a watcher death between bench and append
+    # can never lose a measured number.
+    for pair in "tpu-mlp .bench_mlp.json" "tpu-bert-base .bench_bert.json" \
+                "tpu-pallas-kernels .bench_kernels.json" \
+                "tpu-resnet50 .bench_resnet50.json"; do
+      set -- $pair
+      [ -s "$2" ] && python scripts/append_baseline.py "$1" "$2" >> "$LOG" 2>&1
+    done
     if [ -s .bench_bert.json ] && [ -s .bench_resnet50.json ]; then
       echo "$(date +%FT%T) all warm; watcher idling (10 min probes)" >> "$LOG"
       sleep 600

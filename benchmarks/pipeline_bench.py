@@ -8,6 +8,22 @@ TPU throughput; the schedule-length ratio is what to look at. Prints one
 JSON line.
 
   XLA_FLAGS=--xla_force_host_platform_device_count=8 python benchmarks/pipeline_bench.py
+
+Memory mode (VERDICT r3 task 5 — the pipeline's activation-memory
+accounting): ``BENCH_MODE=memory`` compiles the *train step* (grad through
+``pipeline_apply`` over real transformer EncoderLayer stages) for each
+schedule — GPipe-ordered autodiff plain vs ``remat`` stage_fn, V=1 vs 2 —
+and reports **XLA's own per-device peak temp allocation**
+(``Compiled.memory_analysis().temp_size_in_bytes``), i.e. measured
+residency, not a hand model. Alongside each measured number it prints the
+analytic saved-state floor (T ticks x microbatch state) and the
+hypothetical-1F1B floor (min(P, M) in-flight microbatch states/device —
+what a hand-written-VJP 1F1B schedule could reach; the scan-autodiff
+design cannot express it, see parallel/pipeline.py header), so the docs
+table's (model, M, V, P) fit claims trace to this bench.
+
+  BENCH_MODE=memory XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python benchmarks/pipeline_bench.py
 """
 
 from __future__ import annotations
@@ -29,6 +45,7 @@ def main():
     from distkeras_tpu.parallel.mesh import make_mesh
     from distkeras_tpu.parallel.pipeline import (
         pipeline_apply,
+        schedule_ticks,
         stack_stage_params,
     )
 
@@ -75,7 +92,7 @@ def main():
             out = fn(stacked, mb)
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / steps
-        ticks = ((M - 1) // P) * V * P + ((M - 1) % P) + V * P
+        ticks = schedule_ticks(M, P, V)
         busy = M * V  # per-device busy ticks (each 1/V the work of V=1 ticks)
         results[f"v{V}"] = {
             "ms": round(dt * 1e3, 2),
@@ -101,5 +118,104 @@ def main():
     }))
 
 
+def memory_mode():
+    """Measured peak temp memory of the compiled pipelined train step, per
+    schedule. One JSON line; see module docstring."""
+    import jax
+
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from distkeras_tpu.models.bert import BertConfig, EncoderLayer
+    from distkeras_tpu.parallel.mesh import make_mesh
+    from distkeras_tpu.parallel.pipeline import (
+        pipeline_apply,
+        schedule_ticks,
+        stack_stage_params,
+    )
+
+    P = int(os.environ.get("BENCH_PP", str(len(jax.devices()))))
+    M = int(os.environ.get("BENCH_MICRO", "8"))
+    D = int(os.environ.get("BENCH_DIM", "128"))
+    S = int(os.environ.get("BENCH_SEQ", "64"))
+    B_mb = int(os.environ.get("BENCH_MB", "4"))  # rows per microbatch
+    mesh = make_mesh({"pp": P})
+    cfg = BertConfig(
+        vocab_size=64, hidden_size=D, num_heads=max(2, D // 64),
+        mlp_dim=4 * D, max_seq_len=S, num_layers=2 * P, dtype=jnp.float32,
+    )
+    layer_mod = EncoderLayer(cfg)
+    x_one = jnp.zeros((B_mb, S, D), jnp.float32)
+    layer_params = [
+        jax.tree.map(
+            lambda m: m.unbox() if hasattr(m, "unbox") else m,
+            layer_mod.init(jax.random.PRNGKey(i), x_one)["params"],
+        )
+        for i in range(2 * P)
+    ]
+    mb = np.zeros((M, B_mb, S, D), np.float32)
+    state_bytes = B_mb * S * D * 4  # one microbatch activation, f32
+
+    results = {}
+    for V in (1, 2):
+        per_stage = (2 * P) // (P * V)
+        groups = [
+            {
+                f"sub_{j}": layer_params[s * per_stage + j]
+                for j in range(per_stage)
+            }
+            for s in range(P * V)
+        ]
+        stacked = stack_stage_params(groups, virtual_stages=V)
+        ticks = schedule_ticks(M, P, V)
+
+        for remat in (False, True):
+            def base_fn(params, x, _n=per_stage):
+                for j in range(_n):
+                    x = layer_mod.apply({"params": params[f"sub_{j}"]}, x)
+                return x
+
+            stage_fn = jax.checkpoint(base_fn) if remat else base_fn
+
+            def loss(sp, x, _V=V, _fn=stage_fn):
+                y = pipeline_apply(_fn, sp, x, mesh, virtual_stages=_V)
+                return jnp.sum(y * y)
+
+            compiled = jax.jit(jax.grad(loss)).lower(stacked, mb).compile()
+            ma = compiled.memory_analysis()
+            key = f"v{V}_{'remat' if remat else 'plain'}"
+            results[key] = {
+                "measured_temp_mb": round(ma.temp_size_in_bytes / 2**20, 2),
+                "args_mb": round(ma.argument_size_in_bytes / 2**20, 2),
+                "ticks": ticks,
+                # Scan-autodiff floor: every tick's carried state is saved
+                # for the backward (remat removes the per-layer internals,
+                # not the carries).
+                "analytic_saved_state_mb": round(
+                    ticks * state_bytes / 2**20, 2
+                ),
+            }
+
+    print(json.dumps({
+        "metric": "pipeline_activation_memory",
+        "pp": P, "microbatches": M, "layers": 2 * P, "hidden": D,
+        "seq": S, "microbatch_rows": B_mb,
+        "state_bytes_per_microbatch": state_bytes,
+        **results,
+        # What a hand-written 1F1B could hold instead: at most min(P, M)
+        # microbatch states in flight per device (plus one stage's
+        # recompute workspace). The scanned schedule cannot express this
+        # without a custom VJP — recorded here as the comparison floor.
+        "hypothetical_1f1b_state_mb": round(
+            min(P, M) * state_bytes / 2**20, 2
+        ),
+        "backend": jax.default_backend(),
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MODE") == "memory":
+        memory_mode()
+    else:
+        main()

@@ -1,6 +1,10 @@
 """Pipeline parallelism over a ``pp`` mesh axis (GPipe + Megatron-style
 interleaved virtual stages; the backward is the scan's autodiff
-time-reversal — GPipe-ordered, not 1F1B).
+time-reversal — GPipe-ordered, not 1F1B). The activation-memory price of
+that choice is measured, not guessed: ``BENCH_MODE=memory
+benchmarks/pipeline_bench.py`` reports XLA's compiled peak temp per
+schedule (plain vs remat, V=1 vs 2) next to the hypothetical 1F1B floor;
+the (model, M, V, P)-fits-16GB table lives in docs/parallel.md.
 
 Absent from the reference (SURVEY §2 parallelism table) but a first-class
 axis here. The design is SPMD, not a scheduler: every device runs the same
@@ -45,7 +49,22 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "stack_stage_params", "pipeline_shardings"]
+__all__ = [
+    "pipeline_apply",
+    "stack_stage_params",
+    "pipeline_shardings",
+    "schedule_ticks",
+]
+
+
+def schedule_ticks(num_microbatches: int, num_devices: int,
+                   virtual_stages: int = 1) -> int:
+    """Total scan ticks of the interleaved schedule: microbatch ``M-1``
+    injects at ``((M-1)//P)·V·P + (M-1)%P`` and takes ``V·P`` ticks to
+    drain. The ONE definition — the scan body, the schedule bench, and the
+    memory bench all derive their tick counts from it."""
+    M, P, V = num_microbatches, num_devices, virtual_stages
+    return ((M - 1) // P) * V * P + (M - 1) % P + V * P
 
 
 def stack_stage_params(stage_params_list, virtual_stages: int = 1):
@@ -168,9 +187,7 @@ def _pipeline_local(
 
     # Static tick count: last microbatch M-1 emits at inj(M-1) + V·P - 1
     # (axis_size of a mesh axis is a static int, so T is trace-time known).
-    T = ((M - 1) // num_devices) * V * num_devices + (
-        (M - 1) % num_devices
-    ) + V * num_devices
+    T = schedule_ticks(M, num_devices, V)
     (_, out_buf, aux_acc), _ = lax.scan(
         tick, (state, out_buf, aux_acc), jnp.arange(T)
     )

@@ -72,11 +72,14 @@ def main() -> int:
         print(f"append_baseline: {tag} is an infrastructure-failure line; "
               "not a measurement — skipped", file=sys.stderr)
         return 0
-    if "value" not in rec and "metric" not in rec:
-        # Free-form report (kernel_bench): record the whole JSON object.
+    if "value" not in rec:
+        # Free-form report (kernel_bench: has a metric but no scalar
+        # value): stuff the whole JSON object into the detail column so
+        # the timings/numerics land in BASELINE.md — and so re-runs with
+        # changed numbers produce a different row (dedupe-visible).
         detail = {"report": rec, **detail} if detail else {"report": rec}
-        rec = {"metric": tag, "value": "—", "unit": "see detail",
-               "detail": detail}
+        rec = {"metric": rec.get("metric", tag), "value": "—",
+               "unit": "see detail", "detail": detail}
     device = str(detail.get("device", "?"))
     extras = {
         k: detail[k]

@@ -142,3 +142,35 @@ def test_pipeline_schedule_property(rng):
         ref = np.stack([_sequential(stages, x[m]) for m in range(M)])
         np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5,
                                    rtol=1e-5, err_msg=f"P={P} V={V} M={M}")
+
+
+@pytest.mark.slow
+def test_pipeline_memory_bench_remat_reduces_peak():
+    """Guard the activation-memory accounting (docs/parallel.md table):
+    the bench runs, reports XLA-measured temp per schedule, and remat
+    strictly reduces the peak for both V."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(
+        BENCH_MODE="memory", BENCH_PP="2", BENCH_MICRO="4",
+        BENCH_DIM="64", BENCH_SEQ="32", BENCH_MB="2",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    out = subprocess.run(
+        [sys.executable, "benchmarks/pipeline_bench.py"],
+        capture_output=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr.decode()[-2000:]
+    rec = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    assert rec["metric"] == "pipeline_activation_memory"
+    for v in ("v1", "v2"):
+        plain = rec[f"{v}_plain"]["measured_temp_mb"]
+        remat = rec[f"{v}_remat"]["measured_temp_mb"]
+        assert remat < plain, rec
+    assert rec["hypothetical_1f1b_state_mb"] > 0
