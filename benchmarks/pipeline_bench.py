@@ -16,11 +16,11 @@ schedule — GPipe-ordered autodiff plain vs ``remat`` stage_fn, V=1 vs 2 —
 and reports **XLA's own per-device peak temp allocation**
 (``Compiled.memory_analysis().temp_size_in_bytes``), i.e. measured
 residency, not a hand model. Alongside each measured number it prints the
-analytic saved-state floor (T ticks x microbatch state) and the
-hypothetical-1F1B floor (min(P, M) in-flight microbatch states/device —
-what a hand-written-VJP 1F1B schedule could reach; the scan-autodiff
-design cannot express it, see parallel/pipeline.py header), so the docs
-table's (model, M, V, P) fit claims trace to this bench.
+analytic saved-state floor (T ticks x microbatch state) and — measured
+the same way — the TRUE 1F1B engine (parallel/pipeline_1f1b.py:
+hand-rolled backward, ring buffer of <= P in-flight inputs, residency
+independent of M), so the docs table's (model, M, V, P) fit claims trace
+to this bench.
 
   BENCH_MODE=memory XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
       python benchmarks/pipeline_bench.py
@@ -197,19 +197,53 @@ def memory_mode():
                 ),
             }
 
+    # --- true 1F1B (hand-rolled backward, parallel/pipeline_1f1b.py) ----
+    # Same per-stage depth as the V=1 schedules (2 layers/stage), head +
+    # loss fused into the last stage as the engine requires.
+    from distkeras_tpu.parallel.pipeline_1f1b import (
+        pipeline_1f1b_value_and_grad,
+        ticks_1f1b,
+    )
+
+    def stage2(params, x):
+        for j in range(2):
+            x = layer_mod.apply({"params": params[f"sub_{j}"]}, x)
+        return x
+
+    def last_fn(params, hp, x, labels_mb):
+        y = stage2(params, x)
+        return jnp.sum((y @ hp["w"] - labels_mb) ** 2)
+
+    head = {"w": np.zeros((D, 8), np.float32)}
+    labels = np.zeros((M, B_mb, S, 8), np.float32)
+    groups2 = [
+        {f"sub_{j}": layer_params[s * 2 + j] for j in range(2)}
+        for s in range(P)
+    ]
+    stacked2 = stack_stage_params(groups2)
+    compiled = jax.jit(
+        lambda sp, hp, x, y: pipeline_1f1b_value_and_grad(
+            stage2, last_fn, sp, hp, x, y, mesh
+        )
+    ).lower(stacked2, head, mb, labels).compile()
+    ma = compiled.memory_analysis()
+    results["true_1f1b"] = {
+        "measured_temp_mb": round(ma.temp_size_in_bytes / 2**20, 2),
+        "args_mb": round(ma.argument_size_in_bytes / 2**20, 2),
+        "ticks": ticks_1f1b(M, P),
+        # The ring holds <= P in-flight microbatch inputs per device,
+        # independent of M — the bound the scanned schedules can't reach.
+        "analytic_saved_state_mb": round(
+            min(P, M) * state_bytes / 2**20, 2
+        ),
+    }
+
     print(json.dumps({
         "metric": "pipeline_activation_memory",
         "pp": P, "microbatches": M, "layers": 2 * P, "hidden": D,
         "seq": S, "microbatch_rows": B_mb,
         "state_bytes_per_microbatch": state_bytes,
         **results,
-        # What a hand-written 1F1B could hold instead: at most min(P, M)
-        # microbatch states in flight per device (plus one stage's
-        # recompute workspace). The scanned schedule cannot express this
-        # without a custom VJP — recorded here as the comparison floor.
-        "hypothetical_1f1b_state_mb": round(
-            min(P, M) * state_bytes / 2**20, 2
-        ),
         "backend": jax.default_backend(),
     }))
 

@@ -3,8 +3,10 @@ interleaved virtual stages; the backward is the scan's autodiff
 time-reversal — GPipe-ordered, not 1F1B). The activation-memory price of
 that choice is measured, not guessed: ``BENCH_MODE=memory
 benchmarks/pipeline_bench.py`` reports XLA's compiled peak temp per
-schedule (plain vs remat, V=1 vs 2) next to the hypothetical 1F1B floor;
-the (model, M, V, P)-fits-16GB table lives in docs/parallel.md.
+schedule (plain vs remat, V=1 vs 2) next to the TRUE 1F1B engine
+(:mod:`distkeras_tpu.parallel.pipeline_1f1b` — hand-rolled backward,
+O(P) residency independent of M); the (model, M, V, P)-fits-16GB table
+lives in docs/parallel.md.
 
 Absent from the reference (SURVEY §2 parallelism table) but a first-class
 axis here. The design is SPMD, not a scheduler: every device runs the same
@@ -37,7 +39,9 @@ The whole schedule is a ``lax.scan``, so it differentiates: gradients flow
 back through the ppermutes (reverse hops) and the per-stage applications,
 giving pipeline-parallel *training*, not just inference. (The backward is
 the scan's time-reversal — activation memory is the remat lever on
-``stage_fn``, not the schedule; see PipelineTrainer's ``remat``.)
+``stage_fn``, not the schedule; see PipelineTrainer's ``remat``, or
+``schedule="1f1b"`` for the hand-rolled schedule whose residency is
+independent of M.)
 """
 
 from __future__ import annotations

@@ -1,0 +1,275 @@
+"""True 1F1B pipeline schedule with a hand-rolled backward (one SPMD scan).
+
+The scanned GPipe engine (:mod:`distkeras_tpu.parallel.pipeline`) gets its
+backward from the scan's autodiff time-reversal: every (stage, tick)
+residual stays live until the reversed scan consumes it, so peak
+activation residency grows with the microbatch count ``M`` (measured in
+``BENCH_MODE=memory benchmarks/pipeline_bench.py``). The classic fix —
+the PipeDream-flush / Megatron "1F1B" schedule — cannot be expressed
+through scan autodiff because it *interleaves* forward and backward work;
+this module therefore writes the backward by hand.
+
+**Schedule.** Non-interleaved 1F1B over ``P = mesh['pp']`` devices.
+Device ``d`` runs the forward of microbatch ``m`` at tick ``2m + d`` and
+its backward at tick ``2m + 2P - 1 - d``; the two assignments can never
+collide (their tick parities differ), each device strictly alternates
+F/B in steady state, neighbouring devices are phase-shifted by one tick
+(activations hop ``d -> d+1``, cotangents hop ``d -> d-1``, one
+``ppermute`` each per tick), and the whole step is ONE ``lax.scan`` of
+``2M + 2P - 2`` ticks.
+
+**Memory.** A device keeps only the *stage inputs* of microbatches whose
+backward has not run yet — at most ``ceil((2P - 1 - 2d) / 2) <= P`` of
+them, held in a ring buffer — and recomputes the stage forward inside
+``jax.vjp`` at the backward tick (Megatron-style activation
+recomputation). Peak residency is therefore O(P) microbatch states per
+device **independent of M**, vs the scanned engine's O(M·V); compute
+matches the scanned engine with ``remat=True`` (one extra forward per
+stage application).
+
+**Loss placement.** 1F1B needs each microbatch's output cotangent the
+tick after its last-stage forward, so the head + loss must live *inside*
+the pipe: the last device's backward runs ``jax.vjp`` through
+``last_fn(stage_params, head_params, x, labels)`` (stage -> head -> scalar
+loss) with cotangent seed 1. The pipeline input's cotangent is emitted
+per microbatch so the caller can backpropagate into the embedding that
+produced the microbatches.
+
+The result is a *value-and-grad* primitive, not a differentiable forward:
+``pipeline_1f1b_value_and_grad`` returns the summed loss, the stacked
+per-stage parameter gradients, the head gradients, and the per-microbatch
+input cotangents. No reference counterpart exists (SURVEY §2: pipeline
+parallelism absent from the reference entirely).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distkeras_tpu.parallel.pipeline import stack_stage_params  # noqa: F401
+
+__all__ = ["pipeline_1f1b_value_and_grad", "ticks_1f1b"]
+
+
+def ticks_1f1b(num_microbatches: int, num_devices: int) -> int:
+    """Scan length: the last backward is B_0(M-1) at ``2(M-1) + 2P - 1``."""
+    return 2 * num_microbatches + 2 * num_devices - 2
+
+
+def _1f1b_local(
+    stage_fn, last_fn, stacked_params, head_params, microbatches, labels,
+    axis_name: str,
+):
+    """Per-device body (inside shard_map over ``axis_name``)."""
+    d = lax.axis_index(axis_name)
+    num_devices = lax.axis_size(axis_name)
+    M, B = microbatches.shape[0], microbatches.shape[1]
+    feat = microbatches.shape[2:]
+    dtype = microbatches.dtype
+    Pd = num_devices
+
+    my_params = jax.tree.map(lambda x: x[0], stacked_params)  # [1,...] shard
+    fwd_perm = [(i, (i + 1) % Pd) for i in range(Pd)]
+    bwd_perm = [(i, (i - 1) % Pd) for i in range(Pd)]
+
+    def varying(x):
+        if axis_name in getattr(jax.typeof(x), "vma", ()):
+            return x  # already device-varying over the pipe axis
+        return lax.pcast(x, axis_name, to="varying")
+
+    # CRITICAL: the head params must be pp-varying before any vjp touches
+    # them. Taking a cotangent w.r.t. an axis-INVARIANT input makes JAX
+    # close the transpose with a psum over that axis — and here the vjp
+    # runs inside a cond branch only the last device takes, so that psum
+    # would be a collective inside a divergent branch: a lock-step
+    # deadlock (observed as an XLA rendezvous timeout). Varying inputs
+    # need no such psum; the disjoint-sum reduction happens once, after
+    # the scan, on the accumulated grads.
+    head_params = jax.tree.map(varying, head_params)
+
+    zero_state = jnp.zeros((B, *feat), dtype)
+    zero_grads = jax.tree.map(jnp.zeros_like, my_params)
+    zero_hgrads = jax.tree.map(
+        lambda x: jnp.zeros_like(x, dtype=jnp.float32), head_params
+    )
+    carry0 = dict(
+        act_in=varying(zero_state),            # activation arriving for F
+        cot_in=varying(zero_state.astype(jnp.float32)),  # arriving cotangent
+        ring=varying(jnp.zeros((Pd, B, *feat), dtype)),  # in-flight inputs
+        # zeros_like of the (sharded, already-varying) local params is
+        # itself varying — no pcast needed or allowed.
+        grads=zero_grads,
+        head_grads=jax.tree.map(varying, zero_hgrads),
+        loss=varying(jnp.float32(0.0)),
+        cot_out=varying(jnp.zeros((M, B, *feat), jnp.float32)),
+    )
+
+    last = Pd - 1
+
+    def tick(carry, t):
+        # Role this tick (mutually exclusive by parity — see module doc).
+        mf2, mb2 = t - d, t - (2 * Pd - 1 - d)
+        is_f = (mf2 >= 0) & (mf2 % 2 == 0) & (mf2 // 2 < M)
+        is_b = (mb2 >= 0) & (mb2 % 2 == 0) & (mb2 // 2 < M)
+        m_f = jnp.clip(mf2 // 2, 0, M - 1)
+        m_b = jnp.clip(mb2 // 2, 0, M - 1)
+
+        def f_branch(c):
+            x_feed = lax.dynamic_index_in_dim(microbatches, m_f, 0, False)
+            x = jnp.where(d == 0, x_feed, c["act_in"])
+            ring = lax.dynamic_update_index_in_dim(c["ring"], x, m_f % Pd, 0)
+            # The last device's F output is never consumed (its B tick
+            # recomputes through the vjp), so skip the stage math there.
+            y = jnp.where(
+                d == last, jnp.zeros_like(x), stage_fn(my_params, x)
+            )
+            return (
+                dict(c, ring=ring), y,
+                varying(jnp.zeros((B, *feat), jnp.float32)),
+            )
+
+        def b_branch(c):
+            x = lax.dynamic_index_in_dim(c["ring"], m_b % Pd, 0, False)
+
+            # Both vjps are computed under masks (lax.switch picks the
+            # branch; inside it, jnp.where picks which result is real) —
+            # only one runs per tick per device.
+            def last_loss(p, hp, xx):
+                yl = lax.dynamic_index_in_dim(labels, m_b, 0, False)
+                return last_fn(p, hp, xx, yl)
+
+            def mid_apply(p, xx):
+                return stage_fn(p, xx)
+
+            def do_last(_):
+                loss_m, vjp = jax.vjp(last_loss, my_params, head_params, x)
+                gp, ghp, gx = vjp(jnp.ones_like(loss_m))
+                # f32 accumulators regardless of head param dtype (the
+                # head is already pp-varying, so its cotangent is too).
+                ghp = jax.tree.map(lambda g: g.astype(jnp.float32), ghp)
+                return (
+                    loss_m.astype(jnp.float32), gp, ghp,
+                    gx.astype(jnp.float32),
+                )
+
+            def do_mid(_):
+                _, vjp = jax.vjp(mid_apply, my_params, x)
+                gp, gx = vjp(c["cot_in"].astype(dtype))
+                # Fresh zeros are axis-invariant; the cond's other branch
+                # returns varying values — match the types explicitly.
+                return (
+                    varying(jnp.float32(0.0)), gp,
+                    jax.tree.map(
+                        lambda z: varying(jnp.zeros_like(z)), zero_hgrads
+                    ),
+                    gx.astype(jnp.float32),
+                )
+
+            loss_m, gp, ghp, gx = lax.cond(d == last, do_last, do_mid, None)
+            grads = jax.tree.map(jnp.add, c["grads"], gp)
+            head_grads = jax.tree.map(jnp.add, c["head_grads"], ghp)
+            # Device 0's input cotangent feeds the embedding backward.
+            cot_out = jnp.where(
+                d == 0,
+                lax.dynamic_update_index_in_dim(
+                    c["cot_out"], gx, m_b, 0
+                ),
+                c["cot_out"],
+            )
+            return (
+                dict(c, grads=grads, head_grads=head_grads,
+                     loss=c["loss"] + loss_m, cot_out=cot_out),
+                varying(jnp.zeros((B, *feat), dtype)),
+                gx,
+            )
+
+        def idle(c):
+            return (
+                c,
+                varying(jnp.zeros((B, *feat), dtype)),
+                varying(jnp.zeros((B, *feat), jnp.float32)),
+            )
+
+        role = jnp.where(is_f, 1, jnp.where(is_b, 2, 0))
+        carry, y_send, cot_send = lax.switch(
+            role, [idle, f_branch, b_branch], carry
+        )
+        # Collectives run unconditionally (outside the switch) every tick.
+        carry = dict(
+            carry,
+            act_in=lax.ppermute(y_send, axis_name, fwd_perm),
+            cot_in=lax.ppermute(
+                cot_send.astype(jnp.float32), axis_name, bwd_perm
+            ),
+        )
+        return carry, None
+
+    T = ticks_1f1b(M, Pd)
+    carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+    # Disjoint sums: loss/head_grads live on device P-1, cot_out on
+    # device 0; stage grads stay per-device (stacked over pp outside).
+    loss = lax.psum(carry["loss"], axis_name)
+    head_grads = jax.tree.map(
+        lambda g: lax.psum(g, axis_name), carry["head_grads"]
+    )
+    cot_out = lax.psum(carry["cot_out"], axis_name)
+    stage_grads = jax.tree.map(lambda g: g[None], carry["grads"])
+    return loss, stage_grads, head_grads, cot_out
+
+
+def pipeline_1f1b_value_and_grad(
+    stage_fn,
+    last_fn,
+    stacked_params,
+    head_params,
+    microbatches,
+    labels,
+    mesh: Mesh,
+    axis_name: str = "pp",
+):
+    """Run one 1F1B train-step evaluation over ``mesh[axis_name]``.
+
+    - ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape`` —
+      applied by devices ``0 .. P-2`` (and recomputed inside the last
+      device's vjp);
+    - ``last_fn(stage_params, head_params, x, labels_mb) -> scalar loss``
+      — the last stage *including head and loss* for one microbatch;
+    - ``stacked_params``: PyTree with leading stage axis ``[P, ...]``
+      (:func:`stack_stage_params`), sharded over ``axis_name``;
+    - ``head_params``: replicated head/loss params;
+    - ``microbatches``: ``[M, B, ...]``; ``labels``: ``[M, ...]`` —
+      replicated (no dp support yet; wrap per dp slice if needed).
+
+    Returns ``(loss_sum, stage_grads, head_grads, input_cotangents)``:
+    the summed microbatch losses, gradients stacked ``[P, ...]`` over the
+    stage axis, head gradients, and ``[M, B, ...]`` cotangents of the
+    pipeline inputs (float32) for the caller's embedding backward.
+    Divide by ``M`` for means. Peak per-device activation residency is
+    O(P) microbatch states (ring buffer) — independent of M.
+    """
+    from jax import shard_map
+
+    spec_p = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = shard_map(
+        partial(_1f1b_local, stage_fn, last_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec_p, P(), P(), P()),
+        out_specs=(
+            P(),
+            jax.tree.map(lambda _: P(axis_name), stacked_params),
+            jax.tree.map(lambda _: P(), head_params),
+            P(),
+        ),
+    )
+    lead = jax.tree.leaves(stacked_params)[0].shape[0]
+    if lead != mesh.shape[axis_name]:
+        raise ValueError(
+            f"stacked params have {lead} stages but mesh {axis_name}="
+            f"{mesh.shape[axis_name]} (1F1B is non-interleaved: V=1)"
+        )
+    return fn(stacked_params, head_params, microbatches, labels)

@@ -62,6 +62,7 @@ class PipelineTrainer(Trainer):
         virtual_stages: int = 1,
         ep: int | None = None,
         remat: bool = False,
+        schedule: str = "gpipe",
         batch_size: int = 32,
         features_col: str = "features",
         label_col: str = "label",
@@ -98,6 +99,16 @@ class PipelineTrainer(Trainer):
         # memory lever 1F1B buys via scheduling (which a scan-autodiff
         # pipeline cannot express without a hand-written VJP).
         self.remat = bool(remat)
+        # "gpipe": the scanned differentiable schedule (supports V,
+        # dropout, MoE, ep). "1f1b": the hand-rolled
+        # PipeDream-flush/Megatron schedule (parallel/pipeline_1f1b.py) —
+        # O(P) activation residency independent of num_microbatches
+        # (measured ~19x less than gpipe plain, ~4x less than remat in
+        # BENCH_MODE=memory), at remat-equivalent compute. v1 limits:
+        # V=1, no dropout, no MoE, pp-only mesh, loss metric only.
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        self.schedule = schedule
         self.batch_size = int(batch_size)
         self.features_col = features_col
         self.label_col = label_col
@@ -271,6 +282,77 @@ class PipelineTrainer(Trainer):
 
         return forward
 
+    def _make_1f1b_step(self, mesh, per_stage: int, optimizer):
+        """Train step on the hand-rolled 1F1B engine: embedding vjp outside
+        the pipe, head + loss fused into the last stage (the engine needs
+        each microbatch's cotangent right after its final forward), stage
+        grads from the scan, tied-embedding grads summed from both uses."""
+        from flax import linen as nn
+
+        from distkeras_tpu.models.bert import EncoderLayer
+        from distkeras_tpu.parallel.pipeline_1f1b import (
+            pipeline_1f1b_value_and_grad,
+        )
+
+        cfg = self.cfg
+        layer_mod = EncoderLayer(cfg)
+        ln_final = nn.LayerNorm(dtype=jnp.float32)
+        loss_fn = get_loss(self.loss)
+        M = self.num_microbatches
+
+        def stage_fn(stage_params, x):
+            for j in range(per_stage):
+                x = layer_mod.apply({"params": stage_params[f"sub_{j}"]}, x)
+            return x
+
+        def last_fn(stage_params, head, x, labels_mb):
+            x = stage_fn(stage_params, x)
+            x = ln_final.apply({"params": head["ln_final"]}, x)
+            emb = head["token_embed"]["embedding"]
+            logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T
+            logits = logits + head["mlm_bias"]
+            # Per-microbatch mean scaled by 1/M: the engine sums over
+            # microbatches, so the total is the batch-mean loss and every
+            # gradient it returns is already mean-scaled.
+            return loss_fn(logits, labels_mb) / M
+
+        @jax.jit
+        def step(train_params, opt_state, batch, rng):
+            del rng  # 1f1b v1: deterministic trunk (no dropout)
+            rest = train_params["rest"]
+            tokens = batch["features"].astype(jnp.int32)
+            labels = batch["label"]
+            B, S = tokens.shape
+            if B % M:
+                raise ValueError(
+                    f"batch {B} not divisible into {M} microbatches"
+                )
+
+            def embed_all(r):
+                emb = r["token_embed"]["embedding"]
+                x = emb[tokens].astype(cfg.dtype)
+                x = x + r["pos_embed"][:, :S].astype(cfg.dtype)
+                return x.reshape(M, B // M, S, x.shape[-1])
+
+            mbs, embed_vjp = jax.vjp(embed_all, rest)
+            labels_mb = labels.reshape(M, B // M, *labels.shape[1:])
+            loss, stage_grads, head_grads, cot = pipeline_1f1b_value_and_grad(
+                stage_fn, last_fn, train_params["stages"], rest, mbs,
+                labels_mb, mesh,
+            )
+            (embed_grads,) = embed_vjp(cot.astype(mbs.dtype))
+            # Tied embedding: head use (logits) + embed use sum; disjoint
+            # leaves (pos_embed vs ln_final/mlm_bias) sum with zeros.
+            rest_grads = jax.tree.map(
+                lambda a, b: a.astype(b.dtype) + b, head_grads, embed_grads
+            )
+            grads = {"stages": stage_grads, "rest": rest_grads}
+            updates, new_opt = optimizer.update(grads, opt_state, train_params)
+            new_params = optax.apply_updates(train_params, updates)
+            return new_params, new_opt, {"loss": loss}
+
+        return step
+
     # -- training ------------------------------------------------------------
 
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
@@ -281,6 +363,10 @@ class PipelineTrainer(Trainer):
             pp = self.num_stages or len(devices)
             ep = self.ep or 1
             dp = len(devices) // (pp * ep)
+            if self.schedule == "1f1b":
+                # 1f1b v1 is pp-only: don't auto-fold spare devices into a
+                # dp axis the schedule would then reject.
+                dp = min(dp, 1)
             if dp < 1:
                 raise ValueError(
                     f"num_stages {pp} x ep {ep} > {len(devices)} attached "
@@ -327,18 +413,46 @@ class PipelineTrainer(Trainer):
 
         optimizer = self._optimizer()
         opt_state = optimizer.init(train_params)
-        forward = self._make_forward(
-            mesh, per_stage, ep_size=ep_size, stage_specs=stage_specs
-        )
+        if self.schedule == "1f1b":
+            unsupported = []
+            if self.virtual_stages != 1:
+                unsupported.append("virtual_stages > 1")
+            if self._dropout:
+                unsupported.append("dropout")
+            if self._moe:
+                unsupported.append("MoE")
+            if dict(mesh.shape).get("dp", 1) > 1 or ep_size > 1:
+                unsupported.append("dp/ep mesh axes")
+            if unsupported:
+                raise ValueError(
+                    "schedule='1f1b' does not support: "
+                    + ", ".join(unsupported)
+                    + " (use the gpipe schedule, or remat for memory)"
+                )
+            extra_metrics = [m for m in self.metrics if m != "loss"]
+            if extra_metrics:
+                import logging
 
-        @jax.jit
-        def step(train_params, opt_state, batch, rng):
-            (_, metrics), grads = jax.value_and_grad(forward, has_aux=True)(
-                train_params, batch, rng
+                logging.getLogger(__name__).warning(
+                    "schedule='1f1b' records only the loss; requested "
+                    "metrics %s will be absent from the history (the "
+                    "hand-rolled backward never materializes full-batch "
+                    "logits)", extra_metrics,
+                )
+            step = self._make_1f1b_step(mesh, per_stage, optimizer)
+        else:
+            forward = self._make_forward(
+                mesh, per_stage, ep_size=ep_size, stage_specs=stage_specs
             )
-            updates, opt_state = optimizer.update(grads, opt_state, train_params)
-            train_params = optax.apply_updates(train_params, updates)
-            return train_params, opt_state, metrics
+
+            @jax.jit
+            def step(train_params, opt_state, batch, rng):
+                (_, metrics), grads = jax.value_and_grad(forward, has_aux=True)(
+                    train_params, batch, rng
+                )
+                updates, opt_state = optimizer.update(grads, opt_state, train_params)
+                train_params = optax.apply_updates(train_params, updates)
+                return train_params, opt_state, metrics
 
         # Batch feed: shard the batch dim over dp when the mesh has one.
         batch_spec = (
