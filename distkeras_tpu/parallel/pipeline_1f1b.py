@@ -35,6 +35,16 @@ loss) with cotangent seed 1. The pipeline input's cotangent is emitted
 per microbatch so the caller can backpropagate into the embedding that
 produced the microbatches.
 
+**Stochastic layers.** With ``rng``, each stage application receives the
+key ``fold_in(fold_in(rng, m), stage)`` — a deterministic function of
+(microbatch, stage), so the backward tick's recompute reproduces the
+forward tick's dropout masks exactly.
+
+**Data parallelism.** Pass ``io_spec`` (e.g. ``P(None, "dp")``) to shard
+the microbatch batch axis: each dp slice runs its own 1F1B pipe; losses,
+auxes, and parameter gradients are ``pmean``-ed over the dp axes (the
+mean-loss convention), input cotangents stay dp-sharded like the inputs.
+
 The result is a *value-and-grad* primitive, not a differentiable forward:
 ``pipeline_1f1b_value_and_grad`` returns the summed loss, the stacked
 per-stage parameter gradients, the head gradients, and the per-microbatch
@@ -63,53 +73,74 @@ def ticks_1f1b(num_microbatches: int, num_devices: int) -> int:
 
 def _1f1b_local(
     stage_fn, last_fn, stacked_params, head_params, microbatches, labels,
-    axis_name: str,
+    rng, axis_name: str, varying_axes=(), with_aux: bool = False,
 ):
-    """Per-device body (inside shard_map over ``axis_name``)."""
+    """Per-device body (inside shard_map over ``axis_name`` + any dp axes)."""
     d = lax.axis_index(axis_name)
     num_devices = lax.axis_size(axis_name)
     M, B = microbatches.shape[0], microbatches.shape[1]
     feat = microbatches.shape[2:]
     dtype = microbatches.dtype
     Pd = num_devices
+    all_axes = (axis_name, *varying_axes)
 
     my_params = jax.tree.map(lambda x: x[0], stacked_params)  # [1,...] shard
     fwd_perm = [(i, (i + 1) % Pd) for i in range(Pd)]
     bwd_perm = [(i, (i - 1) % Pd) for i in range(Pd)]
 
     def varying(x):
-        if axis_name in getattr(jax.typeof(x), "vma", ()):
-            return x  # already device-varying over the pipe axis
-        return lax.pcast(x, axis_name, to="varying")
+        have = getattr(jax.typeof(x), "vma", ())
+        need = tuple(a for a in all_axes if a not in have)
+        return lax.pcast(x, need, to="varying") if need else x
 
-    # CRITICAL: the head params must be pp-varying before any vjp touches
+    # CRITICAL: the head params must be varying before any vjp touches
     # them. Taking a cotangent w.r.t. an axis-INVARIANT input makes JAX
     # close the transpose with a psum over that axis — and here the vjp
-    # runs inside a cond branch only the last device takes, so that psum
+    # runs inside a cond branch only the last pp row takes, so that psum
     # would be a collective inside a divergent branch: a lock-step
     # deadlock (observed as an XLA rendezvous timeout). Varying inputs
-    # need no such psum; the disjoint-sum reduction happens once, after
-    # the scan, on the accumulated grads.
+    # need no such psum; the reductions happen once, after the scan, on
+    # the accumulated values.
     head_params = jax.tree.map(varying, head_params)
+    my_params = jax.tree.map(varying, my_params)
 
     zero_state = jnp.zeros((B, *feat), dtype)
-    zero_grads = jax.tree.map(jnp.zeros_like, my_params)
-    zero_hgrads = jax.tree.map(
-        lambda x: jnp.zeros_like(x, dtype=jnp.float32), head_params
-    )
     carry0 = dict(
         act_in=varying(zero_state),            # activation arriving for F
         cot_in=varying(zero_state.astype(jnp.float32)),  # arriving cotangent
         ring=varying(jnp.zeros((Pd, B, *feat), dtype)),  # in-flight inputs
-        # zeros_like of the (sharded, already-varying) local params is
-        # itself varying — no pcast needed or allowed.
-        grads=zero_grads,
-        head_grads=jax.tree.map(varying, zero_hgrads),
+        grads=jax.tree.map(lambda x: varying(jnp.zeros_like(x)), my_params),
+        head_grads=jax.tree.map(
+            lambda x: varying(jnp.zeros_like(x, dtype=jnp.float32)),
+            head_params,
+        ),
         loss=varying(jnp.float32(0.0)),
+        aux=varying(jnp.float32(0.0)),
         cot_out=varying(jnp.zeros((M, B, *feat), jnp.float32)),
     )
 
     last = Pd - 1
+
+    def key_for(m):
+        # Deterministic per (microbatch, stage): the backward recompute
+        # reproduces the forward's dropout masks exactly.
+        return (
+            jax.random.fold_in(jax.random.fold_in(rng, m), d)
+            if rng is not None
+            else None
+        )
+
+    def apply_stage(p, x, m):
+        if rng is None:
+            return stage_fn(p, x)
+        return stage_fn(p, x, key_for(m))
+
+    def apply_last(p, hp, x, yl, m):
+        if rng is None:
+            out = last_fn(p, hp, x, yl)
+        else:
+            out = last_fn(p, hp, x, yl, key_for(m))
+        return out if with_aux else (out, jnp.float32(0.0))
 
     def tick(carry, t):
         # Role this tick (mutually exclusive by parity — see module doc).
@@ -126,7 +157,7 @@ def _1f1b_local(
             # The last device's F output is never consumed (its B tick
             # recomputes through the vjp), so skip the stage math there.
             y = jnp.where(
-                d == last, jnp.zeros_like(x), stage_fn(my_params, x)
+                d == last, jnp.zeros_like(x), apply_stage(my_params, x, m_f)
             )
             return (
                 dict(c, ring=ring), y,
@@ -136,25 +167,26 @@ def _1f1b_local(
         def b_branch(c):
             x = lax.dynamic_index_in_dim(c["ring"], m_b % Pd, 0, False)
 
-            # Both vjps are computed under masks (lax.switch picks the
-            # branch; inside it, jnp.where picks which result is real) —
-            # only one runs per tick per device.
             def last_loss(p, hp, xx):
                 yl = lax.dynamic_index_in_dim(labels, m_b, 0, False)
-                return last_fn(p, hp, xx, yl)
+                return apply_last(p, hp, xx, yl, m_b)
 
             def mid_apply(p, xx):
-                return stage_fn(p, xx)
+                return apply_stage(p, xx, m_b)
 
             def do_last(_):
-                loss_m, vjp = jax.vjp(last_loss, my_params, head_params, x)
+                loss_m, vjp, aux_m = jax.vjp(
+                    last_loss, my_params, head_params, x, has_aux=True
+                )
                 gp, ghp, gx = vjp(jnp.ones_like(loss_m))
-                # f32 accumulators regardless of head param dtype (the
-                # head is already pp-varying, so its cotangent is too).
+                # f32 accumulators regardless of head param dtype.
                 ghp = jax.tree.map(lambda g: g.astype(jnp.float32), ghp)
                 return (
-                    loss_m.astype(jnp.float32), gp, ghp,
-                    gx.astype(jnp.float32),
+                    loss_m.astype(jnp.float32),
+                    # with_aux=False feeds a fresh (invariant) zero here;
+                    # match the other branch's varying type.
+                    varying(aux_m.astype(jnp.float32)),
+                    gp, ghp, gx.astype(jnp.float32),
                 )
 
             def do_mid(_):
@@ -163,27 +195,30 @@ def _1f1b_local(
                 # Fresh zeros are axis-invariant; the cond's other branch
                 # returns varying values — match the types explicitly.
                 return (
-                    varying(jnp.float32(0.0)), gp,
+                    varying(jnp.float32(0.0)), varying(jnp.float32(0.0)),
+                    gp,
                     jax.tree.map(
-                        lambda z: varying(jnp.zeros_like(z)), zero_hgrads
+                        lambda z: varying(jnp.zeros_like(z)),
+                        c["head_grads"],
                     ),
                     gx.astype(jnp.float32),
                 )
 
-            loss_m, gp, ghp, gx = lax.cond(d == last, do_last, do_mid, None)
+            loss_m, aux_m, gp, ghp, gx = lax.cond(
+                d == last, do_last, do_mid, None
+            )
             grads = jax.tree.map(jnp.add, c["grads"], gp)
             head_grads = jax.tree.map(jnp.add, c["head_grads"], ghp)
             # Device 0's input cotangent feeds the embedding backward.
             cot_out = jnp.where(
                 d == 0,
-                lax.dynamic_update_index_in_dim(
-                    c["cot_out"], gx, m_b, 0
-                ),
+                lax.dynamic_update_index_in_dim(c["cot_out"], gx, m_b, 0),
                 c["cot_out"],
             )
             return (
                 dict(c, grads=grads, head_grads=head_grads,
-                     loss=c["loss"] + loss_m, cot_out=cot_out),
+                     loss=c["loss"] + loss_m, aux=c["aux"] + aux_m,
+                     cot_out=cot_out),
                 varying(jnp.zeros((B, *feat), dtype)),
                 gx,
             )
@@ -211,14 +246,31 @@ def _1f1b_local(
 
     T = ticks_1f1b(M, Pd)
     carry, _ = lax.scan(tick, carry0, jnp.arange(T))
-    # Disjoint sums: loss/head_grads live on device P-1, cot_out on
-    # device 0; stage grads stay per-device (stacked over pp outside).
+    # Disjoint sums over pp (loss/aux/head_grads live on the last pp row,
+    # cot_out on row 0); means over any dp axes — the mean-loss convention
+    # (each dp slice computed its shard's mean loss).
     loss = lax.psum(carry["loss"], axis_name)
+    aux = lax.psum(carry["aux"], axis_name)
     head_grads = jax.tree.map(
         lambda g: lax.psum(g, axis_name), carry["head_grads"]
     )
+    stage_grads = carry["grads"]
+    for ax in varying_axes:
+        loss = lax.pmean(loss, ax)
+        aux = lax.pmean(aux, ax)
+        head_grads = jax.tree.map(lambda g: lax.pmean(g, ax), head_grads)
+        stage_grads = jax.tree.map(lambda g: lax.pmean(g, ax), stage_grads)
     cot_out = lax.psum(carry["cot_out"], axis_name)
-    stage_grads = jax.tree.map(lambda g: g[None], carry["grads"])
+    # The cotangents must match the mean-loss convention of the pmean-ed
+    # grads above: each dp slice computed the cotangent of ITS shard-mean
+    # loss, and the global loss is the pmean — scale by 1/dp so the
+    # caller's embedding vjp lands gradients on the same scale as the
+    # stage/head grads (they stay dp-sharded like the inputs).
+    for ax in varying_axes:
+        cot_out = cot_out / lax.axis_size(ax)
+    stage_grads = jax.tree.map(lambda g: g[None], stage_grads)
+    if with_aux:
+        return loss, aux, stage_grads, head_grads, cot_out
     return loss, stage_grads, head_grads, cot_out
 
 
@@ -231,40 +283,64 @@ def pipeline_1f1b_value_and_grad(
     labels,
     mesh: Mesh,
     axis_name: str = "pp",
+    rng=None,
+    with_aux: bool = False,
+    io_spec: P | None = None,
 ):
     """Run one 1F1B train-step evaluation over ``mesh[axis_name]``.
 
-    - ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape`` —
-      applied by devices ``0 .. P-2`` (and recomputed inside the last
-      device's vjp);
+    - ``stage_fn(stage_params, x) -> y`` (``stage_fn(p, x, key)`` when
+      ``rng`` is given) with ``y.shape == x.shape`` — applied by devices
+      ``0 .. P-2`` and recomputed inside the last device's vjp;
     - ``last_fn(stage_params, head_params, x, labels_mb) -> scalar loss``
-      — the last stage *including head and loss* for one microbatch;
+      (``(loss, aux_scalar)`` when ``with_aux``; extra ``key`` arg when
+      ``rng`` is given) — the last stage *including head and loss* for
+      one microbatch;
     - ``stacked_params``: PyTree with leading stage axis ``[P, ...]``
       (:func:`stack_stage_params`), sharded over ``axis_name``;
     - ``head_params``: replicated head/loss params;
-    - ``microbatches``: ``[M, B, ...]``; ``labels``: ``[M, ...]`` —
-      replicated (no dp support yet; wrap per dp slice if needed).
+    - ``microbatches``: ``[M, B, ...]``; ``labels``: ``[M, ...]``. By
+      default replicated; pass ``io_spec`` (e.g. ``P(None, "dp")``) to
+      shard the batch axis over dp — each dp slice runs its own pipe and
+      losses/grads are pmean-ed (mean-loss convention).
 
-    Returns ``(loss_sum, stage_grads, head_grads, input_cotangents)``:
-    the summed microbatch losses, gradients stacked ``[P, ...]`` over the
-    stage axis, head gradients, and ``[M, B, ...]`` cotangents of the
-    pipeline inputs (float32) for the caller's embedding backward.
-    Divide by ``M`` for means. Peak per-device activation residency is
-    O(P) microbatch states (ring buffer) — independent of M.
+    Returns ``(loss_sum[, aux_sum], stage_grads, head_grads,
+    input_cotangents)``: the summed microbatch losses (and auxes),
+    gradients stacked ``[P, ...]`` over the stage axis, head gradients,
+    and ``[M, B, ...]`` input cotangents (float32, sharded like the
+    inputs) for the caller's embedding backward. Divide by ``M`` for
+    means. Peak per-device activation residency is O(P) microbatch
+    states (ring buffer) — independent of M.
     """
     from jax import shard_map
 
+    if io_spec is None:
+        io_spec = P()
+    varying_axes = tuple(
+        ax
+        for entry in io_spec
+        if entry is not None
+        for ax in ((entry,) if isinstance(entry, str) else tuple(entry))
+        if ax != axis_name
+    )
     spec_p = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    fn = shard_map(
-        partial(_1f1b_local, stage_fn, last_fn, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(spec_p, P(), P(), P()),
-        out_specs=(
-            P(),
+    n_out = 5 if with_aux else 4
+    out_specs = (
+        (P(),) * (n_out - 3)
+        + (
             jax.tree.map(lambda _: P(axis_name), stacked_params),
             jax.tree.map(lambda _: P(), head_params),
-            P(),
+            io_spec,
+        )
+    )
+    fn = shard_map(
+        partial(
+            _1f1b_local, stage_fn, last_fn, axis_name=axis_name,
+            varying_axes=varying_axes, with_aux=with_aux,
         ),
+        mesh=mesh,
+        in_specs=(spec_p, P(), io_spec, io_spec, P()),
+        out_specs=out_specs,
     )
     lead = jax.tree.leaves(stacked_params)[0].shape[0]
     if lead != mesh.shape[axis_name]:
@@ -272,4 +348,4 @@ def pipeline_1f1b_value_and_grad(
             f"stacked params have {lead} stages but mesh {axis_name}="
             f"{mesh.shape[axis_name]} (1F1B is non-interleaved: V=1)"
         )
-    return fn(stacked_params, head_params, microbatches, labels)
+    return fn(stacked_params, head_params, microbatches, labels, rng)

@@ -104,8 +104,9 @@ class PipelineTrainer(Trainer):
         # PipeDream-flush/Megatron schedule (parallel/pipeline_1f1b.py) —
         # O(P) activation residency independent of num_microbatches
         # (measured ~19x less than gpipe plain, ~4x less than remat in
-        # BENCH_MODE=memory), at remat-equivalent compute. v1 limits:
-        # V=1, no dropout, no MoE, pp-only mesh, loss metric only.
+        # BENCH_MODE=memory), at remat-equivalent compute. Supports dp
+        # meshes, dropout, and the accuracy metric; limits: V=1, no
+        # MoE/ep.
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
@@ -191,6 +192,16 @@ class PipelineTrainer(Trainer):
 
         return jax.tree_util.tree_map_with_path(spec, stacked)
 
+    @staticmethod
+    def _head_logits(ln_final, head_params, x):
+        """Tied-embedding MLM head: LN -> x @ emb.T + bias. ONE definition,
+        shared by the gpipe forward and the 1f1b last stage, so the two
+        schedules' loss parity (tests/test_pipeline_1f1b.py) cannot drift."""
+        x = ln_final.apply({"params": head_params["ln_final"]}, x)
+        emb = head_params["token_embed"]["embedding"]
+        logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T
+        return logits + head_params["mlm_bias"]
+
     def _make_forward(self, mesh, per_stage: int, ep_size: int = 1,
                       stage_specs=None):
         from flax import linen as nn
@@ -266,9 +277,7 @@ class PipelineTrainer(Trainer):
                 y, aux_sum = y
                 aux = aux_sum / M  # per-microbatch means -> batch mean
             x = y.reshape(B, S, y.shape[-1])
-            x = ln_final.apply({"params": rest["ln_final"]}, x)
-            logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T
-            logits = logits + rest["mlm_bias"]
+            logits = self._head_logits(ln_final, rest, x)
             loss = loss_fn(logits, labels)
             metrics = {"loss": loss}
             if moe:
@@ -286,10 +295,15 @@ class PipelineTrainer(Trainer):
         """Train step on the hand-rolled 1F1B engine: embedding vjp outside
         the pipe, head + loss fused into the last stage (the engine needs
         each microbatch's cotangent right after its final forward), stage
-        grads from the scan, tied-embedding grads summed from both uses."""
+        grads from the scan, tied-embedding grads summed from both uses.
+        Dropout works (deterministic per-(microbatch, stage) keys — the
+        backward recompute reproduces the forward's masks); accuracy is
+        threaded through the engine's aux channel; microbatch IO shards
+        over dp when the mesh has one."""
         from flax import linen as nn
 
         from distkeras_tpu.models.bert import EncoderLayer
+        from distkeras_tpu.parallel.pipeline import _io_spec
         from distkeras_tpu.parallel.pipeline_1f1b import (
             pipeline_1f1b_value_and_grad,
         )
@@ -299,26 +313,52 @@ class PipelineTrainer(Trainer):
         ln_final = nn.LayerNorm(dtype=jnp.float32)
         loss_fn = get_loss(self.loss)
         M = self.num_microbatches
+        dropout = self._dropout
+        want_acc = "accuracy" in self.metrics
+        io_spec = _io_spec(mesh)
 
-        def stage_fn(stage_params, x):
+        def _apply_layers(stage_params, x, key):
             for j in range(per_stage):
-                x = layer_mod.apply({"params": stage_params[f"sub_{j}"]}, x)
+                rngs = (
+                    {"dropout": jax.random.fold_in(key, j)}
+                    if key is not None
+                    else None
+                )
+                x = layer_mod.apply(
+                    {"params": stage_params[f"sub_{j}"]}, x,
+                    train=dropout, rngs=rngs,
+                )
             return x
 
-        def last_fn(stage_params, head, x, labels_mb):
-            x = stage_fn(stage_params, x)
-            x = ln_final.apply({"params": head["ln_final"]}, x)
-            emb = head["token_embed"]["embedding"]
-            logits = x.astype(jnp.float32) @ emb.astype(jnp.float32).T
-            logits = logits + head["mlm_bias"]
+        if dropout:
+            def stage_fn(stage_params, x, key):
+                return _apply_layers(stage_params, x, key)
+        else:
+            def stage_fn(stage_params, x):
+                return _apply_layers(stage_params, x, None)
+
+        def _last(stage_params, head, x, labels_mb, key):
+            x = _apply_layers(stage_params, x, key)
+            logits = self._head_logits(ln_final, head, x)
             # Per-microbatch mean scaled by 1/M: the engine sums over
             # microbatches, so the total is the batch-mean loss and every
             # gradient it returns is already mean-scaled.
-            return loss_fn(logits, labels_mb) / M
+            loss = loss_fn(logits, labels_mb) / M
+            if want_acc:
+                from distkeras_tpu.ops.metrics import accuracy
+
+                return loss, accuracy(logits, labels_mb) / M
+            return loss
+
+        if dropout:
+            def last_fn(p, hp, x, y, key):
+                return _last(p, hp, x, y, key)
+        else:
+            def last_fn(p, hp, x, y):
+                return _last(p, hp, x, y, None)
 
         @jax.jit
         def step(train_params, opt_state, batch, rng):
-            del rng  # 1f1b v1: deterministic trunk (no dropout)
             rest = train_params["rest"]
             tokens = batch["features"].astype(jnp.int32)
             labels = batch["label"]
@@ -336,10 +376,15 @@ class PipelineTrainer(Trainer):
 
             mbs, embed_vjp = jax.vjp(embed_all, rest)
             labels_mb = labels.reshape(M, B // M, *labels.shape[1:])
-            loss, stage_grads, head_grads, cot = pipeline_1f1b_value_and_grad(
+            out = pipeline_1f1b_value_and_grad(
                 stage_fn, last_fn, train_params["stages"], rest, mbs,
-                labels_mb, mesh,
+                labels_mb, mesh, rng=rng if dropout else None,
+                with_aux=want_acc, io_spec=io_spec,
             )
+            if want_acc:
+                loss, acc, stage_grads, head_grads, cot = out
+            else:
+                loss, stage_grads, head_grads, cot = out
             (embed_grads,) = embed_vjp(cot.astype(mbs.dtype))
             # Tied embedding: head use (logits) + embed use sum; disjoint
             # leaves (pos_embed vs ln_final/mlm_bias) sum with zeros.
@@ -349,7 +394,10 @@ class PipelineTrainer(Trainer):
             grads = {"stages": stage_grads, "rest": rest_grads}
             updates, new_opt = optimizer.update(grads, opt_state, train_params)
             new_params = optax.apply_updates(train_params, updates)
-            return new_params, new_opt, {"loss": loss}
+            metrics = {"loss": loss}
+            if want_acc:
+                metrics["accuracy"] = acc
+            return new_params, new_opt, metrics
 
         return step
 
@@ -363,10 +411,6 @@ class PipelineTrainer(Trainer):
             pp = self.num_stages or len(devices)
             ep = self.ep or 1
             dp = len(devices) // (pp * ep)
-            if self.schedule == "1f1b":
-                # 1f1b v1 is pp-only: don't auto-fold spare devices into a
-                # dp axis the schedule would then reject.
-                dp = min(dp, 1)
             if dp < 1:
                 raise ValueError(
                     f"num_stages {pp} x ep {ep} > {len(devices)} attached "
@@ -417,27 +461,26 @@ class PipelineTrainer(Trainer):
             unsupported = []
             if self.virtual_stages != 1:
                 unsupported.append("virtual_stages > 1")
-            if self._dropout:
-                unsupported.append("dropout")
             if self._moe:
                 unsupported.append("MoE")
-            if dict(mesh.shape).get("dp", 1) > 1 or ep_size > 1:
-                unsupported.append("dp/ep mesh axes")
+            if ep_size > 1:
+                unsupported.append("the ep mesh axis")
             if unsupported:
                 raise ValueError(
                     "schedule='1f1b' does not support: "
                     + ", ".join(unsupported)
                     + " (use the gpipe schedule, or remat for memory)"
                 )
-            extra_metrics = [m for m in self.metrics if m != "loss"]
+            extra_metrics = [
+                m for m in self.metrics if m not in ("loss", "accuracy")
+            ]
             if extra_metrics:
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "schedule='1f1b' records only the loss; requested "
-                    "metrics %s will be absent from the history (the "
-                    "hand-rolled backward never materializes full-batch "
-                    "logits)", extra_metrics,
+                    "schedule='1f1b' records loss and accuracy only; "
+                    "requested metrics %s will be absent from the history",
+                    extra_metrics,
                 )
             step = self._make_1f1b_step(mesh, per_stage, optimizer)
         else:
