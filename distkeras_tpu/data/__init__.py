@@ -1,4 +1,5 @@
 from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.data.sparse import SparseColumn
 from distkeras_tpu.data.feed import DeviceFeed, minibatches
 from distkeras_tpu.data.transformers import (
     DenseTransformer,
@@ -18,5 +19,6 @@ __all__ = [
     "MinMaxTransformer",
     "ReshapeTransformer",
     "DenseTransformer",
+    "SparseColumn",
     "LabelIndexTransformer",
 ]

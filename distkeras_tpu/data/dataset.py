@@ -29,10 +29,15 @@ class Dataset:
     """
 
     def __init__(self, columns: Mapping[str, np.ndarray]):
+        from distkeras_tpu.data.sparse import SparseColumn
+
         if not columns:
             raise ValueError("Dataset requires at least one column")
+        # SparseColumn stays sparse (row ops keep CSR form; np.asarray
+        # densifies on demand) — everything else materializes as ndarray.
         self._columns: dict[str, np.ndarray] = {
-            k: np.asarray(v) for k, v in columns.items()
+            k: (v if isinstance(v, SparseColumn) else np.asarray(v))
+            for k, v in columns.items()
         }
         lengths = {k: v.shape[0] for k, v in self._columns.items()}
         if len(set(lengths.values())) != 1:
@@ -106,13 +111,42 @@ class Dataset:
     @classmethod
     def from_npz(cls, path: str) -> "Dataset":
         """Load a dataset saved with :meth:`to_npz` (or any npz whose arrays
-        share a leading row dimension)."""
+        share a leading row dimension). Sparse columns round-trip in CSR
+        form (saved as ``name__csr_*`` component arrays)."""
+        from distkeras_tpu.data.sparse import SparseColumn
+
         with np.load(path) as d:
-            return cls({k: d[k] for k in d.files})
+            cols: dict = {}
+            for k in d.files:
+                if "__csr_" in k:
+                    base, part = k.split("__csr_", 1)
+                    if part == "indptr":
+                        cols[base] = SparseColumn(
+                            d[f"{base}__csr_indptr"],
+                            d[f"{base}__csr_indices"],
+                            d[f"{base}__csr_values"],
+                            int(d[f"{base}__csr_dim"]),
+                        )
+                else:
+                    cols[k] = d[k]
+            return cls(cols)
 
     def to_npz(self, path: str, compressed: bool = False) -> None:
+        from distkeras_tpu.data.sparse import SparseColumn
+
         save = np.savez_compressed if compressed else np.savez
-        save(path, **self._columns)
+        arrays: dict = {}
+        for k, v in self._columns.items():
+            if isinstance(v, SparseColumn):
+                # Persist CSR components — never the densified matrix
+                # (densifying on save would defeat the type's purpose).
+                arrays[f"{k}__csr_indptr"] = v.indptr
+                arrays[f"{k}__csr_indices"] = v.indices
+                arrays[f"{k}__csr_values"] = v.values
+                arrays[f"{k}__csr_dim"] = np.int64(v.dim)
+            else:
+                arrays[k] = v
+        save(path, **arrays)
 
     # -- basic accessors ----------------------------------------------------
 
@@ -145,9 +179,10 @@ class Dataset:
 
     def with_column(self, name: str, values: np.ndarray) -> "Dataset":
         """Return a new Dataset with ``name`` added/replaced (the analogue of
-        Spark's ``withColumn`` used throughout the reference transformers)."""
+        Spark's ``withColumn`` used throughout the reference transformers).
+        Sparse columns are preserved (the constructor's coercion rule)."""
         cols = dict(self._columns)
-        cols[name] = np.asarray(values)
+        cols[name] = values
         return Dataset(cols)
 
     def select(self, *names: str) -> "Dataset":
@@ -166,8 +201,14 @@ class Dataset:
         from distkeras_tpu.data import native
 
         def _one(v: np.ndarray) -> np.ndarray:
-            # Native memcpy gather for the float32 hot path; numpy otherwise.
-            if native.available() and v.dtype == np.float32 and v.flags["C_CONTIGUOUS"]:
+            # Native memcpy gather for the float32 hot path; numpy (and
+            # the CSR row-gather for sparse columns) otherwise.
+            if (
+                native.available()
+                and isinstance(v, np.ndarray)
+                and v.dtype == np.float32
+                and v.flags["C_CONTIGUOUS"]
+            ):
                 return native.gather_rows(v, indices)
             return v[indices]
 
@@ -178,13 +219,32 @@ class Dataset:
         perm = np.random.default_rng(seed).permutation(self._num_rows)
         return self.gather(perm)
 
+    @staticmethod
+    def _cat(parts):
+        from distkeras_tpu.data.sparse import SparseColumn
+
+        if any(isinstance(p, SparseColumn) for p in parts):
+            # Mixed sparse/dense concat: sparse wins (sparsifying the
+            # dense minority costs O(nnz); densifying the sparse majority
+            # could OOM) — order-independent by construction.
+            sparse = [
+                p if isinstance(p, SparseColumn)
+                else SparseColumn.from_dense(np.asarray(p))
+                for p in parts
+            ]
+            out = sparse[0]
+            for p in sparse[1:]:
+                out = out.concat(p)
+            return out
+        return np.concatenate(parts)
+
     def repeat(self, n: int) -> "Dataset":
-        return Dataset({k: np.concatenate([v] * n) for k, v in self._columns.items()})
+        return Dataset({k: self._cat([v] * n) for k, v in self._columns.items()})
 
     def concat(self, other: "Dataset") -> "Dataset":
         return Dataset(
             {
-                k: np.concatenate([v, other._columns[k]])
+                k: self._cat([v, other._columns[k]])
                 for k, v in self._columns.items()
             }
         )
@@ -214,8 +274,28 @@ class Dataset:
     def describe(self) -> dict[str, dict[str, float]]:
         """Per-column summary stats for numeric columns (notebook aid)."""
         out: dict[str, dict[str, float]] = {}
+        from distkeras_tpu.data.sparse import SparseColumn
+
         for name, col in self._columns.items():
             if not np.issubdtype(col.dtype, np.number):
+                continue
+            if isinstance(col, SparseColumn):
+                # Stats straight from CSR (the implicit zeros included) —
+                # no densification.
+                n_total = col.shape[0] * col.dim
+                v = col.values.astype(np.float64)
+                total = float(v.sum())
+                mean = total / n_total
+                var = (float((v * v).sum()) - n_total * mean * mean) / n_total
+                has_zero = col.nnz < n_total
+                vmin = float(v.min()) if col.nnz else 0.0
+                vmax = float(v.max()) if col.nnz else 0.0
+                out[name] = {
+                    "min": min(0.0, vmin) if has_zero else vmin,
+                    "max": max(0.0, vmax) if has_zero else vmax,
+                    "mean": mean,
+                    "std": float(np.sqrt(max(0.0, var))),
+                }
                 continue
             c = col.astype(np.float64)
             out[name] = {

@@ -175,12 +175,15 @@ class ReshapeTransformer(Transformer):
 
 
 class DenseTransformer(Transformer):
-    """Ensure a column is a dense float array.
+    """Densify a sparse feature column.
 
     Reference: ``distkeras/transformers.py`` § ``DenseTransformer`` converts
-    Spark sparse vectors to dense. Without Spark the densification collapses
-    to materializing a contiguous float32 ndarray; (indices, values, size)
-    triples from a COO-style column pair are also supported.
+    Spark MLlib SparseVector columns to dense ones. The native sparse type
+    here is :class:`distkeras_tpu.data.sparse.SparseColumn` (CSR; produced
+    by ``SparseColumn.from_rows`` from the reference's per-row
+    ``(indices, values)`` + ``size`` form); this transformer materializes it
+    as a contiguous float32 ``[N, dim]`` ndarray. Dense inputs pass through
+    with the same dtype/contiguity guarantee.
     """
 
     def __init__(self, input_col: str = "features", output_col: str = "features_dense"):
@@ -188,7 +191,8 @@ class DenseTransformer(Transformer):
         self.output_col = output_col
 
     def transform(self, dataset: Dataset) -> Dataset:
-        x = np.ascontiguousarray(np.asarray(dataset[self.input_col], dtype=np.float32))
+        col = dataset[self.input_col]
+        x = np.ascontiguousarray(np.asarray(col, dtype=np.float32))
         return dataset.with_column(self.output_col, x)
 
 

@@ -172,9 +172,13 @@ def _pipeline_local(
         if rng is None:
             y = stage_fn(my_params, state)
         else:
-            # unique stream per (tick, device): stochastic layers (dropout)
-            # get fresh masks for every stage application of every microbatch
+            # unique stream per (tick, device, dp-slice): stochastic layers
+            # (dropout) get fresh masks for every stage application of
+            # every microbatch — including across dp replicas, whose data
+            # shards differ and must not share masks
             key = jax.random.fold_in(jax.random.fold_in(rng, t), d)
+            for _ax in varying_axes:
+                key = jax.random.fold_in(key, lax.axis_index(_ax))
             y = stage_fn(my_params, state, key)
         if with_aux:
             y, aux = y

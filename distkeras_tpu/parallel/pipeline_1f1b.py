@@ -35,10 +35,11 @@ loss) with cotangent seed 1. The pipeline input's cotangent is emitted
 per microbatch so the caller can backpropagate into the embedding that
 produced the microbatches.
 
-**Stochastic layers.** With ``rng``, each stage application receives the
-key ``fold_in(fold_in(rng, m), stage)`` — a deterministic function of
-(microbatch, stage), so the backward tick's recompute reproduces the
-forward tick's dropout masks exactly.
+**Stochastic layers.** With ``rng``, each stage application receives a
+key folded from (microbatch, stage, dp-slice) — deterministic, so the
+backward tick's recompute reproduces the forward tick's dropout masks
+exactly, and distinct across dp replicas so different data shards never
+share masks.
 
 **Data parallelism.** Pass ``io_spec`` (e.g. ``P(None, "dp")``) to shard
 the microbatch batch axis: each dp slice runs its own 1F1B pipe; losses,
@@ -122,13 +123,15 @@ def _1f1b_local(
     last = Pd - 1
 
     def key_for(m):
-        # Deterministic per (microbatch, stage): the backward recompute
-        # reproduces the forward's dropout masks exactly.
-        return (
-            jax.random.fold_in(jax.random.fold_in(rng, m), d)
-            if rng is not None
-            else None
-        )
+        # Deterministic per (microbatch, stage, dp-slice): the backward
+        # recompute reproduces the forward's dropout masks exactly, and dp
+        # replicas (different data shards) get independent masks.
+        if rng is None:
+            return None
+        key = jax.random.fold_in(jax.random.fold_in(rng, m), d)
+        for ax in varying_axes:
+            key = jax.random.fold_in(key, lax.axis_index(ax))
+        return key
 
     def apply_stage(p, x, m):
         if rng is None:
