@@ -116,11 +116,22 @@ class Dataset:
         from distkeras_tpu.data.sparse import SparseColumn
 
         with np.load(path) as d:
+            # A base is CSR only when its full component quadruple exists;
+            # anything else (including names that merely contain
+            # "__csr_") loads as a plain column.
+            comp = ("indptr", "indices", "values", "dim")
+            bases = {
+                k[: -len("__csr_indptr")]
+                for k in d.files
+                if k.endswith("__csr_indptr")
+                and all(f"{k[: -len('__csr_indptr')]}__csr_{c}" in d.files
+                        for c in comp)
+            }
             cols: dict = {}
             for k in d.files:
-                if "__csr_" in k:
-                    base, part = k.split("__csr_", 1)
-                    if part == "indptr":
+                base = k.split("__csr_", 1)[0] if "__csr_" in k else None
+                if base in bases:
+                    if k.endswith("__csr_indptr"):
                         cols[base] = SparseColumn(
                             d[f"{base}__csr_indptr"],
                             d[f"{base}__csr_indices"],
@@ -137,6 +148,11 @@ class Dataset:
         save = np.savez_compressed if compressed else np.savez
         arrays: dict = {}
         for k, v in self._columns.items():
+            if "__csr_" in k and not isinstance(v, SparseColumn):
+                raise ValueError(
+                    f"column name {k!r} collides with the reserved "
+                    "'__csr_' suffix scheme used for sparse persistence"
+                )
             if isinstance(v, SparseColumn):
                 # Persist CSR components — never the densified matrix
                 # (densifying on save would defeat the type's purpose).
@@ -226,16 +242,12 @@ class Dataset:
         if any(isinstance(p, SparseColumn) for p in parts):
             # Mixed sparse/dense concat: sparse wins (sparsifying the
             # dense minority costs O(nnz); densifying the sparse majority
-            # could OOM) — order-independent by construction.
-            sparse = [
+            # could OOM) — order-independent, single pass (no O(n²) fold).
+            return SparseColumn.concat_all([
                 p if isinstance(p, SparseColumn)
                 else SparseColumn.from_dense(np.asarray(p))
                 for p in parts
-            ]
-            out = sparse[0]
-            for p in sparse[1:]:
-                out = out.concat(p)
-            return out
+            ])
         return np.concatenate(parts)
 
     def repeat(self, n: int) -> "Dataset":

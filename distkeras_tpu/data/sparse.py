@@ -136,8 +136,13 @@ class SparseColumn:
         ``SparseColumn`` — the shuffle/gather/partition paths never
         densify."""
         if isinstance(key, (int, np.integer)):
+            i = int(key)
+            if i < 0:
+                i += len(self)  # numpy-parity negative indexing
+            if not 0 <= i < len(self):
+                raise IndexError(f"row {key} out of range for {len(self)} rows")
             row = np.zeros(self.dim, self.values.dtype)
-            s, e = int(self.indptr[key]), int(self.indptr[int(key) + 1])
+            s, e = int(self.indptr[i]), int(self.indptr[i + 1])
             row[self.indices[s:e]] = self.values[s:e]
             return row
         if isinstance(key, slice):
@@ -163,13 +168,25 @@ class SparseColumn:
         )
 
     def concat(self, other: "SparseColumn") -> "SparseColumn":
-        if self.dim != other.dim:
-            raise ValueError(f"dim mismatch: {self.dim} vs {other.dim}")
+        return SparseColumn.concat_all([self, other])
+
+    @staticmethod
+    def concat_all(parts: Sequence["SparseColumn"]) -> "SparseColumn":
+        """Concatenate many columns in ONE pass (a pairwise fold would
+        re-copy the accumulated nnz arrays per step — O(n²) for repeat)."""
+        dims = {p.dim for p in parts}
+        if len(dims) != 1:
+            raise ValueError(f"dim mismatch: {sorted(dims)}")
+        offsets = np.cumsum([0] + [p.nnz for p in parts[:-1]])
+        indptr = np.concatenate(
+            [parts[0].indptr]
+            + [p.indptr[1:] + off for p, off in zip(parts[1:], offsets[1:])]
+        )
         return SparseColumn(
-            np.concatenate([self.indptr, self.indptr[-1] + other.indptr[1:]]),
-            np.concatenate([self.indices, other.indices]),
-            np.concatenate([self.values, other.values]),
-            self.dim,
+            indptr,
+            np.concatenate([p.indices for p in parts]),
+            np.concatenate([p.values for p in parts]),
+            parts[0].dim,
         )
 
     def __repr__(self) -> str:

@@ -24,6 +24,8 @@ Embedding-level dropout stays off (the embed/head run outside the pipe).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -357,7 +359,10 @@ class PipelineTrainer(Trainer):
             def last_fn(p, hp, x, y):
                 return _last(p, hp, x, y, None)
 
-        @jax.jit
+        # Donate params+opt: the pipelined step updates them in place
+        # (halves their transient HBM during the update; the trainer only
+        # ever uses the returned values).
+        @partial(jax.jit, donate_argnums=(0, 1))
         def step(train_params, opt_state, batch, rng):
             rest = train_params["rest"]
             tokens = batch["features"].astype(jnp.int32)
@@ -488,7 +493,7 @@ class PipelineTrainer(Trainer):
                 mesh, per_stage, ep_size=ep_size, stage_specs=stage_specs
             )
 
-            @jax.jit
+            @partial(jax.jit, donate_argnums=(0, 1))
             def step(train_params, opt_state, batch, rng):
                 (_, metrics), grads = jax.value_and_grad(forward, has_aux=True)(
                     train_params, batch, rng

@@ -24,10 +24,18 @@ def main():
     ap.add_argument("--stages", type=int, default=2)
     ap.add_argument("--virtual-stages", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+                    help="1f1b: hand-rolled schedule, O(P) activation "
+                         "residency independent of microbatch count "
+                         "(requires --virtual-stages 1)")
     from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
     add_platform_flag(ap)
     args = ap.parse_args()
     apply_platform_args(args)
+
+    if args.schedule == "1f1b" and args.virtual_stages != 1:
+        print("note: 1f1b is non-interleaved; forcing --virtual-stages 1")
+        args.virtual_stages = 1
 
     import distkeras_tpu as dk
     from distkeras_tpu.models.bert import BertConfig, _make
@@ -50,13 +58,13 @@ def main():
         model, worker_optimizer="adam", learning_rate=3e-3,
         num_stages=args.stages, virtual_stages=args.virtual_stages,
         num_microbatches=4, batch_size=args.batch_size,
-        num_epoch=args.epochs, seed=0,
+        num_epoch=args.epochs, seed=0, schedule=args.schedule,
     )
     t0 = time.time()
     trained = trainer.train(ds, shuffle=True)
     hist = trainer.get_history()
     print(
-        f"pp={args.stages} V={args.virtual_stages}: loss "
+        f"pp={args.stages} V={args.virtual_stages} {args.schedule}: loss "
         f"{hist[0]['loss']:.3f} -> {hist[-1]['loss']:.3f} "
         f"({len(hist)} steps, {time.time()-t0:.1f}s)"
     )

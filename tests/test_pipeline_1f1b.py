@@ -112,6 +112,7 @@ def test_1f1b_rejects_wrong_stage_count():
         )
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_1f1b_matches_gpipe():
     """schedule='1f1b' trains the same math as the scanned gpipe schedule:
     identical model/data/seed produce matching loss trajectories (both are
@@ -173,6 +174,7 @@ def test_pipeline_trainer_1f1b_rejects_unsupported():
         )
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_1f1b_dp_dropout_accuracy():
     """The lifted v1 limits together: dp x pp mesh (auto-built from 8
     devices), dropout on (deterministic per-(m, stage) keys), accuracy
@@ -199,6 +201,7 @@ def test_pipeline_trainer_1f1b_dp_dropout_accuracy():
     assert h[-1]["accuracy"] > h[0]["accuracy"]
 
 
+@pytest.mark.slow
 def test_1f1b_dp_parity_with_gpipe():
     """dp x pp 1F1B must produce the same training trajectory as the
     gpipe schedule on the same mesh — this pins the dp gradient-scaling

@@ -157,3 +157,21 @@ def test_sparse_dataset_full_surface():
     )
     with pytest.raises(IndexError):
         sp[np.array([99])]
+
+
+def test_sparse_scalar_negative_index_and_npz_collision_guard():
+    import os
+    import tempfile
+
+    dense, sp = _random_sparse(n=6, dim=4, seed=8)
+    np.testing.assert_array_equal(sp[-1], dense[-1])
+    np.testing.assert_array_equal(sp[-2], dense[-2])
+    with pytest.raises(IndexError):
+        sp[6]
+    with pytest.raises(IndexError):
+        sp[-7]
+    # reserved-suffix collision is rejected at save time, not lost silently
+    ds = dk.Dataset.from_arrays(x__csr_mask=dense)
+    with tempfile.TemporaryDirectory() as td:
+        with pytest.raises(ValueError, match="__csr_"):
+            ds.to_npz(os.path.join(td, "bad.npz"))
