@@ -118,18 +118,27 @@ class Dataset:
         with np.load(path) as d:
             # A base is CSR only when its full component quadruple exists;
             # anything else (including names that merely contain
-            # "__csr_") loads as a plain column.
+            # "__csr_") loads as a plain column. Bases are derived by
+            # stripping the FINAL "__csr_<component>" suffix, so a column
+            # whose own name contains "__csr_" still round-trips.
             comp = ("indptr", "indices", "values", "dim")
+
+            def strip(k):
+                for c in comp:
+                    suf = f"__csr_{c}"
+                    if k.endswith(suf):
+                        return k[: -len(suf)]
+                return None
+
             bases = {
-                k[: -len("__csr_indptr")]
-                for k in d.files
-                if k.endswith("__csr_indptr")
-                and all(f"{k[: -len('__csr_indptr')]}__csr_{c}" in d.files
-                        for c in comp)
+                b
+                for b in (strip(k) for k in d.files)
+                if b is not None
+                and all(f"{b}__csr_{c}" in d.files for c in comp)
             }
             cols: dict = {}
             for k in d.files:
-                base = k.split("__csr_", 1)[0] if "__csr_" in k else None
+                base = strip(k)
                 if base in bases:
                     if k.endswith("__csr_indptr"):
                         cols[base] = SparseColumn(

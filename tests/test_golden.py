@@ -88,7 +88,8 @@ def test_golden_pipeline_trainer():
     x = rng.integers(0, 32, size=(64, 8)).astype(np.int32)
     ds = dk.Dataset.from_arrays(features=x, label=x.copy())
     cfg = BertConfig(vocab_size=32, hidden_size=16, num_layers=2,
-                     num_heads=2, mlp_dim=32, max_seq_len=8)
+                     num_heads=2, mlp_dim=32, max_seq_len=8,
+                     dropout_rate=0.0)
     t = dk.PipelineTrainer(
         _make(cfg, 8, "golden_pipe"), worker_optimizer="adam",
         learning_rate=3e-3, num_stages=2, num_microbatches=2,
@@ -96,5 +97,30 @@ def test_golden_pipeline_trainer():
     )
     t.train(ds, shuffle=True)
     hist = t.get_history()
-    # recorded 2026-07-29 (jax 0.9.0, 8-device CPU mesh)
-    assert hist[-1]["loss"] == pytest.approx(3.2478456, rel=0.01)
+    # recorded 2026-07-30, dropout pinned off (jax 0.9.0, 8-dev CPU mesh)
+    assert hist[-1]["loss"] == pytest.approx(3.2230043, rel=0.01)
+
+
+def test_golden_pipeline_1f1b_matches_gpipe_pin():
+    """1F1B family pin: the hand-rolled backward must keep reproducing the
+    gpipe golden trajectory (same model/data/seed as the pipeline pin)."""
+    from distkeras_tpu.models.bert import BertConfig, _make
+
+    rng = np.random.default_rng(1234)
+    x = rng.integers(0, 32, size=(64, 8)).astype(np.int32)
+    ds = dk.Dataset.from_arrays(features=x, label=x.copy())
+    cfg = BertConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                     num_heads=2, mlp_dim=32, max_seq_len=8,
+                     dropout_rate=0.0)
+    t = dk.PipelineTrainer(
+        _make(cfg, 8, "golden_1f1b"), worker_optimizer="adam",
+        learning_rate=3e-3, num_stages=2, num_microbatches=2,
+        batch_size=16, num_epoch=3, seed=7, schedule="1f1b",
+    )
+    t.train(ds, shuffle=True)
+    hist = t.get_history()
+    # recorded 2026-07-30, dropout pinned off (jax 0.9.0, 8-dev CPU mesh):
+    # 3.2233820 vs the gpipe pin 3.2230043 — identical math through a
+    # different schedule, 0.012% apart (bf16-free f32 reduction-order
+    # effects only)
+    assert hist[-1]["loss"] == pytest.approx(3.2233820, rel=0.01)

@@ -175,3 +175,19 @@ def test_sparse_scalar_negative_index_and_npz_collision_guard():
     with tempfile.TemporaryDirectory() as td:
         with pytest.raises(ValueError, match="__csr_"):
             ds.to_npz(os.path.join(td, "bad.npz"))
+
+
+def test_sparse_npz_roundtrip_with_csr_in_name():
+    """A SparseColumn whose own name contains '__csr_' must round-trip
+    (base derivation strips the FINAL component suffix)."""
+    import os
+    import tempfile
+
+    dense, sp = _random_sparse(n=5, dim=3, seed=2)
+    ds = dk.Dataset.from_arrays(**{"a__csr_b": sp})
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "weird.npz")
+        ds.to_npz(p)
+        back = dk.Dataset.from_npz(p)
+        assert isinstance(back["a__csr_b"], SparseColumn)
+        np.testing.assert_array_equal(np.asarray(back["a__csr_b"]), dense)
