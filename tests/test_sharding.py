@@ -47,6 +47,7 @@ def test_data_parallel_shardings_split_batch():
     assert {s.data.shape for s in w.addressable_shards} == {(4, 4)}
 
 
+@pytest.mark.slow
 def test_gspmd_bert_params_tp_sharded():
     from distkeras_tpu.models.bert import bert_tiny_mlm
     from distkeras_tpu.ops.losses import get_optimizer

@@ -55,7 +55,7 @@ def test_single_trainer_multiclass(toy_multiclass):
     "cls,kwargs",
     [
         (dk.DOWNPOUR, dict(communication_window=4)),
-        (dk.ADAG, dict(communication_window=4)),
+        pytest.param(dk.ADAG, dict(communication_window=4), marks=pytest.mark.slow),
         pytest.param(dk.AEASGD, dict(communication_window=4, rho=2.0, learning_rate=0.05), marks=pytest.mark.slow),
         pytest.param(dk.EAMSGD, dict(communication_window=4, rho=2.0, learning_rate=0.05, momentum=0.8), marks=pytest.mark.slow),
         pytest.param(dk.DynSGD, dict(communication_window=4), marks=pytest.mark.slow),

@@ -26,7 +26,11 @@ def test_ulysses_matches_dense(rng, causal):
                                atol=2e-3, rtol=2e-3)
 
 
-@pytest.mark.parametrize("causal", [False, pytest.param(True, marks=pytest.mark.slow)])
+@pytest.mark.parametrize(
+    "causal",
+    [pytest.param(False, marks=pytest.mark.slow),
+     pytest.param(True, marks=pytest.mark.slow)],
+)
 def test_ulysses_gradients_match_dense(rng, causal):
     q, k, v = _qkv(rng, B=1, S=32, H=8, D=8)
     mesh = make_mesh({"sp": 8})
