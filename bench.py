@@ -278,6 +278,76 @@ def _model_and_batch(kind: str, batch: int):
     raise SystemExit(f"unknown BENCH_MODEL {kind!r}")
 
 
+def _config_key(metric: str, batch: int, on_cpu: bool) -> str:
+    return f"{metric}/batch{batch}/{'cpu' if on_cpu else 'tpu'}"
+
+
+def _previous_same_config(metric: str, batch: int, on_cpu: bool):
+    """Most recent recorded same-config measurement, for the drift gate
+    (VERDICT r4 weak #1: the r03->r04 CPU regression slid through with
+    ``vs_baseline: null``). Driver round artifacts (``BENCH_r*.json``,
+    authoritative, committed) win; ``bench_history.json`` (updated by
+    every measurement run, covers watcher-ladder configs the driver never
+    runs) is the fallback. Returns ``(value, source)`` or ``(None, None)``."""
+    import glob
+    import re
+
+    best = None  # (round_no, value, source)
+    for path in glob.glob(os.path.join(HERE, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            continue
+        det = rec.get("detail") or {}
+        if det.get("infrastructure_failure"):
+            continue
+        if rec.get("metric") != metric or det.get("batch_size") != batch:
+            continue
+        if ("CPU" in str(det.get("device", "")).upper()) != on_cpu:
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best[0]:
+            best = (rnd, float(rec["value"]), os.path.basename(path))
+    if best is not None:
+        return best[1], best[2]
+    try:
+        with open(os.path.join(HERE, "bench_history.json")) as f:
+            hist = json.load(f)
+        entry = hist.get(_config_key(metric, batch, on_cpu))
+        if entry:
+            return float(entry["value"]), "bench_history.json"
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None, None
+
+
+def _record_history(metric: str, batch: int, on_cpu: bool, value: float) -> None:
+    path = os.path.join(HERE, "bench_history.json")
+    try:
+        with open(path) as f:
+            hist = json.load(f)
+    except (OSError, ValueError):
+        hist = {}
+    hist[_config_key(metric, batch, on_cpu)] = {
+        "value": value, "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        # Write-then-rename: the parent kills this child on its deadline,
+        # and a kill landing mid-dump must not truncate the history (the
+        # next run would silently reset it and lose every drift baseline).
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(hist, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _measure() -> None:
     kind = os.environ.get("BENCH_MODEL", "bert")
     platform = os.environ.get("BENCH_PLATFORM", "tpu")
@@ -343,15 +413,43 @@ def _measure() -> None:
     flops_agreement = (
         round(xla_flops / hand_flops, 3) if (xla_flops and hand_flops) else None
     )
+    metric = f"{model.name}_train_samples_per_sec_per_chip"
+    on_cpu = platform == "cpu"
+    forced = bool(os.environ.get("BENCH_FORCE_CPU"))
+    # vs_baseline: on TPU, achieved-MFU / the 0.35 north star. On CPU
+    # (where MFU vs a TPU peak is meaningless) it gates DRIFT instead:
+    # the ratio against the last recorded same-config CPU row, so a
+    # regression on the one surface that IS measurable every round can't
+    # land silently (VERDICT r4 weak #1).
+    prev_value, prev_source = (None, None)
+    if on_cpu:
+        prev_value, prev_source = _previous_same_config(metric, batch, True)
+    if not on_cpu:
+        vs_baseline = round(mfu / 0.35, 4) if mfu else None
+        vs_kind = "mfu_over_north_star" if mfu else "mfu_unavailable"
+    elif prev_value is not None and prev_value > 0:
+        vs_baseline = round(sps / prev_value, 4)
+        vs_kind = "cpu_drift_vs_last_recorded"
+    else:
+        vs_baseline = None
+        vs_kind = ("prior_row_unusable" if prev_source is not None
+                   else "no_prior_same_config_row")
+    _record_history(metric, batch, on_cpu, round(sps, 2))
     print(json.dumps({
-        "metric": f"{model.name}_train_samples_per_sec_per_chip",
+        "metric": metric,
         "value": round(sps, 2),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(mfu / 0.35, 4) if mfu else None,
+        "vs_baseline": vs_baseline,
         "detail": {
             "mfu": round(mfu, 4),
-            "tpu_unavailable": platform == "cpu"
-                               and not os.environ.get("BENCH_FORCE_CPU"),
+            # Truthful labelling (VERDICT r4 weak #6): under BENCH_FORCE_CPU
+            # the chip was never probed, so its availability is UNKNOWN —
+            # null, never false. A grep for healthy-TPU rows keys on
+            # tpu_unavailable == false AND forced_cpu == false.
+            "tpu_unavailable": None if (on_cpu and forced) else on_cpu,
+            "forced_cpu": forced,
+            "vs_baseline_kind": vs_kind,
+            "baseline_source": prev_source,
             "model": model.name,
             "batch_size": batch,
             "step_time_mean_s": round(summary["step_time_mean_s"], 5),

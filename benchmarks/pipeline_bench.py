@@ -231,10 +231,13 @@ def memory_mode():
         "measured_temp_mb": round(ma.temp_size_in_bytes / 2**20, 2),
         "args_mb": round(ma.argument_size_in_bytes / 2**20, 2),
         "ticks": ticks_1f1b(M, P),
-        # The ring holds <= P in-flight microbatch inputs per device,
-        # independent of M — the bound the scanned schedules can't reach.
+        # The ring holds <= P in-flight microbatch inputs per device; the
+        # carry also holds ONE M-sized f32 input-cotangent buffer
+        # (cot_out), so the floor is (min(P, M) + M) states — linear in M
+        # with a far smaller constant than the scanned schedules' per-tick
+        # saves (ticks ~ 2M states each, times stage internals).
         "analytic_saved_state_mb": round(
-            min(P, M) * state_bytes / 2**20, 2
+            (min(P, M) + M) * state_bytes / 2**20, 2
         ),
     }
 

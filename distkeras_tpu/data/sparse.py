@@ -150,6 +150,15 @@ class SparseColumn:
         key = np.asarray(key)
         if key.ndim != 1:
             raise TypeError("SparseColumn supports 1-D row selection only")
+        if key.dtype == np.bool_:
+            # ndarray parity for boolean masks: length must match, then the
+            # mask selects rows (the arithmetic below needs integer rows —
+            # a raw bool mask would index the length-(N+1) indptr wrongly).
+            if key.size != len(self):
+                raise IndexError(
+                    f"boolean mask length {key.size} != {len(self)} rows"
+                )
+            key = np.flatnonzero(key)
         key = np.where(key < 0, key + len(self), key)  # ndarray parity
         if key.size and (key.min() < 0 or key.max() >= len(self)):
             raise IndexError(f"row index out of range for {len(self)} rows")

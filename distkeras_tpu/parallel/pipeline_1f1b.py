@@ -22,10 +22,19 @@ F/B in steady state, neighbouring devices are phase-shifted by one tick
 backward has not run yet — at most ``ceil((2P - 1 - 2d) / 2) <= P`` of
 them, held in a ring buffer — and recomputes the stage forward inside
 ``jax.vjp`` at the backward tick (Megatron-style activation
-recomputation). Peak residency is therefore O(P) microbatch states per
-device **independent of M**, vs the scanned engine's O(M·V); compute
-matches the scanned engine with ``remat=True`` (one extra forward per
-stage application).
+recomputation). The *saved stage activations* are therefore O(P)
+microbatch states per device independent of M, vs the scanned engine's
+O(M·V). Total carry residency still has an M-sized term — the
+``[M, B, ...]`` float32 input-cotangent buffer (``cot_out``) — so the
+analytic floor is ``(min(P, M) + M)`` microbatch states, linear in M
+with a much smaller constant than the scanned schedule (measured:
+9-13 MB across M=8..32 vs 171-439 MB for gpipe-plain on the bench
+model — the M term is ONE tensor, not one per stage tick). The
+replicated ``[M, B, ...]`` microbatch inputs are additional M-linear
+residency, but they live in the XLA argument buffers (reported as
+``args_mb`` in the bench), not the temp/carry floor pinned above.
+Compute matches the scanned engine with ``remat=True`` (one extra
+forward per stage application).
 
 **Loss placement.** 1F1B needs each microbatch's output cotangent the
 tick after its last-stage forward, so the head + loss must live *inside*
@@ -158,9 +167,17 @@ def _1f1b_local(
             x = jnp.where(d == 0, x_feed, c["act_in"])
             ring = lax.dynamic_update_index_in_dim(c["ring"], x, m_f % Pd, 0)
             # The last device's F output is never consumed (its B tick
-            # recomputes through the vjp), so skip the stage math there.
-            y = jnp.where(
-                d == last, jnp.zeros_like(x), apply_stage(my_params, x, m_f)
+            # recomputes through the vjp) — genuinely skip the stage math
+            # there via cond (a where would still evaluate apply_stage,
+            # charging the last stage one discarded forward per
+            # microbatch). Safe: stage_fn is collective-free over pp/dp
+            # under the 1F1B constraints, so branch divergence across pp
+            # rows cannot deadlock.
+            y = lax.cond(
+                d == last,
+                lambda xx: varying(jnp.zeros_like(xx)),
+                lambda xx: apply_stage(my_params, xx, m_f),
+                x,
             )
             return (
                 dict(c, ring=ring), y,
@@ -312,8 +329,9 @@ def pipeline_1f1b_value_and_grad(
     gradients stacked ``[P, ...]`` over the stage axis, head gradients,
     and ``[M, B, ...]`` input cotangents (float32, sharded like the
     inputs) for the caller's embedding backward. Divide by ``M`` for
-    means. Peak per-device activation residency is O(P) microbatch
-    states (ring buffer) — independent of M.
+    means. Saved stage activations are O(P) microbatch states (ring
+    buffer); total residency adds one M-sized input-cotangent buffer —
+    ``(min(P, M) + M)`` states, see the module docstring.
     """
     from jax import shard_map
 

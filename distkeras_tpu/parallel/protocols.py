@@ -238,10 +238,14 @@ class AEASGDProtocol(AsyncProtocol):
     window's local progress, and the force ``α·(local - center)``), never
     absolute weights, so the truncation is benign the same way bf16 commit
     deltas are (see :class:`distkeras_tpu.parallel.ha.CompressingClient`).
-    PS-side cost: up to ``max(2*num_workers, 4)`` tracked incarnations, each
-    holding one f32 mirror tree plus its last reply (f32 model-sized after a
-    bootstrap exchange, bf16 force-sized in steady state) — budget roughly
-    ``2 * num_workers * (4 + 4) bytes/param`` worst-case.
+    PS-side cost: up to ``max(2*num_workers, 4)`` mirror trees (stored in
+    ``mirror_dtype``, default bf16 — the mirror's own rounding cancels out
+    of the reconstruction, see ``_round_mirror``) plus up to
+    ``max(4*num_workers, 8)`` recorded replies (f32 model-sized worst case
+    after a bootstrap exchange, bf16 force-sized in steady state) —
+    worst-case budget ``num_workers * (2*2 + 4*4) = 20 bytes/param``
+    (:meth:`host_state_budget`, logged at service start and asserted in
+    ``tests/test_protocols.py``).
     """
 
     name = "aeasgd"
@@ -251,20 +255,42 @@ class AEASGDProtocol(AsyncProtocol):
         communication_window: int = 32,
         rho: float = 5.0,
         learning_rate: float = 0.1,
+        mirror_dtype: str = "bfloat16",
     ):
         super().__init__(communication_window)
         self.rho = float(rho)
         self.learning_rate = float(learning_rate)
+        # Mirror storage precision. The wire is already bf16-rounded both
+        # directions, and the mirror's own rounding cancels out of the
+        # reconstruction (local_est - local = bf16(δ) - δ regardless of the
+        # mirror's absolute error), so bf16 halves the PS's dominant host
+        # cost at no wire-accuracy cost. Both sides round with the SAME
+        # round-to-nearest-even cast in the same expression order, keeping
+        # the mirrors bit-identical. "float32" restores the old behavior.
+        if mirror_dtype not in ("bfloat16", "float32"):
+            raise ValueError(f"mirror_dtype must be bfloat16|float32, got {mirror_dtype!r}")
+        self.mirror_dtype = mirror_dtype
         # Server-side per-worker state, touched only by the single-owner PS
         # loop: the shared mirror tree and the last fused reply (replayed
-        # verbatim for a deduped retry — exactly-once answers). LRU-bounded
-        # (see _set_mirror): worker ids are per-incarnation, so restarts
-        # would otherwise leak a model-sized mirror each; evicting a live
-        # worker's mirror is safe — it just re-bootstraps next window.
+        # verbatim for a deduped retry — exactly-once answers). Each is
+        # LRU-bounded INDEPENDENTLY (see _set_mirror/_set_reply): worker ids
+        # are per-incarnation, so restarts would otherwise leak a
+        # model-sized tree each. Evicting a live worker's mirror is safe —
+        # it just re-bootstraps next window — but its reply must outlive the
+        # mirror: if the reply died with the mirror, a lost-reply retry
+        # arriving after eviction would be told "nothing applied" when the
+        # commit DID move the center, and the worker would skip its side of
+        # the elastic pull (asymmetric apply). A reply is superseded by the
+        # worker's next successful exchange; only 2×num_workers dead
+        # incarnations can age one out, so the asymmetric window survives
+        # only a PS restart (documented as accepted elastic-averaging noise
+        # — the next bootstrap re-centers the pair).
         self._mirrors: "collections.OrderedDict[str, PyTree]" = (
             collections.OrderedDict()
         )
-        self._last_reply: dict[str, tuple] = {}
+        self._last_reply: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict()
+        )
 
     def server_commit(self, center, num_updates, payload, num_workers):
         return pytree_add(center, payload["delta"]), num_updates + 1
@@ -272,6 +298,21 @@ class AEASGDProtocol(AsyncProtocol):
     def _elastic(self, local, center):
         alpha = self.rho * self.learning_rate
         return pytree_scale(pytree_sub(local, center), alpha)
+
+    def _round_mirror(self, tree):
+        """Round a freshly-advanced mirror to the storage dtype — the ONE
+        cast both sides share; any asymmetry here would split the mirrors."""
+        return _wire_bf16(tree) if self.mirror_dtype == "bfloat16" else tree
+
+    def host_state_budget(self, n_params: int, num_workers: int) -> int:
+        """Worst-case PS host bytes for this protocol's per-worker state:
+        ``max(2N, 4)`` mirrors (mirror_dtype) + ``max(4N, 8)`` recorded
+        replies (f32 model-sized worst case — a bootstrap reply; steady
+        state is bf16 force-sized). Logged at service start."""
+        mirror_bytes = 2 if self.mirror_dtype == "bfloat16" else 4
+        mirrors = max(2 * int(num_workers), 4) * mirror_bytes * n_params
+        replies = max(4 * int(num_workers), 8) * 4 * n_params
+        return mirrors + replies
 
     def server_commit_pull(self, center, num_updates, payload, num_workers):
         # Fused elastic exchange (see class docstring). Two request shapes:
@@ -292,36 +333,58 @@ class AEASGDProtocol(AsyncProtocol):
                 zero = pytree_scale(payload["elastic_diff"], 0.0)  # stays bf16: unread
                 return center, num_updates, (zero, _REBOOTSTRAP | num_updates)
             local_est = pytree_add(
-                self._mirrors[wid], _wire_f32(payload["elastic_diff"])
+                _wire_f32(self._mirrors[wid]), _wire_f32(payload["elastic_diff"])
             )
             e_wire = _wire_bf16(self._elastic(local_est, center))
             e = _wire_f32(e_wire)
-            self._set_mirror(wid, pytree_sub(local_est, e), num_workers)
+            self._set_mirror(
+                wid, self._round_mirror(pytree_sub(local_est, e)), num_workers
+            )
             reply = (e_wire, num_updates)
-            self._last_reply[wid] = reply
+            self._set_reply(wid, reply, num_workers)
             return pytree_add(center, e), num_updates + 1, reply
         if "local" in payload:
             local = pytree_to_host(payload["local"])
             e = self._elastic(local, center)
             reply = (e, num_updates)
             if wid is not None:
-                self._set_mirror(wid, pytree_sub(local, e), num_workers)
-                self._last_reply[wid] = reply
+                self._set_mirror(
+                    wid, self._round_mirror(pytree_sub(local, e)), num_workers
+                )
+                self._set_reply(wid, reply, num_workers)
             return pytree_add(center, e), num_updates + 1, reply
         new_center, new_n = self.server_commit(center, num_updates, payload, num_workers)
         return new_center, new_n, (new_center, new_n)
 
     def _set_mirror(self, wid, mirror, num_workers):
         """Store a worker's mirror, LRU-evicting stale incarnations beyond
-        2×num_workers (each mirror is a full f32 model copy; worker ids are
+        2×num_workers (each mirror is a full model copy; worker ids are
         per-incarnation uuids, so churn would otherwise grow this without
-        bound). An evicted live worker just re-bootstraps next window."""
+        bound). An evicted live worker just re-bootstraps next window.
+        Replies are NOT evicted here — they carry the exactly-once
+        guarantee past a mirror eviction (see __init__) and age out of
+        their own LRU in _set_reply."""
         self._mirrors[wid] = mirror
         self._mirrors.move_to_end(wid)
         bound = max(2 * int(num_workers), 4)
         while len(self._mirrors) > bound:
-            old, _ = self._mirrors.popitem(last=False)
-            self._last_reply.pop(old, None)
+            self._mirrors.popitem(last=False)
+
+    def _set_reply(self, wid, reply, num_workers):
+        """Record the fused reply for dedupe replay, LRU-bounded on its own
+        clock at TWICE the mirror bound: a reply outlives its mirror by a
+        full extra churn cycle, every dedupe replay refreshes its recency
+        (an actively-retrying worker keeps its answer alive indefinitely),
+        and a worker's next successful exchange supersedes it. The
+        asymmetric-apply window therefore needs a lost reply AND
+        4×num_workers other exchanges before the retry AND no replay
+        refresh in between — or a PS restart — and is accepted as elastic
+        noise (self-healing at the next bootstrap)."""
+        self._last_reply[wid] = reply
+        self._last_reply.move_to_end(wid)
+        bound = max(4 * int(num_workers), 8)
+        while len(self._last_reply) > bound:
+            self._last_reply.popitem(last=False)
 
     def server_duplicate_reply(self, center, num_updates, payload):
         # The original reply was lost in transit after the commit applied;
@@ -329,6 +392,7 @@ class AEASGDProtocol(AsyncProtocol):
         # recomputing the force would double-count the diff).
         wid = payload.get("worker_id")
         if wid in self._last_reply and ("local" in payload or "elastic_diff" in payload):
+            self._last_reply.move_to_end(wid)  # a retry storm keeps it pinned
             return self._last_reply[wid]
         if "local" in payload:
             return self._elastic(pytree_to_host(payload["local"]), center), num_updates
@@ -354,9 +418,11 @@ class AEASGDProtocol(AsyncProtocol):
                      "last_update": carry.last_update}
                 )
                 e = _wire_f32(e)
-                mirror = pytree_sub(local, e)
+                mirror = self._round_mirror(pytree_sub(local, e))
             else:
-                diff_wire = _wire_bf16(pytree_sub(local, carry.mirror))
+                diff_wire = _wire_bf16(
+                    pytree_sub(local, _wire_f32(carry.mirror))
+                )
                 e_wire, num_updates = fused(
                     {"elastic_diff": diff_wire, "worker_id": wid,
                      "last_update": carry.last_update}
@@ -371,9 +437,13 @@ class AEASGDProtocol(AsyncProtocol):
                     )
                 e = _wire_f32(e_wire)
                 # Advance the shared mirror from the wire bytes — the same
-                # arithmetic, in the same order, as the PS.
-                mirror = pytree_sub(
-                    pytree_add(carry.mirror, _wire_f32(diff_wire)), e
+                # arithmetic, in the same order, and the same storage
+                # rounding as the PS.
+                mirror = self._round_mirror(
+                    pytree_sub(
+                        pytree_add(_wire_f32(carry.mirror), _wire_f32(diff_wire)),
+                        e,
+                    )
                 )
             new_params = pytree_sub(params, e)
             return new_params, WorkerCarry(

@@ -769,6 +769,22 @@ class AsynchronousDistributedTrainer(Trainer):
 
     # reference API parity: DistributedTrainer.service()/stop_service()
     def service(self, center_params):
+        budget_fn = getattr(self.protocol, "host_state_budget", None)
+        if budget_fn is not None:
+            import logging
+
+            n_params = sum(
+                int(np.size(leaf))  # metadata read — no D2H materialize
+                for leaf in jax.tree.leaves(center_params)
+            )
+            logging.getLogger(__name__).info(
+                "PS host-state budget (%s): %.1f MB worst-case "
+                "(%d workers, %d params, mirror_dtype=%s)",
+                self.protocol.name,
+                budget_fn(n_params, self.num_workers) / 2**20,
+                self.num_workers, n_params,
+                getattr(self.protocol, "mirror_dtype", "n/a"),
+            )
         if self.transport == "grpc":
             from distkeras_tpu.parallel.ps_grpc import GrpcParameterServer
 

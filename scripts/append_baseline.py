@@ -83,9 +83,12 @@ def main() -> int:
     device = str(detail.get("device", "?"))
     extras = {
         k: detail[k]
-        for k in ("batch_size", "step_time_mean_s", "tpu_unavailable", "report")
+        for k in ("batch_size", "step_time_mean_s", "tpu_unavailable",
+                  "forced_cpu", "vs_baseline_kind", "report")
         if k in detail
     }
+    if rec.get("vs_baseline") is not None:
+        extras["vs_baseline"] = rec["vs_baseline"]
     extras_json = json.dumps(extras)
     if len(extras_json) > 700:
         extras_json = extras_json[:700] + "…"

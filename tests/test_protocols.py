@@ -257,7 +257,9 @@ def test_aeasgd_mirror_state_is_bounded_under_worker_churn():
             num_workers,
         )
     assert len(p._mirrors) <= 2 * num_workers
-    assert len(p._last_reply) <= 2 * num_workers
+    # Replies age on their own clock, twice the mirror bound (they must
+    # outlive a mirror eviction to keep dedupe replay exact — ADVICE r4).
+    assert len(p._last_reply) <= 4 * num_workers
     # An evicted worker's next diff gets the re-bootstrap flag, not garbage.
     _, _, (_, counter) = p.server_commit_pull(
         center, 20,
@@ -283,3 +285,61 @@ def test_aeasgd_lost_mirror_churn_does_not_grow_reply_state():
         assert counter & (1 << 63)
     assert len(p._last_reply) == 0
     assert len(p._mirrors) == 0
+
+
+def test_aeasgd_reply_outlives_mirror_eviction():
+    """ADVICE r4: a lost-reply retry arriving AFTER the worker's mirror was
+    LRU-evicted must still replay the recorded answer (the commit DID move
+    the center) instead of flagging a re-bootstrap — otherwise the worker
+    skips its side of an elastic pull the center already took."""
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    center = {"w": np.zeros(16, np.float32)}
+    num_workers = 2  # mirror bound 4, reply bound 8
+    local = {"w": np.full(16, 2.0, np.float32)}
+    payload = {"local": local, "worker_id": "w0", "last_update": 0}
+    center, n, reply = p.server_commit_pull(center, 0, payload, num_workers)
+    for i in range(5):  # churn past the mirror bound, not the reply bound
+        center, n, _ = p.server_commit_pull(
+            center, n,
+            {"local": {"w": np.full(16, float(i), np.float32)},
+             "worker_id": f"other{i}", "last_update": 0},
+            num_workers,
+        )
+    assert "w0" not in p._mirrors  # mirror gone...
+    replay, counter = p.server_duplicate_reply(center, n, payload)
+    assert not (counter & (1 << 63))  # ...but the retry is NOT re-bootstrapped
+    assert counter == reply[1]
+    np.testing.assert_array_equal(
+        np.asarray(replay["w"], np.float32), np.asarray(reply[0]["w"], np.float32)
+    )
+
+
+def test_aeasgd_host_state_within_budget():
+    """PS-side mirror+reply bytes for a known model stay within the
+    documented host_state_budget (bf16 mirrors are half of f32)."""
+    n_params, num_workers = 1024, 3
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    center = {"w": np.zeros(n_params, np.float32)}
+    rng = np.random.default_rng(0)
+    for i in range(12):
+        local = {"w": rng.normal(size=n_params).astype(np.float32)}
+        center, _, _ = p.server_commit_pull(
+            center, i,
+            {"local": local, "worker_id": f"w{i % num_workers}",
+             "last_update": 0},
+            num_workers,
+        )
+    mirror_bytes = sum(np.asarray(m["w"]).nbytes for m in p._mirrors.values())
+    reply_bytes = sum(np.asarray(r[0]["w"]).nbytes for r in p._last_reply.values())
+    assert mirror_bytes == len(p._mirrors) * 2 * n_params  # stored bf16
+    assert mirror_bytes + reply_bytes <= p.host_state_budget(n_params, num_workers)
+    # f32 opt-out restores the old storage
+    p32 = AEASGDProtocol(rho=5.0, learning_rate=0.1, mirror_dtype="float32")
+    center = {"w": np.zeros(n_params, np.float32)}
+    center, _, _ = p32.server_commit_pull(
+        center, 0,
+        {"local": {"w": np.ones(n_params, np.float32)}, "worker_id": "a",
+         "last_update": 0},
+        1,
+    )
+    assert np.asarray(p32._mirrors["a"]["w"]).dtype == np.float32

@@ -191,3 +191,18 @@ def test_sparse_npz_roundtrip_with_csr_in_name():
         back = dk.Dataset.from_npz(p)
         assert isinstance(back["a__csr_b"], SparseColumn)
         np.testing.assert_array_equal(np.asarray(back["a__csr_b"]), dense)
+
+
+def test_boolean_mask_selection_ndarray_parity():
+    """ADVICE r4: a bool mask must select rows like ndarray fancy indexing
+    (previously it survived to the indptr arithmetic as bool and raised a
+    confusing IndexError — or silently mis-selected)."""
+    dense, sp = _random_sparse(n=7, dim=5, seed=3)
+    mask = np.array([True, False, True, True, False, False, True])
+    np.testing.assert_array_equal(np.asarray(sp[mask]), dense[mask])
+    # empty mask -> empty column, dim preserved
+    none = sp[np.zeros(7, bool)]
+    assert len(none) == 0 and none.dim == 5
+    # wrong-length mask: loud IndexError, same as ndarray
+    with pytest.raises(IndexError, match="boolean mask"):
+        sp[np.array([True, False])]
