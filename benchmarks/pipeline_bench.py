@@ -227,10 +227,21 @@ def memory_mode():
         )
     ).lower(stacked2, head, mb, labels).compile()
     ma = compiled.memory_analysis()
+    # Hop accounting (round 5): the phase-split scan elides the fill
+    # phase's P cotangent hops and the drain phase's P activation hops —
+    # each direction permutes on 2M+P-2 of the 2M+2P-2 ticks instead of
+    # all of them.
+    t1f = ticks_1f1b(M, P)
+    hops = 2 * M + P - 2
     results["true_1f1b"] = {
         "measured_temp_mb": round(ma.temp_size_in_bytes / 2**20, 2),
         "args_mb": round(ma.argument_size_in_bytes / 2**20, 2),
         "ticks": ticks_1f1b(M, P),
+        "ppermute_hops_per_dir": hops,
+        "ppermute_hops_elided": t1f - hops,
+        "hop_bytes_saved_per_step_mb": round(
+            (t1f - hops) * state_bytes * 2 / 2**20, 2
+        ),
         # The ring holds <= P in-flight microbatch inputs per device; the
         # carry also holds ONE M-sized f32 input-cotangent buffer
         # (cot_out), so the floor is (min(P, M) + M) states — linear in M
@@ -240,6 +251,102 @@ def memory_mode():
             (min(P, M) + M) * state_bytes / 2**20, 2
         ),
     }
+
+    # --- MoE x ep 1F1B (round 5: the composed flagship) -----------------
+    # Same measurement for the hand-rolled schedule with an MoE trunk and
+    # experts sharded over ep (pp x ep mesh): the flat-in-M claim must
+    # survive the composition, so we compile at M and 2M and report both,
+    # plus the gpipe-autodiff equivalent at M for contrast.
+    if P % 2 == 0:
+        from distkeras_tpu.parallel.pipeline_1f1b import (
+            pipeline_1f1b_value_and_grad,
+        )
+        from jax.sharding import NamedSharding
+
+        ep = 2
+        pp_moe = P // ep
+        mesh_moe = make_mesh({"pp": pp_moe, "ep": ep})
+        cfg_moe = BertConfig(
+            vocab_size=64, hidden_size=D, num_heads=max(2, D // 64),
+            mlp_dim=4 * D, max_seq_len=S, num_layers=2 * pp_moe,
+            dtype=jnp.float32, moe_experts=4,
+        )
+        from flax import linen as fnn
+
+        full_layer = EncoderLayer(cfg_moe)  # full-E init (trainer parity)
+        ep_layer = EncoderLayer(cfg_moe, ep_axis="ep", ep_size=ep)
+        moe_params = [
+            fnn.meta.unbox(
+                full_layer.init(jax.random.PRNGKey(i), x_one)
+            )["params"]
+            for i in range(2 * pp_moe)
+        ]
+        groups_moe = [
+            {f"sub_{j}": moe_params[s * 2 + j] for j in range(2)}
+            for s in range(pp_moe)
+        ]
+        stacked_moe = stack_stage_params(groups_moe)
+
+        from distkeras_tpu.parallel.pipeline import stage_param_specs
+
+        specs_moe = stage_param_specs(stacked_moe, ep_size=ep)
+        stacked_moe = jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(mesh_moe, s)),
+            stacked_moe, specs_moe,
+        )
+
+        def moe_stage(params, x):
+            aux = jnp.float32(0.0)
+            for j in range(2):
+                x, st = ep_layer.apply(
+                    {"params": params[f"sub_{j}"]}, x, mutable=["aux_loss"]
+                )
+                aux = aux + sum(
+                    jnp.sum(v) for v in jax.tree.leaves(st["aux_loss"])
+                )
+            return x, aux
+
+        def moe_last(params, hp, x, labels_mb):
+            y, aux = moe_stage(params, x)
+            return jnp.sum((y @ hp["w"] - labels_mb) ** 2), aux
+
+        head_moe = {"w": np.zeros((D, 8), np.float32)}
+        for tag, M_i in (("moe_1f1b", M), ("moe_1f1b_2m", 2 * M)):
+            mb_i = np.zeros((M_i, B_mb, S, D), np.float32)
+            lab_i = np.zeros((M_i, B_mb, S, 8), np.float32)
+            compiled = jax.jit(
+                lambda sp, hp, x, y: pipeline_1f1b_value_and_grad(
+                    moe_stage, moe_last, sp, hp, x, y, mesh_moe,
+                    param_specs=specs_moe, stage_aux_seed=0.01,
+                )
+            ).lower(stacked_moe, head_moe, mb_i, lab_i).compile()
+            ma = compiled.memory_analysis()
+            results[tag] = {
+                "measured_temp_mb": round(ma.temp_size_in_bytes / 2**20, 2),
+                "microbatches": M_i,
+                "ticks": ticks_1f1b(M_i, pp_moe),
+            }
+
+        # gpipe-autodiff contrast at M (same trunk, scanned schedule).
+        def gpipe_moe_loss(sp, hp, x, y):
+            out, aux = pipeline_apply(
+                moe_stage, sp, x, mesh_moe, with_aux=True,
+                param_specs=specs_moe,
+            )
+            return (
+                jnp.sum((out @ hp["w"] - y) ** 2) + 0.01 * aux
+            )
+
+        mb_m = np.zeros((M, B_mb, S, D), np.float32)
+        lab_m = np.zeros((M, B_mb, S, 8), np.float32)
+        compiled = jax.jit(
+            jax.grad(gpipe_moe_loss)
+        ).lower(stacked_moe, head_moe, mb_m, lab_m).compile()
+        ma = compiled.memory_analysis()
+        results["moe_gpipe_plain"] = {
+            "measured_temp_mb": round(ma.temp_size_in_bytes / 2**20, 2),
+            "microbatches": M,
+        }
 
     print(json.dumps({
         "metric": "pipeline_activation_memory",

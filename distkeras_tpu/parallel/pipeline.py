@@ -56,9 +56,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "pipeline_apply",
     "stack_stage_params",
+    "stage_param_specs",
     "pipeline_shardings",
     "schedule_ticks",
 ]
+
+
+def stage_param_specs(stacked, ep_size: int = 1):
+    """Per-leaf PartitionSpecs for stacked stage params: the stage axis
+    shards over ``pp`` everywhere; MoE expert-weight leaves
+    (``moe_mlp/w_in|w_out`` — leading stage dim, then the expert dim)
+    additionally shard their expert dim over ``ep`` when ``ep_size > 1``.
+    The router stays replicated over ep (every member routes the full
+    token set). The ONE definition of the rule — the trainer, the memory
+    bench, and the dryrun must all agree on which leaves are experts."""
+
+    def spec(path, _leaf):
+        if ep_size > 1:
+            keys = [getattr(k, "key", None) for k in path]
+            if "moe_mlp" in keys and keys[-1] in ("w_in", "w_out"):
+                return P("pp", "ep")
+        return P("pp")
+
+    return jax.tree_util.tree_map_with_path(spec, stacked)
 
 
 def schedule_ticks(num_microbatches: int, num_devices: int,

@@ -44,6 +44,33 @@ from distkeras_tpu.training.trainers import Trainer, _StepCheckpointer
 __all__ = ["PipelineTrainer"]
 
 
+def _apply_stage_sublayers(layer_mod, stage_params, x, key, per_stage,
+                           train, moe):
+    """Apply one stage's encoder sublayers; collect sown MoE aux losses.
+    The ONE body behind both schedules' stage functions (gpipe and 1f1b)
+    — their trajectory parity depends on this being shared. ``key`` is
+    non-None exactly when dropout is on; sublayer ``j`` folds ``j`` into
+    it so the 1f1b backward recompute reproduces the forward's masks."""
+    aux = jnp.float32(0.0)
+    for j in range(per_stage):
+        scope = {"params": stage_params[f"sub_{j}"]}
+        rngs = (
+            {"dropout": jax.random.fold_in(key, j)}
+            if key is not None
+            else None
+        )
+        if moe:
+            x, st = layer_mod.apply(
+                scope, x, train=train, rngs=rngs, mutable=["aux_loss"],
+            )
+            aux = aux + sum(
+                jnp.sum(leaf) for leaf in jax.tree.leaves(st["aux_loss"])
+            )
+        else:
+            x = layer_mod.apply(scope, x, train=train, rngs=rngs)
+    return (x, aux) if moe else x
+
+
 class PipelineTrainer(Trainer):
     """Train a transformer-family model with its trunk pipelined over ``pp``.
 
@@ -104,11 +131,12 @@ class PipelineTrainer(Trainer):
         # "gpipe": the scanned differentiable schedule (supports V,
         # dropout, MoE, ep). "1f1b": the hand-rolled
         # PipeDream-flush/Megatron schedule (parallel/pipeline_1f1b.py) —
-        # O(P) activation residency independent of num_microbatches
-        # (measured ~19x less than gpipe plain, ~4x less than remat in
-        # BENCH_MODE=memory), at remat-equivalent compute. Supports dp
-        # meshes, dropout, and the accuracy metric; limits: V=1, no
-        # MoE/ep.
+        # near-flat activation residency in num_microbatches (measured
+        # ~19x less than gpipe plain, ~4x less than remat in
+        # BENCH_MODE=memory; ~15x less than gpipe with an MoE trunk), at
+        # remat-equivalent compute. Supports dp meshes, dropout, the
+        # accuracy metric, and MoE trunks with ep-sharded experts; limit:
+        # V=1 (interleaving needs the gpipe schedule).
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown schedule {schedule!r}")
         self.schedule = schedule
@@ -178,21 +206,12 @@ class PipelineTrainer(Trainer):
         return merged
 
     def _stage_specs(self, stacked, ep_size: int):
-        """Per-leaf PartitionSpecs for the stacked stage params: the stage
-        axis shards over ``pp`` everywhere; expert-weight leaves
-        (``moe_mlp/w_in|w_out`` — leading stage dim, then the expert dim)
-        additionally shard their expert dim over ``ep``. The router stays
-        replicated over ep (every member routes the full token set)."""
-        from jax.sharding import PartitionSpec as P
+        """Per-leaf PartitionSpecs for the stacked stage params — delegates
+        to the shared rule in :func:`stage_param_specs` (the memory bench
+        measures the same specs it trains with)."""
+        from distkeras_tpu.parallel.pipeline import stage_param_specs
 
-        def spec(path, _leaf):
-            if ep_size > 1:
-                keys = [getattr(k, "key", None) for k in path]
-                if "moe_mlp" in keys and keys[-1] in ("w_in", "w_out"):
-                    return P("pp", "ep")
-            return P("pp")
-
-        return jax.tree_util.tree_map_with_path(spec, stacked)
+        return stage_param_specs(stacked, ep_size)
 
     @staticmethod
     def _head_logits(ln_final, head_params, x):
@@ -228,24 +247,10 @@ class PipelineTrainer(Trainer):
         moe = self._moe
 
         def _run_sublayers(stage_params, x, key):
-            """Apply this stage's layers; collect sown MoE aux losses."""
-            aux = jnp.float32(0.0)
-            for j in range(per_stage):
-                scope = {"params": stage_params[f"sub_{j}"]}
-                rngs = (
-                    {"dropout": jax.random.fold_in(key, j)} if dropout else None
-                )
-                if moe:
-                    x, st = layer_mod.apply(
-                        scope, x, train=dropout, rngs=rngs,
-                        mutable=["aux_loss"],
-                    )
-                    aux = aux + sum(
-                        jnp.sum(leaf) for leaf in jax.tree.leaves(st["aux_loss"])
-                    )
-                else:
-                    x = layer_mod.apply(scope, x, train=dropout, rngs=rngs)
-            return (x, aux) if moe else x
+            return _apply_stage_sublayers(
+                layer_mod, stage_params, x, key, per_stage,
+                train=dropout, moe=moe,
+            )
 
         if dropout:
             # Stochastic trunk: pipeline_apply hands each (tick, device)
@@ -293,7 +298,8 @@ class PipelineTrainer(Trainer):
 
         return forward
 
-    def _make_1f1b_step(self, mesh, per_stage: int, optimizer):
+    def _make_1f1b_step(self, mesh, per_stage: int, optimizer,
+                        ep_size: int = 1, stage_specs=None):
         """Train step on the hand-rolled 1F1B engine: embedding vjp outside
         the pipe, head + loss fused into the last stage (the engine needs
         each microbatch's cotangent right after its final forward), stage
@@ -301,7 +307,14 @@ class PipelineTrainer(Trainer):
         Dropout works (deterministic per-(microbatch, stage) keys — the
         backward recompute reproduces the forward's masks); accuracy is
         threaded through the engine's aux channel; microbatch IO shards
-        over dp when the mesh has one."""
+        over dp when the mesh has one. MoE trunks compose: each stage
+        returns its layers' summed load-balance aux, the engine seeds its
+        cotangent with ``aux_loss_weight / M`` (so router balance trains
+        through the same per-tick recompute), and with ``ep_size > 1`` the
+        expert-weight leaves stay sharded P("pp", "ep") end to end — the
+        stage fn runs the MoE block in manual-collective mode (psum over
+        ep; tokens replicated over ep see identical dropout masks because
+        the per-(m, stage, dp) keys never fold the ep index)."""
         from flax import linen as nn
 
         from distkeras_tpu.models.bert import EncoderLayer
@@ -311,26 +324,24 @@ class PipelineTrainer(Trainer):
         )
 
         cfg = self.cfg
-        layer_mod = EncoderLayer(cfg)
+        layer_mod = EncoderLayer(
+            cfg,
+            ep_axis="ep" if ep_size > 1 else None,
+            ep_size=ep_size if ep_size > 1 else 1,
+        )
         ln_final = nn.LayerNorm(dtype=jnp.float32)
         loss_fn = get_loss(self.loss)
         M = self.num_microbatches
         dropout = self._dropout
+        moe = self._moe
         want_acc = "accuracy" in self.metrics
         io_spec = _io_spec(mesh)
 
         def _apply_layers(stage_params, x, key):
-            for j in range(per_stage):
-                rngs = (
-                    {"dropout": jax.random.fold_in(key, j)}
-                    if key is not None
-                    else None
-                )
-                x = layer_mod.apply(
-                    {"params": stage_params[f"sub_{j}"]}, x,
-                    train=dropout, rngs=rngs,
-                )
-            return x
+            return _apply_stage_sublayers(
+                layer_mod, stage_params, x, key, per_stage,
+                train=dropout, moe=moe,
+            )
 
         if dropout:
             def stage_fn(stage_params, x, key):
@@ -340,17 +351,22 @@ class PipelineTrainer(Trainer):
                 return _apply_layers(stage_params, x, None)
 
         def _last(stage_params, head, x, labels_mb, key):
-            x = _apply_layers(stage_params, x, key)
+            out = _apply_layers(stage_params, x, key)
+            x, stage_aux = out if moe else (out, None)
             logits = self._head_logits(ln_final, head, x)
             # Per-microbatch mean scaled by 1/M: the engine sums over
             # microbatches, so the total is the batch-mean loss and every
             # gradient it returns is already mean-scaled.
             loss = loss_fn(logits, labels_mb) / M
+            acc = None
             if want_acc:
                 from distkeras_tpu.ops.metrics import accuracy
 
-                return loss, accuracy(logits, labels_mb) / M
-            return loss
+                acc = accuracy(logits, labels_mb) / M
+            if moe:
+                # (loss, stage_aux[, metrics]) — engine seeds stage_aux.
+                return (loss, stage_aux, acc) if want_acc else (loss, stage_aux)
+            return (loss, acc) if want_acc else loss
 
         if dropout:
             def last_fn(p, hp, x, y, key):
@@ -385,11 +401,14 @@ class PipelineTrainer(Trainer):
                 stage_fn, last_fn, train_params["stages"], rest, mbs,
                 labels_mb, mesh, rng=rng if dropout else None,
                 with_aux=want_acc, io_spec=io_spec,
+                param_specs=stage_specs,
+                stage_aux_seed=(self.aux_loss_weight / M) if moe else None,
             )
-            if want_acc:
-                loss, acc, stage_grads, head_grads, cot = out
-            else:
-                loss, stage_grads, head_grads, cot = out
+            out = list(out)
+            loss = out.pop(0)
+            acc = out.pop(0) if want_acc else None
+            moe_aux = out.pop(0) if moe else None
+            stage_grads, head_grads, cot = out
             (embed_grads,) = embed_vjp(cot.astype(mbs.dtype))
             # Tied embedding: head use (logits) + embed use sum; disjoint
             # leaves (pos_embed vs ln_final/mlm_bias) sum with zeros.
@@ -400,6 +419,10 @@ class PipelineTrainer(Trainer):
             updates, new_opt = optimizer.update(grads, opt_state, train_params)
             new_params = optax.apply_updates(train_params, updates)
             metrics = {"loss": loss}
+            if moe:
+                # Engine sums raw aux over (stages, microbatches); /M makes
+                # it the batch-mean the gpipe path reports.
+                metrics["aux_loss"] = moe_aux / M
             if want_acc:
                 metrics["accuracy"] = acc
             return new_params, new_opt, metrics
@@ -463,18 +486,10 @@ class PipelineTrainer(Trainer):
         optimizer = self._optimizer()
         opt_state = optimizer.init(train_params)
         if self.schedule == "1f1b":
-            unsupported = []
             if self.virtual_stages != 1:
-                unsupported.append("virtual_stages > 1")
-            if self._moe:
-                unsupported.append("MoE")
-            if ep_size > 1:
-                unsupported.append("the ep mesh axis")
-            if unsupported:
                 raise ValueError(
-                    "schedule='1f1b' does not support: "
-                    + ", ".join(unsupported)
-                    + " (use the gpipe schedule, or remat for memory)"
+                    "schedule='1f1b' does not support: virtual_stages > 1 "
+                    "(use the gpipe schedule, or remat for memory)"
                 )
             extra_metrics = [
                 m for m in self.metrics if m not in ("loss", "accuracy")
@@ -487,7 +502,10 @@ class PipelineTrainer(Trainer):
                     "requested metrics %s will be absent from the history",
                     extra_metrics,
                 )
-            step = self._make_1f1b_step(mesh, per_stage, optimizer)
+            step = self._make_1f1b_step(
+                mesh, per_stage, optimizer, ep_size=ep_size,
+                stage_specs=stage_specs,
+            )
         else:
             forward = self._make_forward(
                 mesh, per_stage, ep_size=ep_size, stage_specs=stage_specs
