@@ -8,9 +8,14 @@
 #   - one flock (.tpu.lock) around every chip touch;
 #   - generous timeouts with SIGKILL only as last resort;
 #   - never two python processes on the chip at once.
+# The bench invocation itself (flock + budget-below-timeout + artifact
+# quarantine + BASELINE append) is the shared run_bench_rung helper in
+# scripts/chip_bench_lib.sh — the forced-CPU proof ladder uses the same
+# one, so the discipline cannot drift between the two callers.
 cd /root/repo || exit 1
 LOCK=.tpu.lock
 LOG=.tpu_watch.log
+. scripts/chip_bench_lib.sh
 
 probe() {
   flock "$LOCK" timeout --signal=KILL 300 python - <<'EOF'
@@ -24,22 +29,6 @@ print(f"probe ok: {ds[0]} init+matmul {time.time()-t0:.1f}s", flush=True)
 EOF
 }
 
-run_bench() {  # $1 model  $2 timeout  $3 outfile
-  # TPU_LOCK_HELD: tell bench.py the flock is already held by this wrapper
-  # so it skips its own LOCK_EX (same-file flock across two open file
-  # descriptions self-deadlocks even within one process tree).
-  BENCH_MODEL="$1" TPU_LOCK_HELD=1 flock "$LOCK" timeout --signal=KILL "$2" \
-    python bench.py > "$3" 2> "$3.err" || return 1
-  # bench.py exits 0 even when it could only emit the value=0
-  # infrastructure_failure fallback line (driver-parseability contract).
-  # That artifact is NOT a warm result: set it aside so the ladder
-  # retries this model on the next healthy probe instead of dead-ending.
-  python scripts/append_baseline.py --check "$3" || {
-    mv "$3" "$3.failed.$(date +%s)"
-    return 1
-  }
-}
-
 echo "$(date +%FT%T) watcher start" >> "$LOG"
 while true; do
   if probe >> "$LOG" 2>&1; then
@@ -48,11 +37,13 @@ while true; do
     # Warm sequence: smallest graph first so each flock window is short.
     if [ ! -s .bench_mlp.json ]; then
       echo "$(date +%FT%T) warming mlp" >> "$LOG"
-      run_bench mlp 1800 .bench_mlp.json && echo "$(date +%FT%T) mlp done: $(cat .bench_mlp.json)" >> "$LOG"
+      run_bench_rung mlp 1800 .bench_mlp.json tpu-mlp \
+        && echo "$(date +%FT%T) mlp done: $(cat .bench_mlp.json)" >> "$LOG"
     fi
     if [ -s .bench_mlp.json ] && [ ! -s .bench_bert.json ]; then
       echo "$(date +%FT%T) warming bert" >> "$LOG"
-      run_bench bert 5400 .bench_bert.json && echo "$(date +%FT%T) bert done: $(cat .bench_bert.json)" >> "$LOG"
+      run_bench_rung bert 5400 .bench_bert.json tpu-bert-base \
+        && echo "$(date +%FT%T) bert done: $(cat .bench_bert.json)" >> "$LOG"
     fi
     if [ -s .bench_bert.json ] && [ ! -s .bench_kernels.json ] \
         && [ "$(cat .bench_kernels.attempts 2>/dev/null || echo 0)" -lt 3 ]; then
@@ -66,7 +57,8 @@ while true; do
     # the BASELINE flagship model's number forever.
     if [ -s .bench_bert.json ] && [ ! -s .bench_resnet50.json ]; then
       echo "$(date +%FT%T) warming resnet50 (long compile)" >> "$LOG"
-      run_bench resnet50 10800 .bench_resnet50.json && echo "$(date +%FT%T) resnet50 done: $(cat .bench_resnet50.json)" >> "$LOG"
+      run_bench_rung resnet50 10800 .bench_resnet50.json tpu-resnet50 \
+        && echo "$(date +%FT%T) resnet50 done: $(cat .bench_resnet50.json)" >> "$LOG"
     fi
     # Record every existing artifact's row (idempotent: identical rows
     # dedupe, infrastructure_failure artifacts are refused) — re-running

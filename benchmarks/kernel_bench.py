@@ -33,6 +33,12 @@ def _bench(fn, *args, steps=10):
 
 def main():
     import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # The env var alone is NOT enough in this container (sitecustomize
+        # pins axon first); the config update is what actually avoids
+        # touching — and hanging on — a wedged chip.
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from distkeras_tpu.ops.attention import dot_product_attention

@@ -1,0 +1,34 @@
+# Shared chip-bench invocation discipline — ONE definition, sourced by
+# the watcher (.tpu_watch.sh) and the forced-CPU proof ladder
+# (scripts/cpu_proof_ladder.sh), so the lock/timeout/artifact rules
+# cannot drift between them.
+#
+#   run_bench_rung <model> <external_timeout_s> <outfile> <tag> [ENV=V...]
+#
+# - The internal BENCH_BUDGET_S is set 60s BELOW the external
+#   `timeout --signal=KILL` so bench.py's own budget/signal machinery
+#   always emits its guaranteed JSON line before the uncatchable KILL
+#   can land (KILL bypasses the SIGTERM fallback-emit handler).
+# - Fallback/failed artifacts are quarantined (*.failed.<ts>) so ladders
+#   retry on the next pass instead of dead-ending on an empty file.
+# - On success the row is appended to BASELINE.md immediately (append is
+#   idempotent; callers may re-append later for crash safety).
+# - All chip access serializes on the repo flock; TPU_LOCK_HELD tells
+#   bench.py not to re-take it (same-file flock across two open file
+#   descriptions self-deadlocks).
+
+run_bench_rung() {
+  local model="$1" t_ext="$2" out="$3" tag="$4"
+  shift 4
+  local budget=$(( t_ext > 120 ? t_ext - 60 : t_ext / 2 ))
+  env "$@" BENCH_MODEL="$model" BENCH_BUDGET_S="$budget" TPU_LOCK_HELD=1 \
+    flock "${LOCK:-.tpu.lock}" timeout --signal=KILL "$t_ext" \
+    python bench.py > "$out" 2> "$out.err" \
+    || { mv -f "$out" "$out.failed.$(date +%s)" 2>/dev/null; return 1; }
+  python scripts/append_baseline.py --check "$out" || {
+    mv -f "$out" "$out.failed.$(date +%s)"
+    return 1
+  }
+  [ -n "$tag" ] && python scripts/append_baseline.py "$tag" "$out"
+  return 0
+}

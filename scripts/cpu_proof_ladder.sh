@@ -1,0 +1,44 @@
+#!/bin/bash
+# Prove the chip-recovery bench ladder end-to-end WITHOUT a chip
+# (VERDICT r4 weak #2: only the mlp rung had ever executed anywhere).
+#
+# Runs every rung of .tpu_watch.sh's warm sequence under BENCH_FORCE_CPU
+# — the identical code path a TPU recovery takes, minus the chip — each
+# leaving its .bench_cpu_proof_*.json artifact and auto-appending an
+# honestly-labelled row (forced_cpu=true, tpu_unavailable=null) to
+# BASELINE.md. Serializes on the same flock as every other chip touch
+# (FORCE_CPU never probes the chip, but the discipline is uniform) via
+# the shared run_bench_rung helper.
+#
+#   bash scripts/cpu_proof_ladder.sh
+set -u
+cd "$(dirname "$0")/.." || exit 1
+LOCK=.tpu.lock
+. scripts/chip_bench_lib.sh
+rc=0
+
+run_rung() {  # $1 model  $2 external timeout  $3 tag
+  local out=".bench_cpu_proof_$1.json"
+  echo "== rung $1 (timeout ${2}s) =="
+  if run_bench_rung "$1" "$2" "$out" "$3" BENCH_FORCE_CPU=1; then
+    echo "  $(cat "$out")"
+  else
+    echo "  rung $1 FAILED"
+    rc=1
+  fi
+}
+
+run_rung mlp 300 cpu-proof-mlp
+run_rung bert 900 cpu-proof-bert-base
+run_rung resnet50 900 cpu-proof-resnet50
+
+echo "== rung kernel_bench (pallas, interpret mode) =="
+out=.bench_cpu_proof_kernels.json
+BENCH_FORCE_CPU=1 PYTHONPATH=. TPU_LOCK_HELD=1 flock "$LOCK" \
+  timeout --signal=KILL 900 \
+  python benchmarks/kernel_bench.py > "$out" 2> "$out.err" \
+  && python scripts/append_baseline.py cpu-proof-pallas-kernels "$out" \
+  && echo "  $(head -c 300 "$out")" \
+  || { echo "  kernel rung FAILED"; rc=1; }
+
+exit $rc
