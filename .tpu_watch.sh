@@ -49,8 +49,7 @@ while true; do
         && [ "$(cat .bench_kernels.attempts 2>/dev/null || echo 0)" -lt 3 ]; then
       echo "$(( $(cat .bench_kernels.attempts 2>/dev/null || echo 0) + 1 ))" > .bench_kernels.attempts
       echo "$(date +%FT%T) running pallas kernel bench" >> "$LOG"
-      PYTHONPATH=/root/repo flock "$LOCK" timeout --signal=KILL 5400 \
-        python benchmarks/kernel_bench.py > .bench_kernels.json 2> .bench_kernels.json.err \
+      run_kernel_rung 5400 .bench_kernels.json tpu-pallas-kernels \
         && echo "$(date +%FT%T) kernels done: $(cat .bench_kernels.json)" >> "$LOG"
     fi
     # resnet50 gates on bert only — a failing kernel bench must not block

@@ -335,8 +335,11 @@ def _previous_same_config(metric: str, batch: int, on_cpu: bool,
             continue
         if ("CPU" in str(det.get("device", "")).upper()) != on_cpu:
             continue
-        # Older rows carry no shape/forced fields: absent means the default
-        # shape and an organic (unforced) run — both compare as "".
+        # Rows recorded before the shape field existed compare as "" — that
+        # matches mlp (whose tag IS "") and deliberately never matches
+        # bert/resnet (default tags "seq128"/"img224"): those models have
+        # no pre-shape-field CPU rows in any BENCH_r*.json, and refusing a
+        # shapeless prior is safer than guessing its geometry.
         if str(det.get("shape", "") or "") != shape:
             continue
         if bool(det.get("forced_cpu")) != forced:

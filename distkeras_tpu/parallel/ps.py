@@ -202,7 +202,15 @@ class ParameterServerService:
 
 class InProcessClient:
     """Worker-side handle (reference ``distkeras/workers.py`` §
-    ``NetworkWorker.pull``/``commit`` round-trips, minus the socket)."""
+    ``NetworkWorker.pull``/``commit`` round-trips, minus the socket).
+
+    ``wire_is_local``: the "wire" is a same-process queue — bytes are
+    free and replies cannot be lost, so protocols should skip
+    wire-compression state machines (bf16 delta mirrors, dedupe replay)
+    that only pay on a real network. See
+    ``AEASGDProtocol.worker_window``."""
+
+    wire_is_local = True
 
     def __init__(self, service: ParameterServerService):
         self._service = service

@@ -29,6 +29,27 @@ run_bench_rung() {
     mv -f "$out" "$out.failed.$(date +%s)"
     return 1
   }
-  [ -n "$tag" ] && python scripts/append_baseline.py "$tag" "$out"
+  if [ -n "$tag" ]; then
+    # A failed append is a failed rung (the measurement never landed in
+    # BASELINE.md) — but the artifact stays in place, NOT quarantined, so
+    # callers with an idempotent re-append pass (the watcher) recover it.
+    python scripts/append_baseline.py "$tag" "$out" || return 1
+  fi
+  return 0
+}
+
+# run_kernel_rung <external_timeout_s> <outfile> <tag> [ENV=V...]
+# Same flock/quarantine/append discipline for the pallas kernel bench
+# (benchmarks/kernel_bench.py — its own script, no BENCH_BUDGET_S knob).
+run_kernel_rung() {
+  local t_ext="$1" out="$2" tag="$3"
+  shift 3
+  env "$@" PYTHONPATH=. TPU_LOCK_HELD=1 \
+    flock "${LOCK:-.tpu.lock}" timeout --signal=KILL "$t_ext" \
+    python benchmarks/kernel_bench.py > "$out" 2> "$out.err" \
+    || { mv -f "$out" "$out.failed.$(date +%s)" 2>/dev/null; return 1; }
+  if [ -n "$tag" ]; then
+    python scripts/append_baseline.py "$tag" "$out" || return 1
+  fi
   return 0
 }

@@ -34,11 +34,11 @@ run_rung resnet50 900 cpu-proof-resnet50
 
 echo "== rung kernel_bench (pallas, interpret mode) =="
 out=.bench_cpu_proof_kernels.json
-BENCH_FORCE_CPU=1 PYTHONPATH=. TPU_LOCK_HELD=1 flock "$LOCK" \
-  timeout --signal=KILL 900 \
-  python benchmarks/kernel_bench.py > "$out" 2> "$out.err" \
-  && python scripts/append_baseline.py cpu-proof-pallas-kernels "$out" \
-  && echo "  $(head -c 300 "$out")" \
-  || { echo "  kernel rung FAILED"; rc=1; }
+if run_kernel_rung 900 "$out" cpu-proof-pallas-kernels BENCH_FORCE_CPU=1; then
+  echo "  $(head -c 300 "$out")"
+else
+  echo "  kernel rung FAILED"
+  rc=1
+fi
 
 exit $rc

@@ -343,3 +343,29 @@ def test_aeasgd_host_state_within_budget():
         1,
     )
     assert np.asarray(p32._mirrors["a"]["w"]).dtype == np.float32
+
+
+def test_aeasgd_local_transport_skips_mirror_machinery():
+    """In-process transport (wire_is_local): the elastic exchange ships the
+    full-precision local tree with NO worker_id, so the PS keeps no mirror
+    or reply state and the worker keeps no mirror — the wire-compression
+    state machine only runs where there is a wire (round-5 fix for the
+    1.52x loopback overhead; BASELINE.md round-5 table)."""
+    from distkeras_tpu.parallel.ps import ParameterServerService
+
+    p = AEASGDProtocol(rho=5.0, learning_rate=0.1)
+    svc = ParameterServerService(p, {"w": np.zeros(16, np.float32)}, 1)
+    svc.start()
+    try:
+        client = svc.client()
+        assert getattr(client, "wire_is_local", False)
+        params, carry = p.worker_begin(client, None)
+        for i in range(3):
+            params, carry = p.worker_window(_perturb(params, i), carry, client)
+        assert carry.mirror is None          # worker side: no mirror kept
+        assert not carry.worker_id
+        assert len(p._mirrors) == 0          # PS side: no bookkeeping
+        assert len(p._last_reply) == 0
+        assert svc.num_commits == 3          # the exchanges DID apply
+    finally:
+        svc.stop()
