@@ -25,9 +25,15 @@ def main():
     ap.add_argument("--virtual-stages", type=int, default=2)
     ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
-                    help="1f1b: hand-rolled schedule, O(P) activation "
-                         "residency independent of microbatch count "
+                    help="1f1b: hand-rolled schedule, near-flat activation "
+                         "residency in the microbatch count "
                          "(requires --virtual-stages 1)")
+    ap.add_argument("--moe-experts", type=int, default=0,
+                    help="replace the MLP with a routed MoE of this many "
+                         "experts (aux load-balance loss trains too)")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="shard experts over an ep mesh axis (dp x pp x ep; "
+                         "composes with both schedules, 1f1b included)")
     from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
     add_platform_flag(ap)
     args = ap.parse_args()
@@ -44,6 +50,7 @@ def main():
     cfg = BertConfig(
         vocab_size=vocab, hidden_size=64, num_layers=4, num_heads=4,
         mlp_dim=128, max_seq_len=seq, dropout_rate=0.0, causal=True,
+        moe_experts=args.moe_experts,
     )
     model = _make(cfg, seq, "gpt_pipe")
 
@@ -59,13 +66,19 @@ def main():
         num_stages=args.stages, virtual_stages=args.virtual_stages,
         num_microbatches=4, batch_size=args.batch_size,
         num_epoch=args.epochs, seed=0, schedule=args.schedule,
+        ep=args.ep if args.ep > 1 else None,
     )
     t0 = time.time()
     trained = trainer.train(ds, shuffle=True)
     hist = trainer.get_history()
+    aux = (
+        f" aux {hist[0]['aux_loss']:.3f} -> {hist[-1]['aux_loss']:.3f}"
+        if "aux_loss" in hist[0] else ""
+    )
     print(
-        f"pp={args.stages} V={args.virtual_stages} {args.schedule}: loss "
-        f"{hist[0]['loss']:.3f} -> {hist[-1]['loss']:.3f} "
+        f"pp={args.stages} V={args.virtual_stages} {args.schedule}"
+        f"{f' moe={args.moe_experts} ep={args.ep}' if args.moe_experts else ''}: "
+        f"loss {hist[0]['loss']:.3f} -> {hist[-1]['loss']:.3f}{aux} "
         f"({len(hist)} steps, {time.time()-t0:.1f}s)"
     )
 

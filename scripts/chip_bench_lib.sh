@@ -48,6 +48,12 @@ run_kernel_rung() {
     flock "${LOCK:-.tpu.lock}" timeout --signal=KILL "$t_ext" \
     python benchmarks/kernel_bench.py > "$out" 2> "$out.err" \
     || { mv -f "$out" "$out.failed.$(date +%s)" 2>/dev/null; return 1; }
+  # Unparseable output quarantines like run_bench_rung's (a bad artifact
+  # left in place would satisfy the watcher's [ -s ] retry gate forever).
+  python scripts/append_baseline.py --check "$out" || {
+    mv -f "$out" "$out.failed.$(date +%s)"
+    return 1
+  }
   if [ -n "$tag" ]; then
     python scripts/append_baseline.py "$tag" "$out" || return 1
   fi
