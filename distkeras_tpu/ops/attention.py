@@ -86,7 +86,8 @@ def _block_attn_update(q, k_blk, v_blk, acc, m, denom, scale, mask=None):
     return new_acc, new_m, new_denom
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   stripe: bool = False):
     """Ring attention over a sharded sequence axis.
 
     To be called **inside** ``shard_map`` (or an equivalent SPMD context)
@@ -116,8 +117,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         if causal:
             # K/V shard visiting at `step` originated on device (my - step) % p.
             src = (my - step) % p
-            rows = my * S_local + jnp.arange(S_local)[:, None]  # global q pos
-            cols = src * S_local + jnp.arange(S_local)[None, :]  # global k pos
+            if stripe:
+                # Striped layout (ring_flash.stripe_shard): shard m's local
+                # index j is global token j*p + m — every hop is a (near-)
+                # triangle, balancing causal work across the ring.
+                rows = jnp.arange(S_local)[:, None] * p + my
+                cols = jnp.arange(S_local)[None, :] * p + src
+            else:
+                rows = my * S_local + jnp.arange(S_local)[:, None]  # global q
+                cols = src * S_local + jnp.arange(S_local)[None, :]  # global k
             mask = (rows >= cols)[None, None]  # [1,1,Sq,Sk]
         acc, m, denom = _block_attn_update(
             q, k_cur, v_cur, acc, m, denom, scale, mask=mask
@@ -133,16 +141,22 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     return (acc / jnp.maximum(denom_t, 1e-30)).astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp", causal: bool = False):
+def ring_self_attention(q, k, v, mesh, seq_axis: str = "sp",
+                        causal: bool = False, stripe: bool = False):
     """Convenience wrapper: run :func:`ring_attention` under ``shard_map`` on
     ``mesh``, sharding the sequence dimension of ``[B, S, H, D]`` inputs over
-    ``seq_axis`` and the batch over ``dp`` if present."""
+    ``seq_axis`` and the batch over ``dp`` if present. ``stripe=True``
+    expects inputs in the striped token layout
+    (:func:`distkeras_tpu.ops.ring_flash.stripe_shard`)."""
     from jax import shard_map
 
+    if stripe and not causal:
+        raise ValueError("stripe=True only changes causal masking")
     spec = sp_batch_spec(mesh, seq_axis, q.shape[0])
 
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                          stripe=stripe),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

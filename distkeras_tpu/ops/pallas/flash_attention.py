@@ -37,14 +37,19 @@ __all__ = ["flash_attention"]
 _NEG_INF = -1e30
 
 
-def _causal_mask(q_start, k_start, block_q, block_k):
+def _causal_mask(q_start, k_start, block_q, block_k, shift=0):
+    """Attend iff row >= col + shift: shift=0 is the standard inclusive
+    causal triangle; shift=1 excludes the diagonal (STRICT causal — the
+    striped ring-attention layout needs it for hops where the visiting
+    shard's stripe sits later in the token order than the local one)."""
     rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    return rows >= cols
+    return rows >= cols + shift
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
-                scale: float, causal: bool, q_block: int, seq_len: int):
+                scale: float, causal: bool, q_block: int, seq_len: int,
+                causal_shift: int = 0):
     q = q_ref[0]  # [block_q, D]
     num_k_blocks = seq_len // block_k
     block_q, d = q.shape
@@ -62,8 +67,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = jnp.where(_causal_mask(q_start, i * block_k, block_q, block_k),
-                          s, _NEG_INF)
+            s = jnp.where(
+                _causal_mask(q_start, i * block_k, block_q, block_k,
+                             causal_shift),
+                s, _NEG_INF,
+            )
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, blk_max)
         p = jnp.exp(s - m_new)
@@ -84,7 +92,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, block_k: int,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                block_k: int, scale: float, causal: bool, q_block: int,
-               seq_len: int):
+               seq_len: int, causal_shift: int = 0):
     q = q_ref[0]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]  # [block_q, 1]
@@ -100,8 +108,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = jnp.where(_causal_mask(q_start, i * block_k, block_q, block_k),
-                          s, _NEG_INF)
+            s = jnp.where(
+                _causal_mask(q_start, i * block_k, block_q, block_k,
+                             causal_shift),
+                s, _NEG_INF,
+            )
         p = jnp.exp(s - lse)  # [block_q, block_k]
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -121,7 +132,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, *, block_q: int, scale: float, causal: bool,
-                k_block: int, seq_len: int):
+                k_block: int, seq_len: int, causal_shift: int = 0):
     k = k_ref[0]  # [block_k, D]
     v = v_ref[0]
     block_k, d = k.shape
@@ -138,8 +149,11 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            s = jnp.where(_causal_mask(i * block_q, k_start, block_q, block_k),
-                          s, _NEG_INF)
+            s = jnp.where(
+                _causal_mask(i * block_q, k_start, block_q, block_k,
+                             causal_shift),
+                s, _NEG_INF,
+            )
         p = jnp.exp(s - lse)  # [block_q, block_k]
         dv = dv + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -162,13 +176,14 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                   causal_shift=0):
     """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, S, 1])."""
     bh, s, d = q.shape
     scale = d**-0.5
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, scale=scale, causal=causal,
-        q_block=block_q, seq_len=s,
+        q_block=block_q, seq_len=s, causal_shift=causal_shift,
     )
     grid = (bh, s // block_q)
     return pl.pallas_call(
@@ -191,13 +206,15 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
-def dq_call(q, k, v, do, lse, delta, causal, block_q, interpret):
+def dq_call(q, k, v, do, lse, delta, causal, block_q, interpret,
+            causal_shift=0):
     """dQ for (possibly differing) q/kv lengths — shared with ring_flash."""
     bh, s, d = q.shape
     s_kv = k.shape[1]
     return pl.pallas_call(
         functools.partial(_dq_kernel, block_k=min(block_q, s_kv), scale=d**-0.5,
-                          causal=causal, q_block=block_q, seq_len=s_kv),
+                          causal=causal, q_block=block_q, seq_len=s_kv,
+                          causal_shift=causal_shift),
         grid=(bh, s // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -213,13 +230,15 @@ def dq_call(q, k, v, do, lse, delta, causal, block_q, interpret):
     )(q, k, v, do, lse, delta)
 
 
-def dkv_call(k, v, q, do, lse, delta, causal, block_k, interpret):
+def dkv_call(k, v, q, do, lse, delta, causal, block_k, interpret,
+             causal_shift=0):
     """dK/dV for (possibly differing) q/kv lengths — shared with ring_flash."""
     bh, s_kv, d = k.shape
     s_q = q.shape[1]
     return pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=min(block_k, s_q), scale=d**-0.5,
-                          causal=causal, k_block=block_k, seq_len=s_q),
+                          causal=causal, k_block=block_k, seq_len=s_q,
+                          causal_shift=causal_shift),
         grid=(bh, s_kv // block_k),
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),

@@ -34,7 +34,35 @@ from distkeras_tpu.ops.pallas.flash_attention import (
     dq_call as _dq_call,
 )
 
-__all__ = ["ring_flash_attention"]
+__all__ = ["ring_flash_attention", "stripe_shard", "stripe_unshard"]
+
+
+def _stripe_permute(x, p, axis, to_striped):
+    """Both stripe directions are the same blocked transpose — expressed
+    as reshape+swapaxes (a cheap XLA-fusable transpose, not a gather)."""
+    S = x.shape[axis]
+    if S % p:
+        raise ValueError(f"sequence {S} not divisible by {p} stripes")
+    shape = x.shape
+    inner = (S // p, p) if to_striped else (p, S // p)
+    x = x.reshape(*shape[:axis], *inner, *shape[axis + 1:])
+    x = jnp.swapaxes(x, axis, axis + 1)
+    return x.reshape(shape)
+
+
+def stripe_shard(x, p, axis: int = 1):
+    """Natural token order -> striped layout: after the usual contiguous
+    mesh split into ``p`` shards, shard ``m`` holds tokens ``m, m+p,
+    m+2p, ...`` (position ``(m, j)`` = global token ``j*p + m``). Apply to
+    q/k/v (and labels/position ids) BEFORE sharding; invert the outputs
+    with :func:`stripe_unshard`. Positional embeddings must be added in
+    natural order first — the permutation moves tokens, not positions."""
+    return _stripe_permute(x, p, axis, to_striped=True)
+
+
+def stripe_unshard(x, p, axis: int = 1):
+    """Inverse of :func:`stripe_shard`."""
+    return _stripe_permute(x, p, axis, to_striped=False)
 
 
 def _fold(x):  # [B, S, H, D] -> [BH, S, D]
@@ -48,7 +76,9 @@ def _unfold(x, B, H):  # [BH, S, D] -> [B, S, H, D]
 
 
 def _hop_forward(q, k_cur, v_cur, mode, block_q, interpret):
-    """(o_i, lse_i) for one visiting shard. mode: 0=skip, 1=causal, 2=full."""
+    """(o_i, lse_i) for one visiting shard.
+    mode: 0=skip, 1=causal (diagonal included), 2=full, 3=strict causal
+    (diagonal excluded — the striped layout's later-stripe hops)."""
     bh, s, d = q.shape
 
     def skip(_):
@@ -65,10 +95,33 @@ def _hop_forward(q, k_cur, v_cur, mode, block_q, interpret):
         return _flash_forward(q, k_cur, v_cur, False, block_q,
                               min(block_q, k_cur.shape[1]), interpret)
 
-    return lax.switch(mode, [skip, diag, full], None)
+    def strict(_):
+        return _flash_forward(q, k_cur, v_cur, True, block_q,
+                              min(block_q, k_cur.shape[1]), interpret,
+                              causal_shift=1)
+
+    return lax.switch(mode, [skip, diag, full, strict], None)
 
 
-def _make_ring(axis_name, causal, block_q, interpret):
+def _make_ring(axis_name, causal, block_q, interpret, stripe=False):
+    # Per-hop kernel mask. Contiguous layout (stripe=False): the ring
+    # three-case — earlier shard full, own shard causal, later shard
+    # skipped; under causal masking the work is triangular in the shard
+    # index, so the last shard does p hops of work while shard 0 does one,
+    # and the lock-step ring idles at ~50% utilization. Striped layout
+    # (stripe=True; Striped Attention, Brandon et al. 2023): shard m holds
+    # tokens m, m+p, m+2p, ... — global position jq*p + my vs jk*p + src
+    # makes every hop either inclusive-causal (src <= my) or strict-causal
+    # (src > my): NO skipped hops, near-identical work per hop on every
+    # device, ~2x causal ring utilization. Callers permute tokens with
+    # stripe_shard()/stripe_unshard().
+    def hop_mode(src, my):
+        if not causal:
+            return jnp.full((), 2, jnp.int32)
+        if stripe:
+            return jnp.where(src <= my, 1, 3)
+        return jnp.where(src == my, 1, jnp.where(src < my, 2, 0))
+
     @jax.custom_vjp
     def ring(q, k, v):
         o, _ = _ring_fwd_impl(q, k, v)
@@ -87,11 +140,7 @@ def _make_ring(axis_name, causal, block_q, interpret):
         def hop(carry, step):
             o, lse, k_cur, v_cur = carry
             src = (my - step) % p
-            mode = (
-                jnp.where(src == my, 1, jnp.where(src < my, 2, 0))
-                if causal
-                else jnp.full((), 2, jnp.int32)
-            )
+            mode = hop_mode(src, my)
             o_i, lse_i = _hop_forward(q, k_cur, v_cur, mode, block_q, interpret)
             new_lse = jnp.logaddexp(lse, lse_i)
             w_old = jnp.exp(lse - new_lse)
@@ -125,11 +174,7 @@ def _make_ring(axis_name, causal, block_q, interpret):
         def hop(carry, step):
             dq, dk_cur, dv_cur, k_cur, v_cur = carry
             src = (my - step) % p
-            mode = (
-                jnp.where(src == my, 1, jnp.where(src < my, 2, 0))
-                if causal
-                else jnp.full((), 2, jnp.int32)
-            )
+            mode = hop_mode(src, my)
 
             def skip(_):
                 return (
@@ -138,20 +183,20 @@ def _make_ring(axis_name, causal, block_q, interpret):
                     jnp.zeros_like(v_cur),
                 )
 
-            def run(is_causal):
+            def run(is_causal, shift=0):
                 def f(_):
                     dq_i = _dq_call(q, k_cur, v_cur, do, lse, delta, is_causal,
-                                    block_q, interpret)
+                                    block_q, interpret, causal_shift=shift)
                     dk_i, dv_i = _dkv_call(k_cur, v_cur, q, do, lse, delta,
                                            is_causal,
                                            min(block_q, k_cur.shape[1]),
-                                           interpret)
+                                           interpret, causal_shift=shift)
                     return dq_i, dk_i, dv_i
 
                 return f
 
             dq_i, dk_i, dv_i = lax.switch(
-                mode, [skip, run(True), run(False)], None
+                mode, [skip, run(True), run(False), run(True, shift=1)], None
             )
             dq = dq + dq_i.astype(jnp.float32)
             dk_cur = dk_cur + dk_i.astype(jnp.float32)
@@ -183,11 +228,22 @@ def ring_flash_attention(
     causal: bool = False,
     block_q: int = 128,
     interpret: bool | None = None,
+    stripe: bool = False,
 ):
     """Ring flash attention over ``[B, S, H, D]`` inputs with the sequence
     dimension sharded over ``mesh[seq_axis]``. Exact (matches dense
     attention) and differentiable; batch shards over ``dp`` when present.
+
+    ``stripe=True`` (causal only): inputs are in the striped token layout
+    (:func:`stripe_shard`) — every ring hop then carries near-equal work
+    on every device instead of the contiguous layout's triangular skew
+    (shard 0 does 1 hop of work, shard p-1 does p), roughly doubling
+    causal utilization at identical numerics. Outputs stay striped; invert
+    with :func:`stripe_unshard`.
     """
+    if stripe and not causal:
+        raise ValueError("stripe=True only changes causal masking; "
+                         "non-causal rings are already balanced")
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -235,7 +291,7 @@ def ring_flash_attention(
     from distkeras_tpu.ops.attention import sp_batch_spec
 
     spec = sp_batch_spec(mesh, seq_axis, B)
-    ring = _make_ring(seq_axis, causal, block_q, interpret)
+    ring = _make_ring(seq_axis, causal, block_q, interpret, stripe=stripe)
 
     def local(q, k, v):  # per-device [B_loc, S_loc, H, D]
         o = ring(_fold(q), _fold(k), _fold(v))

@@ -42,6 +42,9 @@ def main():
     if args.schedule == "1f1b" and args.virtual_stages != 1:
         print("note: 1f1b is non-interleaved; forcing --virtual-stages 1")
         args.virtual_stages = 1
+    if args.ep > 1 and not args.moe_experts:
+        ap.error("--ep needs --moe-experts (a dense MLP has no expert "
+                 "weights to shard; an ep mesh axis would only shrink dp)")
 
     import distkeras_tpu as dk
     from distkeras_tpu.models.bert import BertConfig, _make
