@@ -55,8 +55,12 @@ class BertConfig:
     ring_mesh: object = None
     ring_axis: str = "sp"
     # Which sequence-parallel strategy when ring_mesh is set: "ring"
-    # (ppermute K/V stream, ops/ring_flash.py) or "ulysses" (all-to-all
-    # head re-sharding, ops/ulysses.py; needs num_heads % sp == 0).
+    # (ppermute K/V stream, ops/ring_flash.py), "ring_stripe" (same ring
+    # in the striped token layout — balanced causal work per hop, ~2x
+    # ring utilization; causal only; the model stripes after embedding
+    # and unstripes before the head, so the external [B, S, V] contract
+    # is unchanged), or "ulysses" (all-to-all head re-sharding,
+    # ops/ulysses.py; needs num_heads % sp == 0).
     sp_impl: str = "ring"
     # Incremental decoding: attention layers keep K/V caches of length
     # max_seq_len in a mutable "cache" collection, and positions advance a
@@ -98,11 +102,27 @@ class SelfAttention(nn.Module):
         elif cfg.ring_mesh is not None and mask is None:
             if cfg.sp_impl == "ulysses":
                 from distkeras_tpu.ops.ulysses import ulysses_self_attention as sp_fn
-            elif cfg.sp_impl == "ring":
-                from distkeras_tpu.ops.ring_flash import ring_flash_attention as sp_fn
+            elif cfg.sp_impl in ("ring", "ring_stripe"):
+                import functools
+
+                from distkeras_tpu.ops.ring_flash import ring_flash_attention
+
+                stripe = cfg.sp_impl == "ring_stripe"
+                if stripe and not cfg.causal:
+                    raise ValueError(
+                        "sp_impl='ring_stripe' is causal-only (striping "
+                        "balances the causal triangle; non-causal rings "
+                        "are already balanced — use sp_impl='ring')"
+                    )
+                # CONTRACT: with stripe, x must already be in the striped
+                # token layout. Bert.__call__ stripes once after embedding;
+                # direct EncoderLayer consumers must not set ring_stripe
+                # (PipelineTrainer rejects ring_mesh configs outright).
+                sp_fn = functools.partial(ring_flash_attention, stripe=stripe)
             else:
                 raise ValueError(
-                    f"unknown sp_impl {cfg.sp_impl!r}: expected 'ring' or 'ulysses'"
+                    f"unknown sp_impl {cfg.sp_impl!r}: expected 'ring', "
+                    "'ring_stripe', or 'ulysses'"
                 )
             out = sp_fn(
                 q.reshape(shape), k.reshape(shape), v.reshape(shape),
@@ -243,8 +263,27 @@ class Bert(nn.Module):
         else:
             x = embed(token_ids) + pos_embed[:, :S].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
+        # Striped sequence parallelism: permute tokens ONCE after the
+        # (natural-order) positional embedding and run the whole trunk in
+        # the striped layout — attention is the only position-sensitive
+        # op, and it gets the striped masks from sp_impl. Un-permuted
+        # before the head, so logits stay [B, S, V] in natural order.
+        striped = (
+            cfg.ring_mesh is not None
+            and cfg.sp_impl == "ring_stripe"
+            and not cfg.decode
+        )
+        if striped:
+            from distkeras_tpu.ops.ring_flash import stripe_shard
+
+            sp = dict(cfg.ring_mesh.shape)[cfg.ring_axis]
+            x = stripe_shard(x, sp)
         for i in range(cfg.num_layers):
             x = EncoderLayer(cfg, name=f"layer_{i}")(x, train=train)
+        if striped:
+            from distkeras_tpu.ops.ring_flash import stripe_unshard
+
+            x = stripe_unshard(x, sp)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Tied MLM head: project back through the embedding matrix.
         logits = embed.attend(x.astype(jnp.float32))

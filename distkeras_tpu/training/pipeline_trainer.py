@@ -117,6 +117,17 @@ class PipelineTrainer(Trainer):
                 f"{self.model.name!r}"
             )
         self.cfg = cfg
+        if getattr(cfg, "ring_mesh", None) is not None:
+            # The pipelined trunk applies EncoderLayer under its own
+            # shard_map — a nested sequence-parallel mesh cannot run there,
+            # and sp_impl="ring_stripe" would silently apply striped masks
+            # to unstriped tokens (the striping lives in Bert.__call__,
+            # outside the pipe). Loud rejection beats wrong logits.
+            raise ValueError(
+                "PipelineTrainer does not support sequence-parallel "
+                "attention inside the pipelined trunk (cfg.ring_mesh is "
+                "set); unset ring_mesh, or use the sync trainer for sp"
+            )
         self.num_stages = num_stages
         self.num_microbatches = int(num_microbatches)
         # Interleaved (Megatron-style) schedule: V chunks per device cut the

@@ -62,9 +62,12 @@ def test_flash_return_lse_matches_manual(rng):
 
 
 @pytest.mark.slow
-def test_bert_with_ring_attention_trains(rng):
+@pytest.mark.parametrize("sp_impl", ["ring", "ring_stripe"])
+def test_bert_with_ring_attention_trains(rng, sp_impl):
     """BERT with ring-flash attention trains under the sync trainer on a
-    dp x sp mesh — end-to-end sequence-parallel long-context training."""
+    dp x sp mesh — end-to-end sequence-parallel long-context training.
+    ring_stripe additionally pins the model-level stripe/unstripe
+    bracketing: logits must equal the plain dense model's."""
     import dataclasses
 
     import distkeras_tpu as dk
@@ -75,9 +78,10 @@ def test_bert_with_ring_attention_trains(rng):
     cfg = bert_mod.BertConfig(
         vocab_size=vocab, hidden_size=64, num_layers=2, num_heads=2,
         mlp_dim=128, max_seq_len=seq, dropout_rate=0.0,
-        ring_mesh=mesh, ring_axis="sp",
+        ring_mesh=mesh, ring_axis="sp", sp_impl=sp_impl,
+        causal=(sp_impl == "ring_stripe"),  # stripe is causal-only
     )
-    model = bert_mod._make(cfg, seq, "bert_ring")
+    model = bert_mod._make(cfg, seq, f"bert_{sp_impl}")
 
     tokens = np.asarray(rng.integers(1, vocab, size=(128, seq)), np.int32)
     ds = dk.Dataset.from_arrays(features=tokens, label=tokens)
@@ -89,9 +93,9 @@ def test_bert_with_ring_attention_trains(rng):
     hist = trainer.get_history()
     assert hist[-1]["loss"] < hist[0]["loss"]
 
-    # correctness: ring model forward == plain model forward (same weights)
+    # correctness: sp model forward == plain model forward (same weights)
     plain_cfg = dataclasses.replace(cfg, ring_mesh=None)
-    plain = bert_mod._make(plain_cfg, seq, "bert_plain")
+    plain = bert_mod._make(plain_cfg, seq, f"bert_plain_{sp_impl}")
     variables = model.init(3)
     x = tokens[:4]
     o_ring, _ = model.apply(variables, x)
@@ -190,3 +194,26 @@ def test_striped_jnp_ring_matches_dense_causal(rng):
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-3, rtol=2e-3)
+
+def test_ring_stripe_rejections():
+    """Loud failures for the striped layout's contract edges: non-causal
+    stripe, and sequence parallelism inside the pipelined trunk (where the
+    model-level striping cannot run and masks would be silently wrong)."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import bert as bert_mod
+
+    mesh = make_mesh({"sp": 4})
+    cfg = bert_mod.BertConfig(
+        vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+        mlp_dim=32, max_seq_len=8, ring_mesh=mesh, ring_axis="sp",
+        sp_impl="ring_stripe", causal=False,
+    )
+    with pytest.raises(ValueError, match="causal"):
+        bert_mod._make(cfg, 8, "stripe_noncausal").init(0)
+
+    import dataclasses
+
+    cfg2 = dataclasses.replace(cfg, causal=True)
+    with pytest.raises(ValueError, match="pipelined trunk"):
+        dk.PipelineTrainer(bert_mod._make(cfg2, 8, "stripe_pipe"),
+                           num_stages=2)
