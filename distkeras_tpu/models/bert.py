@@ -101,7 +101,24 @@ class SelfAttention(nn.Module):
             )
         elif cfg.ring_mesh is not None and mask is None:
             if cfg.sp_impl == "ulysses":
-                from distkeras_tpu.ops.ulysses import ulysses_self_attention as sp_fn
+                import functools
+
+                from distkeras_tpu.ops.ulysses import ulysses_self_attention
+
+                if cfg.use_flash_attention:
+                    # Compose the strategies: all-to-all to head sharding,
+                    # then the Pallas flash kernel over the full local
+                    # sequence — no O(S^2) score materialization where the
+                    # default dense local attention would build one.
+                    from distkeras_tpu.ops.pallas.flash_attention import (
+                        flash_attention,
+                    )
+
+                    sp_fn = functools.partial(
+                        ulysses_self_attention, attn_fn=flash_attention
+                    )
+                else:
+                    sp_fn = ulysses_self_attention
             elif cfg.sp_impl in ("ring", "ring_stripe"):
                 import functools
 

@@ -97,6 +97,8 @@ def ulysses_self_attention(q, k, v, mesh, seq_axis: str = "sp",
             f"head counts that don't divide"
         )
     spec = sp_batch_spec(mesh, seq_axis, B)
+    # check_vma off: a Pallas attn_fn's pallas_call out_shapes carry no vma
+    # annotations (same reason as ring_flash_attention's shard_map).
     fn = shard_map(
         functools.partial(
             ulysses_attention, axis_name=seq_axis, causal=causal,
@@ -105,5 +107,6 @@ def ulysses_self_attention(q, k, v, mesh, seq_axis: str = "sp",
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=False,
     )
     return fn(q, k, v)

@@ -95,3 +95,39 @@ def test_bert_with_ulysses_attention_trains(rng):
     np.testing.assert_allclose(
         np.asarray(o_u), np.asarray(o_plain), atol=3e-2, rtol=3e-2
     )
+
+
+def test_ulysses_with_flash_local_matches_dense(rng):
+    """Ulysses composed with the Pallas flash kernel as the local attention
+    (BertConfig(use_flash_attention=True, sp_impl="ulysses")): values and
+    gradients match the dense local default — no O(S^2) local scores."""
+    from distkeras_tpu.ops.pallas.flash_attention import flash_attention
+    from distkeras_tpu.ops.ulysses import ulysses_self_attention
+
+    B, S, H, D = 2, 64, 4, 8
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    mk = lambda: np.asarray(rng.normal(size=(B, S, H, D)), np.float32)
+    q, k, v = mk(), mk(), mk()
+
+    for causal in (False, True):
+        out = ulysses_self_attention(
+            q, k, v, mesh, seq_axis="sp", causal=causal,
+            attn_fn=flash_attention,
+        )
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+
+    def loss_flash(q, k, v):
+        return jnp.mean(ulysses_self_attention(
+            q, k, v, mesh, seq_axis="sp", causal=True,
+            attn_fn=flash_attention) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.mean(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
