@@ -98,16 +98,13 @@ def test_bert_with_ulysses_attention_trains(rng):
 
 
 def test_ulysses_with_flash_local_matches_dense(rng):
-    """Ulysses composed with the Pallas flash kernel as the local attention
-    (BertConfig(use_flash_attention=True, sp_impl="ulysses")): values and
-    gradients match the dense local default — no O(S^2) local scores."""
+    """Ulysses composed with the Pallas flash kernel as the local attention:
+    values and gradients match the dense local default — no O(S^2) local
+    scores. (The model-level BertConfig wiring is pinned separately below.)"""
     from distkeras_tpu.ops.pallas.flash_attention import flash_attention
-    from distkeras_tpu.ops.ulysses import ulysses_self_attention
 
-    B, S, H, D = 2, 64, 4, 8
     mesh = make_mesh({"dp": 2, "sp": 4})
-    mk = lambda: np.asarray(rng.normal(size=(B, S, H, D)), np.float32)
-    q, k, v = mk(), mk(), mk()
+    q, k, v = _qkv(rng)
 
     for causal in (False, True):
         out = ulysses_self_attention(
@@ -131,3 +128,33 @@ def test_ulysses_with_flash_local_matches_dense(rng):
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-3, rtol=2e-3)
+
+
+def test_bert_ulysses_flash_model_wiring(rng):
+    """BertConfig(sp_impl="ulysses", use_flash_attention=True) dispatches
+    to the flash-local composition: logits match the plain dense model on
+    identical weights (a typo in the SelfAttention branch cannot hide)."""
+    import dataclasses
+
+    from distkeras_tpu.models import bert as bert_mod
+
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    vocab, seq = 64, 32
+    cfg = bert_mod.BertConfig(
+        vocab_size=vocab, hidden_size=64, num_layers=2, num_heads=4,
+        mlp_dim=128, max_seq_len=seq, dropout_rate=0.0, causal=True,
+        ring_mesh=mesh, ring_axis="sp", sp_impl="ulysses",
+        use_flash_attention=True,
+    )
+    model = bert_mod._make(cfg, seq, "bert_uly_flash")
+    plain = bert_mod._make(
+        dataclasses.replace(cfg, ring_mesh=None, use_flash_attention=False),
+        seq, "bert_uly_plain",
+    )
+    variables = model.init(3)
+    x = np.asarray(rng.integers(1, vocab, size=(4, seq)), np.int32)
+    o_sp, _ = model.apply(variables, x)
+    o_plain, _ = plain.apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(o_sp), np.asarray(o_plain), atol=3e-2, rtol=3e-2
+    )
