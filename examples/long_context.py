@@ -26,9 +26,16 @@ def main():
     ap.add_argument("--ulysses", action="store_true",
                     help="all-to-all sequence parallelism (ops/ulysses.py) "
                          "instead of the K/V ring; needs heads %% devices == 0")
+    ap.add_argument("--stripe", action="store_true",
+                    help="striped token layout (causal only): balances the "
+                         "causal triangle across the ring — every hop does "
+                         "equal work instead of shard 0 idling")
     from distkeras_tpu.utils.platform import add_platform_flag, apply_platform_args
     add_platform_flag(ap)
     args = ap.parse_args()
+    if args.stripe and (args.ulysses or not args.causal):
+        ap.error("--stripe balances the CAUSAL ring: needs --causal, "
+                 "without --ulysses")
     apply_platform_args(args)
 
     import os
@@ -56,6 +63,11 @@ def main():
     print(f"S={S} over sp={ndev}: dense scores would be {dense_bytes/1e9:.1f} GB; "
           f"ring peak {ring_bytes/1e9:.2f} GB across all devices")
 
+    if args.stripe:
+        from distkeras_tpu.ops.ring_flash import stripe_shard, stripe_unshard
+
+        q, k, v = (np.asarray(stripe_shard(t, ndev)) for t in (q, k, v))
+
     t0 = time.time()
     if args.ulysses:
         from distkeras_tpu.ops.ulysses import ulysses_self_attention
@@ -66,14 +78,16 @@ def main():
     elif args.flash:
         from distkeras_tpu.ops.ring_flash import ring_flash_attention
 
-        kind = "ring-flash"
+        kind = "ring-flash-striped" if args.stripe else "ring-flash"
         out = ring_flash_attention(q, k, v, mesh, seq_axis="sp",
-                                   causal=args.causal)
+                                   causal=args.causal, stripe=args.stripe)
     else:
-        kind = "ring"
+        kind = "ring-striped" if args.stripe else "ring"
         out = ring_self_attention(q, k, v, mesh, seq_axis="sp",
-                                  causal=args.causal)
+                                  causal=args.causal, stripe=args.stripe)
     out = np.asarray(out)
+    if args.stripe:
+        out = np.asarray(stripe_unshard(out, ndev))
     print(f"{kind} attention done in {time.time()-t0:.1f}s "
           f"out={out.shape} finite={np.isfinite(out).all()}")
 
