@@ -1145,7 +1145,17 @@ class ADAG(AsynchronousDistributedTrainer):
 
 
 class AEASGD(AsynchronousDistributedTrainer):
-    """Asynchronous Elastic Averaging SGD (reference § ``AEASGD``)."""
+    """Asynchronous Elastic Averaging SGD (reference § ``AEASGD``).
+
+    Tuning note: ``alpha = rho * learning_rate`` is the rate at which the
+    CENTER tracks the workers per exchange — and the returned model IS the
+    center. The reference defaults (rho=5, SGD lr~0.1) give alpha=0.5;
+    with adam-scale learning rates (1e-3) the same rho leaves alpha=0.005
+    and the center barely leaves its init within a short run — scale rho
+    up to land alpha in a working 0.05–0.5 band. Measured on the digits
+    acceptance task (20 epochs): rho=1 (alpha=1e-3) → 0.15 accuracy, the
+    near-untrained center; rho=50 (alpha=0.05) → single-node parity
+    (``tests/test_real_data.py``)."""
 
     protocol_cls = AEASGDProtocol
 

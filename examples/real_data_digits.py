@@ -82,6 +82,16 @@ def main():
     run("adag", dk.ADAG(model(), num_workers=args.workers, **kwargs))
     run("downpour", dk.DOWNPOUR(model(), num_workers=args.workers, **kwargs))
     run("dynsgd", dk.DynSGD(model(), num_workers=args.workers, **kwargs))
+    # The elastic family. alpha = rho*lr is the CENTER's tracking rate —
+    # and the returned model IS the center — so with adam-scale lr (1e-3)
+    # the reference-default rho=5.0 leaves alpha=0.005 and the center lags
+    # its workers badly (measured at rho=1: 0.15 accuracy, ~untrained).
+    # rho=50 lands alpha=0.05, the low end of the working band (the
+    # reference's SGD-era configs ran alpha = 5 x 0.1 = 0.5).
+    run("aeasgd", dk.AEASGD(model(), num_workers=args.workers,
+                            rho=50.0, communication_window=8, **kwargs))
+    run("eamsgd", dk.EAMSGD(model(), num_workers=args.workers,
+                            rho=50.0, communication_window=8, **kwargs))
 
     base = results["single"]
     for name, acc in results.items():

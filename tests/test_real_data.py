@@ -43,23 +43,41 @@ def _accuracy(trained, test):
     )
 
 
-def test_real_digits_single_node_learns(digits):
+_KWARGS = dict(worker_optimizer="adam", learning_rate=1e-3, batch_size=32,
+               num_epoch=20, seed=0)
+
+
+@pytest.fixture(scope="module")
+def single_acc(digits):
+    """The single-node baseline, trained ONCE for the whole module (every
+    parity test compares against the same run)."""
     train, test = digits
-    t = dk.SingleTrainer(_model(), worker_optimizer="adam", learning_rate=1e-3,
-                         batch_size=32, num_epoch=20, seed=0)
-    trained = t.train(train, shuffle=True)
-    acc = _accuracy(trained, test)
-    assert acc > 0.93, acc
+    single = dk.SingleTrainer(_model(), **_KWARGS)
+    return _accuracy(single.train(train, shuffle=True), test)
 
 
-def test_real_digits_async_parity_with_single(digits):
+def test_real_digits_single_node_learns(single_acc):
+    assert single_acc > 0.93, single_acc
+
+
+def test_real_digits_async_parity_with_single(digits, single_acc):
     """The reference acceptance criterion, on real data."""
     train, test = digits
-    kwargs = dict(worker_optimizer="adam", learning_rate=1e-3, batch_size=32,
-                  num_epoch=20, seed=0)
-    single = dk.SingleTrainer(_model(), **kwargs)
-    acc_single = _accuracy(single.train(train, shuffle=True), test)
-    adag = dk.ADAG(_model(), num_workers=4, **kwargs)
+    adag = dk.ADAG(_model(), num_workers=4, **_KWARGS)
     acc_adag = _accuracy(adag.train(train, shuffle=True), test)
-    assert acc_single > 0.93
-    assert abs(acc_adag - acc_single) < 0.08, (acc_adag, acc_single)
+    assert abs(acc_adag - single_acc) < 0.08, (acc_adag, single_acc)
+
+
+@pytest.mark.parametrize("cls", ["AEASGD", "EAMSGD"])
+def test_real_digits_elastic_parity_with_single(digits, single_acc, cls):
+    """The elastic family on real data (round 5 — completes the acceptance
+    matrix, EAMSGD included). alpha = rho*lr is the CENTER's tracking rate
+    and the returned model IS the center: with adam-scale lr (1e-3), rho
+    must scale up to land alpha in a working band (rho=50 -> alpha=0.05;
+    measured: rho=1 -> alpha=1e-3 leaves the center at 0.15 accuracy) —
+    the footgun is documented on the trainer."""
+    train, test = digits
+    elastic = getattr(dk, cls)(_model(), num_workers=4, rho=50.0,
+                               communication_window=8, **_KWARGS)
+    acc_elastic = _accuracy(elastic.train(train, shuffle=True), test)
+    assert abs(acc_elastic - single_acc) < 0.08, (acc_elastic, single_acc)
