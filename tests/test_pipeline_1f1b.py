@@ -387,3 +387,37 @@ def test_1f1b_single_microbatch_edge():
             np.asarray(sg["w"][i]), np.asarray(ref_sg_list[i]["w"]),
             atol=1e-4, rtol=1e-4,
         )
+
+
+@pytest.mark.slow
+def test_pipeline_trainer_1f1b_moe_ep_with_dropout_trains():
+    """MoE x ep x dropout through the hand-rolled schedule: the B-tick
+    recompute must reproduce the F-tick's dropout masks with the aux
+    channel active (deterministic per-(m, stage, dp) keys), or gradients
+    silently mismatch the forward — caught here as training failing to
+    converge."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.bert import BertConfig, _make
+
+    VOCAB, SEQ = 32, 8
+    cfg = BertConfig(vocab_size=VOCAB, hidden_size=16, num_layers=2,
+                     num_heads=2, mlp_dim=32, max_seq_len=SEQ,
+                     dropout_rate=0.1, moe_experts=4)
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, VOCAB, size=(96, SEQ)).astype(np.int32)
+    ds = dk.Dataset.from_arrays(features=x, label=x.copy())
+    mesh = make_mesh({"dp": 2, "pp": 2, "ep": 2})
+    t = dk.PipelineTrainer(
+        _make(cfg, SEQ, "bert_moe1f1b_drop"), num_stages=2, ep=2,
+        schedule="1f1b", num_microbatches=2, batch_size=16,
+        num_epoch=4, learning_rate=3e-3, worker_optimizer="adam", seed=0,
+        mesh=mesh, aux_loss_weight=0.05,
+    )
+    t.train(ds, shuffle=True)
+    h = t.get_history()
+    # Same bar as the non-moe dropout test: monotone-ish improvement (a
+    # mask mismatch between F-tick and B-tick recompute stalls training
+    # entirely — measured here as loss 3.47->2.94, acc 0.05->0.27).
+    assert h[-1]["loss"] < h[0]["loss"], (h[0], h[-1])
+    assert all(np.isfinite(s["aux_loss"]) for s in h)
+    assert "accuracy" in h[-1] and h[-1]["accuracy"] > h[0]["accuracy"]
