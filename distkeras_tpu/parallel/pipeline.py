@@ -5,8 +5,9 @@ that choice is measured, not guessed: ``BENCH_MODE=memory
 benchmarks/pipeline_bench.py`` reports XLA's compiled peak temp per
 schedule (plain vs remat, V=1 vs 2) next to the TRUE 1F1B engine
 (:mod:`distkeras_tpu.parallel.pipeline_1f1b` — hand-rolled backward,
-O(P) residency independent of M); the (model, M, V, P)-fits-16GB table
-lives in docs/parallel.md.
+near-flat residency in M: O(P) saved stage activations plus one M-sized
+cotangent buffer); the (model, M, V, P)-fits-16GB table lives in
+docs/parallel.md.
 
 Absent from the reference (SURVEY §2 parallelism table) but a first-class
 axis here. The design is SPMD, not a scheduler: every device runs the same
@@ -41,7 +42,7 @@ giving pipeline-parallel *training*, not just inference. (The backward is
 the scan's time-reversal — activation memory is the remat lever on
 ``stage_fn``, not the schedule; see PipelineTrainer's ``remat``, or
 ``schedule="1f1b"`` for the hand-rolled schedule whose residency is
-independent of M.)
+near-flat in M — and which composes with MoE/ep since round 5.)
 """
 
 from __future__ import annotations
