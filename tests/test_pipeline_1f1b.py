@@ -421,3 +421,23 @@ def test_pipeline_trainer_1f1b_moe_ep_with_dropout_trains():
     assert h[-1]["loss"] < h[0]["loss"], (h[0], h[-1])
     assert all(np.isfinite(s["aux_loss"]) for s in h)
     assert "accuracy" in h[-1] and h[-1]["accuracy"] > h[0]["accuracy"]
+
+
+def test_1f1b_phase_split_compiles_dead_hops_away():
+    """Structural pin for the hop elision: the compiled step must contain
+    THREE scan loops with FOUR collective-permute sites total (fill: act
+    only; steady: act+cot; drain: cot only) — a regression that merges the
+    phases back into one loop, or re-adds a dead hop, changes the count."""
+    stages, head, mb, labels = _setup()
+    mesh = make_mesh({"pp": P_DEV})
+    stacked = stack_stage_params(stages)
+    txt = jax.jit(
+        lambda s, h, x, y: pipeline_1f1b_value_and_grad(
+            _stage_fn, _last_fn, s, h, x, y, mesh
+        )
+    ).lower(stacked, head, mb, labels).compile().as_text()
+    hops = txt.count("collective-permute(") + txt.count(
+        "collective-permute-start("
+    )
+    assert hops == 4, f"expected 4 ppermute sites (1+2+1), found {hops}"
+    assert txt.count("while(") == 3, "expected the 3 phase scans"
