@@ -1,0 +1,95 @@
+"""The bench drift gate and BASELINE append tooling (round 5): pure-python
+helpers that decide what BENCH_r05's vs_baseline compares against — the
+one guard on the only surface measurable every round."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (stdlib-only parent module)
+
+
+def _write_round(tmp_path, n, metric, value, batch, device="TFRT_CPU_0",
+                 shape="", forced=False, infra=False):
+    detail = {"batch_size": batch, "device": device}
+    if shape:
+        detail["shape"] = shape
+    if forced:
+        detail["forced_cpu"] = True
+    if infra:
+        detail["infrastructure_failure"] = True
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+        "parsed": {"metric": metric, "value": value, "detail": detail}
+    }))
+
+
+def test_previous_same_config_prefers_latest_round(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+    m = "mnist_mlp_train_samples_per_sec_per_chip"
+    _write_round(tmp_path, 3, m, 100.0, 256)
+    _write_round(tmp_path, 4, m, 200.0, 256)
+    value, source = bench._previous_same_config(m, 256, True)
+    assert value == 200.0 and source == "BENCH_r04.json"
+
+
+def test_previous_same_config_filters_identity(tmp_path, monkeypatch):
+    """batch, device kind, shape, forced flag, and infra rows all gate."""
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+    m = "bert_base_mlm_train_samples_per_sec_per_chip"
+    _write_round(tmp_path, 1, m, 1.0, 2, shape="seq64", forced=True)
+    _write_round(tmp_path, 2, m, 9.0, 2, shape="seq128", forced=True)
+    _write_round(tmp_path, 3, m, 5.0, 2, shape="seq64", forced=True, infra=True)
+    # same shape+forced -> r01 (r02 is a different shape, r03 is infra)
+    value, source = bench._previous_same_config(m, 2, True, "seq64", True)
+    assert (value, source) == (1.0, "BENCH_r01.json")
+    # organic lookup never sees forced rows
+    assert bench._previous_same_config(m, 2, True, "seq64", False) == (None, None)
+    # batch mismatch
+    assert bench._previous_same_config(m, 4, True, "seq64", True) == (None, None)
+    # a TPU lookup never matches CPU rows
+    assert bench._previous_same_config(m, 2, False, "seq64", True) == (None, None)
+
+
+def test_shapeless_prior_matches_only_empty_shape(tmp_path, monkeypatch):
+    """Rows recorded before the shape field existed (BENCH_r04's mlp row)
+    compare as shape \"\" — matching mlp, never bert/resnet defaults."""
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+    m = "mnist_mlp_train_samples_per_sec_per_chip"
+    _write_round(tmp_path, 4, m, 34026.13, 256)  # no shape, no forced
+    assert bench._previous_same_config(m, 256, True) == (
+        34026.13, "BENCH_r04.json"
+    )
+    assert bench._previous_same_config(m, 256, True, "seq128") == (None, None)
+
+
+def test_record_history_roundtrip_and_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+    m = "resnet50_train_samples_per_sec_per_chip"
+    bench._record_history(m, 4, True, 5.29, "img64", True)
+    # no BENCH_r rows -> the history file answers
+    value, source = bench._previous_same_config(m, 4, True, "img64", True)
+    assert (value, source) == (5.29, "bench_history.json")
+    # overwrite is atomic and keyed
+    bench._record_history(m, 4, True, 6.0, "img64", True)
+    hist = json.loads((tmp_path / "bench_history.json").read_text())
+    key = bench._config_key(m, 4, True, "img64", True)
+    assert hist[key]["value"] == 6.0 and len(hist) == 1
+    # corrupt file degrades to no-prior instead of crashing
+    (tmp_path / "bench_history.json").write_text("{truncated")
+    assert bench._previous_same_config(m, 4, True, "img64", True) == (None, None)
+
+
+def test_append_baseline_check_accepts_and_refuses(tmp_path):
+    from scripts import append_baseline
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"metric": "x", "value": 1.0,
+                                "detail": {"device": "cpu"}}) + "\n")
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"metric": "x", "value": 0.0,
+                               "detail": {"infrastructure_failure": True}}) + "\n")
+    assert append_baseline.load_record(str(good))["value"] == 1.0
+    rec = append_baseline.load_record(str(bad))
+    assert rec["detail"]["infrastructure_failure"]
