@@ -1,0 +1,80 @@
+"""Causal ring-attention load balance: contiguous vs striped layout.
+
+The lock-step ring's wall clock is set by the BUSIEST device at each hop
+(every hop ends in a ppermute barrier). This bench computes the EXACT
+per-(device, hop) attention work for both layouts — pure mask
+combinatorics, no hardware needed — and reports the makespan ratio, i.e.
+how much faster the striped layout finishes the same causal attention.
+
+Work model: one unit per (query, key) pair the mask admits. Contiguous
+layout: device d owns rows [d*S/p, (d+1)*S/p); the hop visiting shard
+``src`` is full (src < d), triangular (src == d), or empty (src > d).
+Striped layout (stripe_shard): device d owns rows {d, d+p, ...}; every
+hop is an inclusive or strict triangle of near-identical size.
+
+Prints ONE JSON line. Exact by construction; the measured-numerics side
+(striped output == dense causal, values and grads) is pinned in
+tests/test_ring_flash.py.
+
+  python benchmarks/ring_balance.py            # p=8, S=4096
+  BENCH_SP=16 BENCH_SEQ=65536 python benchmarks/ring_balance.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def hop_work(p: int, s_local: int, layout: str) -> np.ndarray:
+    """work[d, h] = admitted (q, k) pairs on device d at hop h."""
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(f"unknown layout {layout!r}")
+    work = np.zeros((p, p), dtype=np.int64)
+    tri_incl = s_local * (s_local + 1) // 2
+    tri_strict = s_local * (s_local - 1) // 2
+    full = s_local * s_local
+    for d in range(p):
+        for h in range(p):
+            src = (d - h) % p
+            if layout == "contiguous":
+                work[d, h] = full if src < d else (tri_incl if src == d else 0)
+            else:  # striped: q global = jq*p + d, k global = jk*p + src
+                work[d, h] = tri_incl if src <= d else tri_strict
+    return work
+
+
+def main():
+    p = int(os.environ.get("BENCH_SP", "8"))
+    S = int(os.environ.get("BENCH_SEQ", "4096"))
+    if S % p:
+        raise SystemExit(f"BENCH_SEQ {S} not divisible by BENCH_SP {p}")
+    s_local = S // p
+
+    out = {"metric": "causal_ring_balance", "sp": p, "seq": S}
+    makespans = {}
+    for layout in ("contiguous", "striped"):
+        w = hop_work(p, s_local, layout)
+        # Lock-step: each hop costs its busiest device; total work is the
+        # full causal triangle either way (exactness cross-check).
+        makespan = int(w.max(axis=0).sum())
+        total = int(w.sum())
+        assert total == S * (S + 1) // 2, (layout, total)
+        makespans[layout] = makespan
+        out[layout] = {
+            "makespan_units": makespan,
+            "busiest_device_share": round(float(w.sum(axis=1).max() / total), 4),
+            "idle_fraction": round(1.0 - total / (makespan * p), 4),
+        }
+    out["striped_speedup"] = round(
+        makespans["contiguous"] / makespans["striped"], 4
+    )
+    # Limit p -> inf, s_local fixed: contiguous makespan -> p * full-block
+    # hops on the last device vs striped -> p * half-block hops: ratio -> 2.
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

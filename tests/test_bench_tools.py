@@ -93,3 +93,21 @@ def test_append_baseline_check_accepts_and_refuses(tmp_path):
     assert append_baseline.load_record(str(good))["value"] == 1.0
     rec = append_baseline.load_record(str(bad))
     assert rec["detail"]["infrastructure_failure"]
+
+
+def test_ring_balance_combinatorics():
+    """The analytic ring-balance bench conserves total causal work in both
+    layouts and the striped makespan approaches the 2x asymptote."""
+    from benchmarks.ring_balance import hop_work
+
+    p, s_local = 8, 64
+    S = p * s_local
+    for layout in ("contiguous", "striped"):
+        w = hop_work(p, s_local, layout)
+        assert int(w.sum()) == S * (S + 1) // 2  # exact causal triangle
+    contig = hop_work(p, s_local, "contiguous")
+    striped = hop_work(p, s_local, "striped")
+    ratio = contig.max(axis=0).sum() / striped.max(axis=0).sum()
+    assert 1.7 < ratio < 2.0
+    # striped per-hop spread is at most one diagonal (s_local units)
+    assert int(striped.max() - striped.min()) == s_local
