@@ -39,6 +39,16 @@ def ensure_virtual_cpu_flags(n: int) -> None:
 
     Only effective before jax initializes backends; appends or raises the
     ``--xla_force_host_platform_device_count`` flag as needed.
+
+    Also forces single-threaded Eigen kernels: the virtual devices share
+    ONE intra-op thread pool, and a collective program whose per-partition
+    compute contains pool-parallel Eigen ops (matmuls past Eigen's
+    inline-execution threshold, e.g. a 500-wide MLP) can deadlock — the
+    partitions already blocked inside the all-reduce rendezvous occupy the
+    pool while the last partition's matmul waits for pool capacity, and
+    XLA's 40s rendezvous termination kills the process. Single-threaded
+    Eigen makes every partition's compute self-contained. Real TPUs don't
+    share an intra-op pool across chips; this is simulation-only plumbing.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
@@ -48,6 +58,8 @@ def ensure_virtual_cpu_flags(n: int) -> None:
         flags = flags.replace(
             m.group(0), f"--xla_force_host_platform_device_count={n}"
         )
+    if n > 1 and "--xla_cpu_multi_thread_eigen" not in flags:
+        flags += " --xla_cpu_multi_thread_eigen=false"
     os.environ["XLA_FLAGS"] = flags
 
 
@@ -75,6 +87,15 @@ def force_platform(platform: str | None, num_virtual_cpu: int | None = None) -> 
         )
     if platform == "cpu" and num_virtual_cpu:
         ensure_virtual_cpu_flags(num_virtual_cpu)
+    elif platform == "cpu":
+        # Virtual devices may come from a pre-set XLA_FLAGS env instead of
+        # num_virtual_cpu — the Eigen single-threading (see
+        # ensure_virtual_cpu_flags) must cover that route too, or the
+        # collective-rendezvous deadlock it prevents stays live.
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m and int(m.group(1)) > 1:
+            ensure_virtual_cpu_flags(int(m.group(1)))
     import jax
 
     name = {"tpu": "axon,cpu", "axon": "axon,cpu"}.get(platform, platform)

@@ -11,6 +11,16 @@ signal/background label) is generated instead. Point ``--csv`` at the real
 file to reproduce the original pipeline.
 
 Run: python examples/workflow.py [--csv path] [--trainers adag,downpour]
+
+Simulation caveat (virtual CPU devices only, real chips unaffected): the
+``sync`` trainer's 8-partition all-reduce over 8 VIRTUAL devices on one
+oversubscribed host core is timing-fragile for this 500-wide model —
+XLA:CPU's collective rendezvous hard-kills the process after 40s if a
+partition thread is starved (``rendezvous.cc: Termination timeout``).
+``utils/platform.py`` already forces single-threaded Eigen kernels to
+remove the main deadlock mode; if the kill still triggers on a loaded
+host, re-run with fewer virtual devices (``--devices 4``) or run sync
+standalone. Small models (the entire test suite) never hit it.
 """
 
 import argparse
@@ -42,8 +52,8 @@ TRAINERS = {
     "single": lambda m, a, c: dk.SingleTrainer(m, **c),
     "downpour": lambda m, a, c: dk.DOWNPOUR(m, num_workers=a.workers, communication_window=8, **c),
     "adag": lambda m, a, c: dk.ADAG(m, num_workers=a.workers, communication_window=8, **c),
-    "aeasgd": lambda m, a, c: dk.AEASGD(m, num_workers=a.workers, communication_window=8, rho=2.0, **c),
-    "eamsgd": lambda m, a, c: dk.EAMSGD(m, num_workers=a.workers, communication_window=8, rho=2.0, momentum=0.8, **c),
+    "aeasgd": lambda m, a, c: dk.AEASGD(m, num_workers=a.workers, communication_window=8, rho=20.0, **c),
+    "eamsgd": lambda m, a, c: dk.EAMSGD(m, num_workers=a.workers, communication_window=8, rho=20.0, momentum=0.8, **c),
     "dynsgd": lambda m, a, c: dk.DynSGD(m, num_workers=a.workers, communication_window=8, **c),
     "sync": lambda m, a, c: dk.SynchronousDistributedTrainer(m, **c),
     "averaging": lambda m, a, c: dk.AveragingTrainer(m, num_workers=a.workers, **c),

@@ -15,8 +15,12 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 # pre-existing count rather than deferring to it.
 _flags = os.environ.get("XLA_FLAGS", "")
 _flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+# Single-threaded Eigen: the 8 virtual devices share one intra-op pool,
+# and pool-parallel kernels inside collective programs can deadlock the
+# all-reduce rendezvous (see utils/platform.ensure_virtual_cpu_flags).
 os.environ["XLA_FLAGS"] = (
     _flags + " --xla_force_host_platform_device_count=8"
+    " --xla_cpu_multi_thread_eigen=false"
 ).strip()
 
 # The container's axon sitecustomize force-selects the TPU platform even
