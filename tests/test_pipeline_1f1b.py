@@ -145,8 +145,13 @@ def test_pipeline_trainer_1f1b_matches_gpipe():
     h2 = t_gpipe.get_history()
     assert len(h1) == len(h2)
     assert h1[-1]["loss"] < h1[0]["loss"]
+    # Trajectory (not single-step) comparison: the two schedules reduce in
+    # different orders, and Adam compounds the float noise over 2 epochs —
+    # measured drift reached 2.2e-3 under single-threaded-Eigen kernels.
+    # A real convention bug (e.g. the 1/dp cotangent mis-scale this test
+    # once caught) diverges by orders of magnitude within a few steps.
     for a, b in zip(h1, h2):
-        assert abs(a["loss"] - b["loss"]) < 2e-3, (a, b)
+        assert abs(a["loss"] - b["loss"]) < 5e-3, (a, b)
 
 
 def test_pipeline_trainer_1f1b_rejects_unsupported():
