@@ -97,6 +97,7 @@ def test_bert_with_ulysses_attention_trains(rng):
     )
 
 
+@pytest.mark.slow  # ~11s: grad parity through the pallas interpreter
 def test_ulysses_with_flash_local_matches_dense(rng):
     """Ulysses composed with the Pallas flash kernel as the local attention:
     values and gradients match the dense local default — no O(S^2) local
