@@ -131,8 +131,8 @@ def test_ulysses_with_flash_local_matches_dense(rng):
                                    atol=2e-3, rtol=2e-3)
 
 
-@pytest.mark.slow  # two full BERT applies; the op-level parity test above
-def test_bert_ulysses_flash_model_wiring(rng):  # stays in the fast lane
+@pytest.mark.slow  # two full BERT applies (~17s)
+def test_bert_ulysses_flash_model_wiring(rng):
     """BertConfig(sp_impl="ulysses", use_flash_attention=True) dispatches
     to the flash-local composition: logits match the plain dense model on
     identical weights (a typo in the SelfAttention branch cannot hide)."""
