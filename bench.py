@@ -344,9 +344,15 @@ def _previous_same_config(metric: str, batch: int, on_cpu: bool,
             continue
         if bool(det.get("forced_cpu")) != forced:
             continue
+        # Rows can carry a missing/null value (e.g. an aborted measurement
+        # child still wrote its record skeleton); skip them instead of
+        # crashing the comparison on float(None).
+        val = rec.get("value")
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
         rnd = int(m.group(1))
         if best is None or rnd > best[0]:
-            best = (rnd, float(rec["value"]), os.path.basename(path))
+            best = (rnd, float(val), os.path.basename(path))
     if best is not None:
         return best[1], best[2]
     try:

@@ -1,0 +1,197 @@
+"""Serving latency under load: the first benchmark in this repo that
+measures LATENCY, not training throughput.
+
+Drives the continuous-batching engine (:mod:`distkeras_tpu.serving`) with
+two canonical load shapes:
+
+- **closed-loop**: C concurrent clients, each submitting its next request
+  the moment the previous one completes — measures saturated-engine
+  behavior (slot occupancy, tokens/sec goodput);
+- **open-loop**: Poisson arrivals at an offered rate λ req/s regardless
+  of completions — measures the latency/load curve an SLO cares about
+  (p50/p95/p99 TTFT, queue growth, backpressure rejects when λ exceeds
+  capacity).
+
+Also verifies the two engine invariants the subsystem is built on, so a
+CPU demo run IS the acceptance test:
+
+1. admission never retraces decode — exactly ONE compiled decode
+   executable after the whole run (compile-count probe);
+2. continuous-batched greedy streams match one-shot ``generate()``
+   token-for-token for the same prompts.
+
+Run (CPU):
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py \
+        --mode both --requests 24 --slots 4 --metrics-out /tmp/serve.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+def _build(args):
+    from distkeras_tpu.models.bert import gpt_small, gpt_tiny
+    from distkeras_tpu.serving import ServingEngine, ServingMetrics
+    from distkeras_tpu.tracing import MetricStream
+
+    model = (gpt_tiny(seq_len=args.seq_len, vocab_size=args.vocab)
+             if args.model == "gpt_tiny" else gpt_small(seq_len=args.seq_len))
+    variables = model.init(0)
+    stream = (MetricStream.to_jsonl(args.metrics_out)
+              if args.metrics_out else None)
+    engine = ServingEngine(
+        model, variables, slots=args.slots, max_queue=args.max_queue,
+        metrics=ServingMetrics(stream))
+    return model, variables, engine, stream
+
+
+def _prompts(args, n):
+    # Lengths from a small fixed set: the engine handles any length, but
+    # the parity cross-check's generate() compiles once per distinct
+    # prompt shape — a handful of lengths keeps a CPU demo run fast.
+    rng = np.random.default_rng(args.seed)
+    pool = [k for k in (3, 5, 8, 13) if k < args.seq_len // 2] or [3]
+    lens = rng.choice(pool, size=n)
+    return [rng.integers(0, args.vocab, size=int(k)).tolist() for k in lens]
+
+
+async def _closed_loop(engine, prompts, args):
+    """C clients, each chaining requests back-to-back."""
+    results: list[tuple[list[int], list[int]]] = []
+    it = iter(prompts)
+
+    async def client():
+        for p in it:
+            req = engine.submit(p, args.new_tokens)
+            toks = await req.result()
+            results.append((p, toks))
+
+    await asyncio.gather(*(client() for _ in range(args.clients)))
+    return results
+
+
+async def _open_loop(engine, prompts, args):
+    """Poisson arrivals at --rate req/s; rejects counted, not retried."""
+    rng = np.random.default_rng(args.seed + 1)
+    from distkeras_tpu.serving import QueueFullError
+
+    pending, rejects, results = [], 0, []
+    for p in prompts:
+        try:
+            pending.append((p, engine.submit(p, args.new_tokens)))
+        except QueueFullError:
+            rejects += 1
+        await asyncio.sleep(float(rng.exponential(1.0 / args.rate)))
+    for p, req in pending:
+        results.append((p, await req.result()))
+    return results, rejects
+
+
+def _check_parity(model, variables, results, new_tokens):
+    from distkeras_tpu.inference.generate import generate
+
+    mismatches = 0
+    seen: dict[tuple, list[int]] = {}
+    for p, got in results:
+        key = tuple(p)
+        if key not in seen:
+            seen[key] = generate(model, variables, np.asarray([p], np.int32),
+                                 new_tokens, greedy=True)[0].tolist()
+        mismatches += got != seen[key]
+    return mismatches
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="both",
+                    choices=["closed", "open", "both"])
+    ap.add_argument("--model", default="gpt_tiny",
+                    choices=["gpt_tiny", "gpt_small"])
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--clients", type=int, default=6,
+                    help="closed-loop concurrency")
+    ap.add_argument("--rate", type=float, default=30.0,
+                    help="open-loop offered load, req/s")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--metrics-out", default=None)
+    ap.add_argument("--skip-parity", action="store_true",
+                    help="skip the generate() cross-check (pure load run)")
+    args = ap.parse_args()
+
+    from distkeras_tpu.serving import ServingMetrics
+
+    model, variables, engine, stream = _build(args)
+    report = {"config": {
+        "model": args.model, "slots": args.slots, "requests": args.requests,
+        "new_tokens": args.new_tokens, "mode": args.mode,
+    }}
+
+    async def run_mode(mode):
+        task = asyncio.create_task(engine.run())
+        t0 = time.monotonic()
+        if mode == "closed":
+            results = await _closed_loop(engine, _prompts(args, args.requests), args)
+            rejects = 0
+        else:
+            results, rejects = await _open_loop(
+                engine, _prompts(args, args.requests), args)
+        elapsed = time.monotonic() - t0
+        engine.shutdown(drain=True)
+        await task
+        return results, rejects, elapsed
+
+    async def run_all():
+        # One event loop for every phase: asyncio primitives bind to the
+        # loop they first run on, so sequential asyncio.run loops would
+        # strand the engine's scheduler (reopen() also guards this).
+        all_results = []
+        for mode in (["closed", "open"] if args.mode == "both"
+                     else [args.mode]):
+            # Fresh metrics per phase (shared JSONL stream): the report's
+            # per-mode percentiles must cover THIS load shape only, and
+            # tokens_per_sec must divide by this phase's clock.
+            engine.metrics = ServingMetrics(stream)
+            results, rejects, elapsed = await run_mode(mode)
+            all_results.extend(results)
+            done_tokens = sum(len(t) for _, t in results)
+            summary = engine.metrics.emit_summary()
+            report[mode] = {
+                "completed": len(results),
+                "rejected_queue_full": rejects,
+                "wall_s": round(elapsed, 3),
+                "goodput_tokens_per_sec": round(done_tokens / elapsed, 2),
+                **{k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in summary.items()
+                   if k.startswith(("ttft", "inter_token", "queue", "slot",
+                                    "tokens_per_sec", "requests"))},
+            }
+            engine.reopen()
+        return all_results
+
+    all_results = asyncio.run(run_all())
+
+    compiles = engine.decode_compile_count()
+    report["decode_compile_count"] = compiles
+    assert compiles in (1, -1), (
+        f"continuous batching retraced the decode step: {compiles} "
+        "compiled executables (expected exactly 1)")
+    if not args.skip_parity:
+        mism = _check_parity(model, variables, all_results, args.new_tokens)
+        report["parity_mismatches"] = mism
+        assert mism == 0, f"{mism} streams diverged from one-shot generate()"
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

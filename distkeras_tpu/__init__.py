@@ -57,6 +57,7 @@ from distkeras_tpu.inference.evaluators import (
     PrecisionRecallEvaluator,
 )
 from distkeras_tpu.inference.generate import Generator, beam_search, generate
+from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.utils.config import TrainerConfig
 
 __all__ = [
@@ -88,5 +89,6 @@ __all__ = [
     "generate",
     "beam_search",
     "Generator",
+    "ServingEngine",
     "TrainerConfig",
 ]

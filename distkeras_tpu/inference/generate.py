@@ -28,7 +28,10 @@ import numpy as np
 __all__ = ["generate", "beam_search", "Generator"]
 
 
-def _decode_module(model):
+def _decode_module(model, slots: bool = False):
+    """Decode-mode twin of ``model``'s module (same params, KV-cache
+    attention). ``slots=True`` selects the per-slot vector-index variant
+    that the continuous-batching engine (serving/engine.py) steps."""
     from distkeras_tpu.models.bert import Bert, BertConfig
 
     cfg = getattr(model, "config", None)
@@ -43,10 +46,25 @@ def _decode_module(model):
             "generation requires a decoder LM"
         )
     dec_cfg = dataclasses.replace(
-        cfg, decode=True, dropout_rate=0.0, ring_mesh=None,
-        use_flash_attention=False,
+        cfg, decode=True, decode_slots=slots, dropout_rate=0.0,
+        ring_mesh=None, use_flash_attention=False,
     )
     return Bert(dec_cfg), dec_cfg
+
+
+def _trained_len(model, dec_cfg) -> int:
+    # `or` (not a getattr default): Model allows input_shape=None (e.g.
+    # from_keras with no input shape) — falsy values fall back too.
+    shape = getattr(model, "input_shape", None) or (dec_cfg.max_seq_len,)
+    return shape[0]
+
+
+def _context_limit(model, dec_cfg) -> int:
+    """Decodable context bound: the TRAINED length, not cache capacity —
+    positions past what training touched hold randomly-initialized
+    positional embeddings. Shared with the serving engine's admission
+    validation."""
+    return min(dec_cfg.max_seq_len, _trained_len(model, dec_cfg))
 
 
 def _check_context(model, dec_cfg, prompt, max_new_tokens: int):
@@ -59,11 +77,8 @@ def _check_context(model, dec_cfg, prompt, max_new_tokens: int):
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     S0 = prompt.shape[1]
-    # `or` (not a getattr default): Model allows input_shape=None (e.g.
-    # from_keras with no input shape) — falsy values fall back too.
-    trained_shape = getattr(model, "input_shape", None) or (dec_cfg.max_seq_len,)
-    trained_len = trained_shape[0]
-    limit = min(dec_cfg.max_seq_len, trained_len)
+    trained_len = _trained_len(model, dec_cfg)
+    limit = _context_limit(model, dec_cfg)
     if S0 + max_new_tokens > limit:
         raise ValueError(
             f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) exceeds "
@@ -105,6 +120,22 @@ def _empty_cache(module, batch_size: int):
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
+def sample_rows(logits, temps, key, top_k):
+    """Per-row sampling over ``[B, V]`` logits: rows with ``temps <= 0``
+    take argmax (greedy), the rest sample at their own temperature with
+    optional top-k filtering. The ONE sampling implementation — shared by
+    :func:`generate` and the serving engine's per-slot decode step so the
+    two inference paths stay provably token-identical."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if top_k is not None:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("module", "max_new_tokens", "top_k", "greedy"),
@@ -117,12 +148,11 @@ def _generate_jit(module, params, prompt, rng, max_new_tokens, temperature,
     def sample(logits, key):
         logits = logits.astype(jnp.float32)
         if greedy:
+            # Static greedy skips the categorical entirely (no dead
+            # sampling branch in the compiled program).
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / jnp.maximum(temperature, 1e-6)
-        if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        temps = jnp.broadcast_to(temperature, logits.shape[:1])
+        return sample_rows(logits, temps, key, top_k)
 
     # Prefill: one big forward over the whole prompt fills every layer's
     # KV cache and yields the first next-token distribution.

@@ -68,6 +68,14 @@ class BertConfig:
     # (inference/generate.py). Params are layout-identical to the
     # decode=False model, so trained weights drop in.
     decode: bool = False
+    # Continuous-batching decode (serving/engine.py): the cache and
+    # positional indices become per-batch-row VECTORS ``[B]`` instead of
+    # one shared scalar, so each batch slot can sit at a different
+    # sequence position — the property that lets a serving engine admit a
+    # new request into a free slot while other slots are mid-decode,
+    # inside one compiled step. Requires ``decode=True``; params are
+    # still layout-identical to the training model.
+    decode_slots: bool = False
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -166,6 +174,7 @@ class SelfAttention(nn.Module):
         decode (S = 1): new K/V write at the cache index, the query attends
         to the full fixed-length cache under a global-position mask, and the
         index advances by S — every shape static for XLA."""
+        import jax
         import jax.lax as lax
 
         cfg = self.cfg
@@ -173,21 +182,39 @@ class SelfAttention(nn.Module):
         L = cfg.max_seq_len
         ck = self.variable("cache", "cached_key", jnp.zeros, (B, L, H, D), cfg.dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros, (B, L, H, D), cfg.dtype)
+        idx_shape = (B,) if cfg.decode_slots else ()
         ci = self.variable("cache", "cache_index",
-                           lambda: jnp.zeros((), jnp.int32))
+                           lambda: jnp.zeros(idx_shape, jnp.int32))
         if self.is_initializing():
             return dot_product_attention(q, k, v, causal=True)
         idx = ci.value
-        ck.value = lax.dynamic_update_slice(
-            ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
-        )
-        cv.value = lax.dynamic_update_slice(
-            cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
-        )
-        ci.value = idx + S
-        q_pos = idx + jnp.arange(S)  # global positions of these queries
-        k_pos = jnp.arange(L)
-        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,S,L]
+        if cfg.decode_slots:
+            # Per-slot positions: each row writes its K/V at its OWN cache
+            # index and masks against its own position — slots at different
+            # sequence depths coexist in one compiled step. A freed slot's
+            # index keeps advancing on garbage tokens, hence the clamp (the
+            # OOB write lands at L-S and is overwritten on re-admission).
+            write = jax.vmap(
+                lambda c, u, i: lax.dynamic_update_slice(c, u, (i, 0, 0))
+            )
+            ck.value = write(ck.value, k.astype(ck.value.dtype), idx)
+            cv.value = write(cv.value, v.astype(cv.value.dtype), idx)
+            ci.value = jnp.minimum(idx + S, L)
+            q_pos = idx[:, None] + jnp.arange(S)[None, :]  # [B, S]
+            k_pos = jnp.arange(L)
+            # [B,1,S,L]: row b's queries see cache positions <= their own.
+            mask = k_pos[None, None, None, :] <= q_pos[:, None, :, None]
+        else:
+            ck.value = lax.dynamic_update_slice(
+                ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0)
+            )
+            cv.value = lax.dynamic_update_slice(
+                cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0)
+            )
+            ci.value = idx + S
+            q_pos = idx + jnp.arange(S)  # global positions of these queries
+            k_pos = jnp.arange(L)
+            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,S,L]
         return dot_product_attention(q, ck.value, cv.value, mask=mask)
 
 
@@ -262,20 +289,33 @@ class Bert(nn.Module):
         S = token_ids.shape[1]
         if cfg.decode:
             # Positions advance with the KV caches: a cache-collection
-            # counter offsets the positional slice per apply.
+            # counter offsets the positional slice per apply (a vector of
+            # per-slot counters under decode_slots — each batch row slices
+            # the positional table at its own depth).
+            B = token_ids.shape[0]
+            pi_shape = (B,) if cfg.decode_slots else ()
             pi = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                "cache", "pos_index", lambda: jnp.zeros(pi_shape, jnp.int32)
             )
             if self.is_initializing():
                 pos = pos_embed[:, :S]
             else:
+                import jax
                 import jax.lax as lax
 
-                pos = lax.dynamic_slice(
-                    pos_embed, (0, pi.value, 0),
-                    (1, S, cfg.hidden_size),
-                )
-                pi.value = pi.value + S
+                if cfg.decode_slots:
+                    pos = jax.vmap(
+                        lambda i: lax.dynamic_slice(
+                            pos_embed[0], (i, 0), (S, cfg.hidden_size)
+                        )
+                    )(pi.value)  # [B, S, H]
+                    pi.value = jnp.minimum(pi.value + S, cfg.max_seq_len)
+                else:
+                    pos = lax.dynamic_slice(
+                        pos_embed, (0, pi.value, 0),
+                        (1, S, cfg.hidden_size),
+                    )
+                    pi.value = pi.value + S
             x = embed(token_ids) + pos.astype(cfg.dtype)
         else:
             x = embed(token_ids) + pos_embed[:, :S].astype(cfg.dtype)

@@ -64,6 +64,9 @@ parallelism absent from the reference entirely).
 
 from __future__ import annotations
 
+from distkeras_tpu.utils.platform import axis_size as _axis_size
+from distkeras_tpu.utils.platform import pcast as _pcast
+
 from functools import partial
 
 import jax
@@ -105,7 +108,7 @@ def _1f1b_local(
     deadlock (the reason everything else is pcast varying below).
     """
     d = lax.axis_index(axis_name)
-    num_devices = lax.axis_size(axis_name)
+    num_devices = _axis_size(axis_name)
     M, B = microbatches.shape[0], microbatches.shape[1]
     feat = microbatches.shape[2:]
     dtype = microbatches.dtype
@@ -118,9 +121,12 @@ def _1f1b_local(
     bwd_perm = [(i, (i - 1) % Pd) for i in range(Pd)]
 
     def varying(x):
-        have = getattr(jax.typeof(x), "vma", ())
+        # Pre-VMA jax has no jax.typeof/vma tracking; _pcast is identity
+        # there, so "need everything" is both safe and correct.
+        typeof = getattr(jax, "typeof", None)
+        have = getattr(typeof(x), "vma", ()) if typeof is not None else ()
         need = tuple(a for a in all_axes if a not in have)
-        return lax.pcast(x, need, to="varying") if need else x
+        return _pcast(x, need, to="varying") if need else x
 
     # CRITICAL: the head params must be varying before any vjp touches
     # them. Taking a cotangent w.r.t. an axis-INVARIANT input makes JAX
@@ -392,7 +398,7 @@ def _1f1b_local(
     # caller's embedding vjp lands gradients on the same scale as the
     # stage/head grads (they stay dp-sharded like the inputs).
     for ax in varying_axes:
-        cot_out = cot_out / lax.axis_size(ax)
+        cot_out = cot_out / _axis_size(ax)
     stage_grads = jax.tree.map(lambda g: g[None], stage_grads)
     out = (loss,)
     if with_aux:
@@ -455,7 +461,9 @@ def pipeline_1f1b_value_and_grad(
     buffer); total residency adds one M-sized input-cotangent buffer —
     ``(min(P, M) + M)`` states, see the module docstring.
     """
-    from jax import shard_map
+    from distkeras_tpu.utils.platform import get_shard_map
+
+    shard_map = get_shard_map()
 
     if io_spec is None:
         io_spec = P()

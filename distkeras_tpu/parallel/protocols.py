@@ -264,7 +264,11 @@ class AEASGDProtocol(AsyncProtocol):
         # directions, and the mirror's own rounding cancels out of the
         # reconstruction (local_est - local = bf16(δ) - δ regardless of the
         # mirror's absolute error), so bf16 halves the PS's dominant host
-        # cost at no wire-accuracy cost. Both sides round with the SAME
+        # cost. Accuracy note: δ = local - mirror now carries the mirror's
+        # PARAMETER-scale bf16 residual, so the per-window reconstruction
+        # error grows by roughly |param|·2^-18 on top of the |update|·2^-9
+        # wire rounding a float32 mirror already had — benign for elastic
+        # averaging, but not free. Both sides round with the SAME
         # round-to-nearest-even cast in the same expression order, keeping
         # the mirrors bit-identical. "float32" restores the old behavior.
         if mirror_dtype not in ("bfloat16", "float32"):

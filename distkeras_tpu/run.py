@@ -10,6 +10,10 @@ Example config:
     {"trainer": "ADAG", "worker_optimizer": "adam", "learning_rate": 1e-3,
      "num_workers": 4, "batch_size": 64, "num_epoch": 2,
      "communication_window": 12}
+
+Online serving (``python -m distkeras_tpu.run serve --model gpt_tiny
+--port 8500``) starts the continuous-batching TCP server
+(:mod:`distkeras_tpu.serving`) over a causal LM from the zoo.
 """
 
 from __future__ import annotations
@@ -29,6 +33,8 @@ MODEL_ZOO = {
     "resnet50": ("distkeras_tpu.models.resnet", "resnet50"),
     "bert_tiny_mlm": ("distkeras_tpu.models.bert", "bert_tiny_mlm"),
     "bert_base_mlm": ("distkeras_tpu.models.bert", "bert_base_mlm"),
+    "gpt_tiny": ("distkeras_tpu.models.bert", "gpt_tiny"),
+    "gpt_small": ("distkeras_tpu.models.bert", "gpt_small"),
 }
 
 
@@ -56,7 +62,86 @@ def load_data(path: str, features_col: str, label_col: str):
     )
 
 
+def serve_main(argv=None) -> int:
+    """``serve`` subcommand: continuous-batching TCP server over a causal
+    LM from the zoo (random-init demo weights unless --weights given)."""
+    ap = argparse.ArgumentParser(prog="distkeras_tpu.run serve")
+    ap.add_argument("--model", default="gpt_tiny",
+                    help="causal LM from the zoo (gpt_tiny/gpt_small)")
+    ap.add_argument("--model-args", default="{}",
+                    help="JSON kwargs for the model fn")
+    ap.add_argument("--weights", default=None,
+                    help="serialized-pytree weights (save_weights output); "
+                         "random init when omitted")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8500, help="0 = ephemeral")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="decode batch width (concurrent requests)")
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="admission queue depth before queue_full rejects")
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--metrics-out", default=None,
+                    help="JSONL per-iteration serving metrics")
+    args = ap.parse_args(argv)
+
+    import asyncio
+
+    from distkeras_tpu.serving import (
+        ServingEngine, ServingMetrics, ServingServer,
+    )
+    from distkeras_tpu.tracing import MetricStream
+
+    model = load_model(args.model, json.loads(args.model_args))
+    variables = model.init(args.seed)
+    if args.weights:
+        from distkeras_tpu.utils.pytree import deserialize_pytree
+
+        variables = deserialize_pytree(
+            open(args.weights, "rb").read(), like=variables)
+    metrics = ServingMetrics(
+        MetricStream.to_jsonl(args.metrics_out) if args.metrics_out else None)
+    engine = ServingEngine(
+        model, variables, slots=args.slots, max_queue=args.max_queue,
+        top_k=args.top_k, metrics=metrics, seed=args.seed)
+    server = ServingServer(engine, host=args.host, port=args.port)
+
+    async def go():
+        import signal
+
+        await server.start()
+        print(json.dumps({
+            "serving": args.model, "host": args.host, "port": server.port,
+            "slots": args.slots, "max_queue": args.max_queue,
+        }), flush=True)
+        # Signal-driven shutdown INSIDE the loop: a raw KeyboardInterrupt
+        # out of asyncio.run would cancel the engine task before the
+        # drain, skipping the graceful stop and the summary line.
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+        await stop.wait()
+        await server.stop(drain=True)
+        print(json.dumps(
+            {k: round(v, 6) for k, v in metrics.summary().items()}),
+            flush=True)
+
+    try:
+        asyncio.run(go())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        return serve_main(argv[1:])
     ap = argparse.ArgumentParser(prog="distkeras_tpu.run")
     ap.add_argument("--config", required=True, help="TrainerConfig JSON file")
     ap.add_argument("--data", required=True, help=".npz (features/label) or CSV")

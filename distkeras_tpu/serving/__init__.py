@@ -1,0 +1,46 @@
+"""Online serving: a continuous-batching decode engine over the KV-cache
+generation stack (:mod:`distkeras_tpu.inference.generate`).
+
+The reference's inference surface is batch-transform only
+(``distkeras/predictors.py``: map a fixed model over a DataFrame); this
+package closes the ROADMAP's "serve heavy traffic" gap with an online
+request path:
+
+- :class:`ServingEngine` — fixed-slot continuous batching: one compiled
+  decode step for the lifetime of the server, requests admitted into free
+  slots mid-decode (no retrace, no drain);
+- :class:`Scheduler` / :class:`Request` — priority-FIFO admission with
+  max-depth backpressure and per-request deadlines;
+- :class:`ServingServer` / :class:`ServingClient` — asyncio TCP front end
+  with newline-delimited-JSON streaming token output;
+- :class:`ServingMetrics` — TTFT / inter-token latency / occupancy
+  percentiles through :class:`distkeras_tpu.tracing.MetricStream`.
+"""
+
+from distkeras_tpu.serving.scheduler import (
+    EngineStopped,
+    QueueFullError,
+    Request,
+    RequestCancelled,
+    RequestTimeout,
+    Scheduler,
+    ServingError,
+)
+from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.server import ServingServer
+from distkeras_tpu.serving.client import ServingClient
+
+__all__ = [
+    "ServingEngine",
+    "Scheduler",
+    "Request",
+    "ServingServer",
+    "ServingClient",
+    "ServingMetrics",
+    "ServingError",
+    "QueueFullError",
+    "RequestTimeout",
+    "RequestCancelled",
+    "EngineStopped",
+]

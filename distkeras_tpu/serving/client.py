@@ -1,0 +1,124 @@
+"""Client for the serving TCP protocol (see :mod:`.server` for the wire
+format). Async-first with a sync convenience wrapper."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import AsyncIterator, Callable, Sequence
+
+from distkeras_tpu.serving.scheduler import (
+    EngineStopped,
+    QueueFullError,
+    RequestTimeout,
+    ServingError,
+)
+
+__all__ = ["ServingClient", "ServerError"]
+
+_CODE_TO_ERROR = {
+    "queue_full": QueueFullError,
+    "timeout": RequestTimeout,
+    "stopped": EngineStopped,
+}
+
+
+class ServerError(ServingError):
+    """Server-side failure that has no more specific typed class."""
+
+    code = "error"
+
+
+def _raise_for(rec: dict) -> None:
+    cls = _CODE_TO_ERROR.get(rec.get("code"), ServerError)
+    raise cls(rec.get("error", "server error"))
+
+
+class ServingClient:
+    """One TCP connection; requests run sequentially per connection (open
+    several clients for concurrency — the server batches across them)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8500):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+
+    async def connect(self) -> "ServingClient":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        return self
+
+    async def aclose(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            self._reader = self._writer = None
+
+    async def __aenter__(self) -> "ServingClient":
+        return await self.connect()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.aclose()
+
+    async def stream(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        priority: int = 0,
+        timeout: float | None = None,
+    ) -> AsyncIterator[int]:
+        """Yield token ids as the server streams them; raises the typed
+        :class:`ServingError` subclass matching the server's error code."""
+        if self._writer is None:
+            await self.connect()
+        spec = {
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "priority": int(priority),
+            "timeout": timeout,
+        }
+        self._writer.write((json.dumps(spec) + "\n").encode())
+        await self._writer.drain()
+        while True:
+            line = await self._reader.readline()
+            if not line:
+                raise ConnectionError("server closed the connection")
+            rec = json.loads(line)
+            if "token" in rec:
+                yield rec["token"]
+            elif rec.get("done"):
+                self.last_done = rec
+                return
+            else:
+                _raise_for(rec)
+
+    async def generate(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        on_token: Callable[[int], None] | None = None,
+        **kw,
+    ) -> dict:
+        """Collect a full generation; returns the server's ``done`` record
+        (``tokens``, ``ttft_ms``, ``latency_ms``)."""
+        async for tok in self.stream(prompt, max_new_tokens, **kw):
+            if on_token is not None:
+                on_token(tok)
+        return self.last_done
+
+    def generate_sync(self, prompt: Sequence[int], max_new_tokens: int,
+                      **kw) -> dict:
+        """Blocking one-shot convenience (opens and closes a connection)."""
+
+        async def go():
+            async with ServingClient(self.host, self.port) as c:
+                return await c.generate(prompt, max_new_tokens, **kw)
+
+        return asyncio.run(go())

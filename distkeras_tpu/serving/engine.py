@@ -1,0 +1,432 @@
+"""Continuous-batching decode engine: one compiled step, rotating slots.
+
+The offline path (:func:`distkeras_tpu.inference.generate.generate`)
+decodes a *closed* batch: every row starts together, the whole batch runs
+``max_new_tokens`` steps, stragglers pad out the scan. An online server
+cannot do that — requests arrive whenever they arrive, and draining the
+batch to admit one request wastes every other slot's compute.
+
+This engine keeps the shape discipline that makes the offline path fast
+(static ``[B_slots, max_seq_len, H, D]`` KV caches, ONE compiled decode
+step for the lifetime of the server) while making the batch *open*:
+
+- each of the ``slots`` rows of the decode batch is an independent
+  request at its **own** sequence position (``BertConfig.decode_slots``
+  turns the cache/positional indices into per-row vectors);
+- a finished request frees its row; a queued request is admitted between
+  decode iterations by a **prefill** program (compiled once per
+  power-of-two prompt-length bucket) whose single-row KV cache is spliced
+  into the live batch cache with ``dynamic_update_slice`` — the decode
+  step itself never retraces and never stops for admission;
+- free rows keep decoding garbage (their output is discarded) — the cost
+  of a fixed-shape batch, and exactly the trade the training side makes
+  with padded microbatches.
+
+Per-request sampling: ``temperature <= 0`` rows take the argmax branch
+inside the same compiled step (a ``jnp.where`` select, not a retrace), so
+greedy and sampled requests coexist in one batch. ``top_k`` is
+engine-wide static config.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distkeras_tpu.inference.generate import (
+    _check_context,
+    _context_limit,
+    _decode_module,
+    _empty_cache,
+    sample_rows,
+)
+from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.serving.scheduler import (
+    EngineStopped,
+    Request,
+    RequestCancelled,
+    RequestTimeout,
+    Scheduler,
+    ServingError,
+)
+
+__all__ = ["ServingEngine"]
+
+
+def _prefill_fn(module, top_k, params, padded, true_len, temp, key):
+    """Run a right-padded ``[1, P]`` prompt through the decode module,
+    producing the slot's KV cache and first sampled token.
+
+    Padding is benign: causal attention means real positions never see the
+    pad tail, the first token samples from the logits at ``true_len - 1``,
+    and the garbage K/V at ``[true_len, P)`` is masked out of every later
+    decode step (``k_pos <= q_pos``) until overwritten by real tokens. The
+    index leaves are rewound from ``P`` to ``true_len`` so decode resumes
+    at the real end of the prompt.
+    """
+    cache = _empty_cache(module, 1)
+    logits, mut = module.apply(
+        {"params": params, "cache": cache}, padded, train=False,
+        mutable=["cache"],
+    )
+    cache = jax.tree.map(
+        lambda a: jnp.full_like(a, true_len) if a.ndim == 1 else a,
+        mut["cache"],
+    )
+    last = jnp.take(logits[0], true_len - 1, axis=0)[None]  # [1, V]
+    tok = sample_rows(last, temp[None], key, top_k)[0]
+    return cache, tok
+
+
+def _admit_fn(cache, tokens, temps, slot, pre_cache, first_tok, temp):
+    """Splice a prefilled single-row cache into batch row ``slot``.
+
+    ``slot`` is a traced scalar, so one compiled program serves every
+    slot; every cache leaf carries the batch dim first in decode_slots
+    mode, so the splice is a uniform leading-axis dynamic_update_slice.
+    """
+    cache = jax.tree.map(
+        lambda big, small: lax.dynamic_update_slice(
+            big, small.astype(big.dtype), (slot,) + (0,) * (small.ndim - 1)
+        ),
+        cache, pre_cache,
+    )
+    tokens = tokens.at[slot].set(first_tok)
+    temps = temps.at[slot].set(temp)
+    return cache, tokens, temps
+
+
+def _decode_fn(module, top_k, params, cache, tokens, temps, key):
+    """ONE decode iteration for the whole slot batch ``[B] -> [B]``."""
+    logits, mut = module.apply(
+        {"params": params, "cache": cache}, tokens[:, None], train=False,
+        mutable=["cache"],
+    )
+    nxt = sample_rows(logits[:, -1], temps, key, top_k)
+    return mut["cache"], nxt
+
+
+@dataclasses.dataclass
+class _SlotState:
+    request: Request
+    remaining: int  # tokens still to decode after the prefill token
+    last_token_t: float
+
+
+class ServingEngine:
+    """Fixed-slot continuous-batching server core.
+
+    ``model``/``variables``: a causal LM from the zoo (gpt_tiny/gpt_small)
+    and its trained variables — the same pair :func:`generate` takes.
+    ``slots``: decode batch width (concurrent in-flight requests).
+    ``max_queue``: admission backpressure depth (:class:`QueueFullError`
+    beyond it). ``top_k``: engine-wide top-k sampling (None = full vocab).
+
+    Drive it with :meth:`submit` + :meth:`run` (asyncio); blocking device
+    work (prefill, decode step) runs in the default executor so the event
+    loop keeps accepting connections mid-decode.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        *,
+        slots: int = 4,
+        max_queue: int = 64,
+        top_k: int | None = None,
+        metrics: ServingMetrics | None = None,
+        seed: int = 0,
+        min_prefill_bucket: int = 8,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.model = model
+        self._module, self._cfg = _decode_module(model, slots=True)
+        if top_k is not None and not 1 <= top_k <= self._cfg.vocab_size:
+            # Same bound generate() enforces: out-of-range top_k would
+            # silently disable (or invert) the filtering via clamped
+            # indexing rather than fail loudly.
+            raise ValueError(
+                f"top_k={top_k} outside [1, vocab_size={self._cfg.vocab_size}]"
+            )
+        self._params = variables["params"]
+        self.limit = _context_limit(model, self._cfg)
+        self.slots = int(slots)
+        self.scheduler = Scheduler(max_depth=max_queue)
+        self.metrics = metrics or ServingMetrics()
+        self._min_bucket = int(min_prefill_bucket)
+        self._key = jax.random.PRNGKey(seed)
+
+        # Device-resident batch state.
+        self._cache = _empty_cache(self._module, self.slots)
+        self._tokens = jnp.zeros((self.slots,), jnp.int32)
+        self._temps = jnp.zeros((self.slots,), jnp.float32)
+        self._slot_state: list[_SlotState | None] = [None] * self.slots
+
+        # One jit wrapper per engine so compile counts are per-instance:
+        # the decode step must stay at exactly one executable for the
+        # server's lifetime (see decode_compile_count()). The live batch
+        # cache/tokens are donated — the engine rebinds them from each
+        # call's outputs, and donation keeps the multi-MB KV caches
+        # updating in place instead of copying per decoded token. _temps
+        # is NOT donated in decode (it persists across iterations).
+        self._prefill = jax.jit(functools.partial(_prefill_fn, self._module, top_k))
+        self._admit_jit = jax.jit(_admit_fn, donate_argnums=(0, 1, 2))
+        self._decode_step = jax.jit(
+            functools.partial(_decode_fn, self._module, top_k),
+            donate_argnums=(1, 2))
+
+        self._running = False
+        self._stopping = False
+        self._draining = True
+
+    # -- introspection ------------------------------------------------------
+    def decode_compile_count(self) -> int:
+        """Number of compiled decode executables (must stay 1: admission
+        must never retrace the decode step)."""
+        probe = getattr(self._decode_step, "_cache_size", None)
+        return int(probe()) if probe is not None else -1
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slot_state if s is not None)
+
+    @property
+    def free_slots(self) -> int:
+        return self.slots - self.active_slots
+
+    # -- submission ---------------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        priority: int = 0,
+        timeout: float | None = None,
+    ) -> Request:
+        """Validate and enqueue a request; returns the streaming handle.
+
+        Raises :class:`ValueError` (bad prompt / context overflow),
+        :class:`QueueFullError` (backpressure), or :class:`EngineStopped`
+        (shutting down) — all before any device work.
+        """
+        if self._stopping:
+            raise EngineStopped("engine is shutting down; not admitting")
+        prompt_arr = np.asarray(prompt, np.int32)
+        if prompt_arr.ndim == 2 and prompt_arr.shape[0] == 1:
+            prompt_arr = prompt_arr[0]
+        if prompt_arr.ndim != 1 or prompt_arr.size < 1:
+            raise ValueError(f"prompt must be a non-empty 1-D token list; "
+                             f"got shape {prompt_arr.shape}")
+        _check_context(self.model, self._cfg, prompt_arr[None, :],
+                       max_new_tokens)
+        req = Request(
+            prompt_arr.tolist(), max_new_tokens, temperature=temperature,
+            priority=priority, timeout=timeout,
+        )
+        try:
+            self.scheduler.submit(req)
+        except ServingError:
+            self.metrics.record_reject()
+            raise
+        return req
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop admitting. ``drain=True`` finishes in-flight requests
+        before :meth:`run` returns; ``drain=False`` errors them out."""
+        self._stopping = True
+        self._draining = drain
+        self.scheduler.kick()
+
+    def reopen(self) -> None:
+        """Re-arm admission after a drain shutdown. The compiled programs
+        and slot caches persist, so a bench can run several load phases on
+        one engine without re-paying compilation."""
+        if self._running:
+            raise RuntimeError("cannot reopen while run() is active")
+        self._stopping = False
+        self._draining = True
+        self.scheduler.reset_loop_state()
+
+    async def run(self, idle_poll_s: float = 0.05) -> None:
+        """Main loop: expire, admit, decode, stream — until shutdown."""
+        if self._running:
+            raise RuntimeError("engine.run() is already active")
+        self._running = True
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                now = time.monotonic()
+                # 1. Shed queued requests that died waiting: deadline
+                # passed, or caller cancelled (client disconnect).
+                for req in self.scheduler.expire(now):
+                    if req.cancelled:
+                        self._finish_error(req, RequestCancelled(
+                            "cancelled while queued"))
+                    else:
+                        self.metrics.record_expire()
+                        self._finish_error(req, RequestTimeout(
+                            f"deadline exceeded after {req.timeout}s in queue"))
+                # 2. Free active slots whose request died mid-decode.
+                for i, st in enumerate(self._slot_state):
+                    if st is None:
+                        continue
+                    dl = st.request.deadline
+                    if st.request.cancelled:
+                        self._finish_error(st.request, RequestCancelled(
+                            f"cancelled with {st.remaining} tokens undecoded"))
+                        self._slot_state[i] = None
+                    elif dl is not None and now > dl:
+                        self.metrics.record_expire()
+                        self._finish_error(st.request, RequestTimeout(
+                            f"deadline exceeded after {st.request.timeout}s "
+                            f"with {st.remaining} tokens undecoded"))
+                        self._slot_state[i] = None
+                # 3. Shutdown: flush the queue with typed errors.
+                if self._stopping:
+                    for req in self.scheduler.drain():
+                        self._finish_error(
+                            req, EngineStopped("engine shut down while queued"))
+                # 4. Admission: prefill queued requests into free slots.
+                # Device work runs in the executor; stream/metrics
+                # bookkeeping stays on the loop thread (asyncio queues and
+                # events are not thread-safe).
+                if not self._stopping:
+                    while self.free_slots and len(self.scheduler):
+                        req = self.scheduler.pop(now)
+                        if req is None:
+                            break
+                        slot = self._slot_state.index(None)
+                        # Queue wait ends HERE (slot granted); TTFT below
+                        # additionally includes the prefill device time —
+                        # recording both apart is what lets an operator
+                        # split admission delay from prefill cost.
+                        self.metrics.record_admit(
+                            time.monotonic() - req.t_submit)
+                        tok0 = await loop.run_in_executor(
+                            None, self._prefill_admit, req, slot)
+                        t = time.monotonic()
+                        st = _SlotState(req, req.max_new_tokens, t)
+                        self._slot_state[slot] = st
+                        self._push_token(st, tok0, t, first=True)
+                        st.remaining -= 1
+                        if st.remaining == 0:
+                            self._finish_ok(req)
+                            self._slot_state[slot] = None
+                # 5. Nothing in flight?
+                if self.active_slots == 0:
+                    if self._stopping:
+                        break
+                    await self.scheduler.wait_for_request(idle_poll_s)
+                    continue
+                if self._stopping and not self._draining:
+                    for i, st in enumerate(self._slot_state):
+                        if st is not None:
+                            self._finish_error(st.request, EngineStopped(
+                                "engine shut down mid-decode"))
+                            self._slot_state[i] = None
+                    break
+                # 6. One decode iteration for the whole batch.
+                nxt = await loop.run_in_executor(None, self._decode_sync)
+                t = time.monotonic()
+                for i, st in enumerate(self._slot_state):
+                    if st is None:
+                        continue
+                    self._push_token(st, int(nxt[i]), t)
+                    if st.remaining == 0:
+                        self._finish_ok(st.request)
+                        self._slot_state[i] = None
+                self.metrics.sample(
+                    len(self.scheduler), self.active_slots, self.slots)
+                # Yield so the server can read sockets between iterations.
+                await asyncio.sleep(0)
+        except BaseException as e:
+            # A device failure — or the embedder cancelling the run()
+            # task directly (CancelledError is a BaseException) — must
+            # not strand clients: every in-flight and queued request gets
+            # a terminal error event before the exception propagates
+            # (otherwise server handlers block forever on streams nothing
+            # will ever finish).
+            err = ServingError(f"engine failure: {e!r}")
+            for i, st in enumerate(self._slot_state):
+                if st is not None:
+                    self._finish_error(st.request, err)
+                    self._slot_state[i] = None
+            for req in self.scheduler.drain():
+                self._finish_error(req, err)
+            self._stopping = True
+            raise
+        finally:
+            self._running = False
+
+    # -- internals ----------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        """Prefill pad length: next power of two >= n (>= min bucket),
+        capped at the decodable context — bounds prefill compiles at
+        log2(context) programs total."""
+        b = self._min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.limit)
+
+    def _prefill_admit(self, req: Request, slot: int) -> int:
+        """Blocking prefill + cache splice (device work only — runs in the
+        executor; caller does stream bookkeeping on the loop thread).
+        Returns the request's first token."""
+        s0 = len(req.prompt)
+        P = self._bucket(s0)
+        padded = np.zeros((1, P), np.int32)
+        padded[0, :s0] = req.prompt
+        self._key, sub = jax.random.split(self._key)
+        temp = jnp.float32(req.temperature)
+        pre_cache, tok0 = self._prefill(
+            self._params, jnp.asarray(padded), jnp.int32(s0), temp, sub)
+        self._cache, self._tokens, self._temps = self._admit_jit(
+            self._cache, self._tokens, self._temps, jnp.int32(slot),
+            pre_cache, tok0, temp)
+        return int(tok0)
+
+    def _decode_sync(self) -> np.ndarray:
+        self._key, sub = jax.random.split(self._key)
+        self._cache, self._tokens = self._decode_step(
+            self._params, self._cache, self._tokens, self._temps, sub)
+        return np.asarray(self._tokens)
+
+    def _push_token(self, st: _SlotState, tok: int, t: float,
+                    first: bool = False) -> None:
+        req = st.request
+        if first:
+            req.t_first_token = t
+            self.metrics.record_first_token(t - req.t_submit)
+        else:
+            self.metrics.record_inter_token(t - st.last_token_t)
+            st.remaining -= 1
+        st.last_token_t = t
+        req.out_tokens.append(tok)
+        req.events.put_nowait(("token", tok))
+
+    def _finish_ok(self, req: Request) -> None:
+        req.t_done = time.monotonic()
+        self.metrics.record_finish(req.t_done - req.t_submit)
+        req.events.put_nowait(("done", {
+            "tokens": len(req.out_tokens),
+            "ttft_s": req.ttft,
+            "latency_s": req.t_done - req.t_submit,
+        }))
+        req.done.set()
+
+    def _finish_error(self, req: Request, err: ServingError) -> None:
+        req.error = err
+        req.t_done = time.monotonic()
+        req.events.put_nowait(("error", err))
+        req.done.set()

@@ -1,0 +1,143 @@
+"""Serving observability: latency-under-load metrics.
+
+Training benchmarks in this repo measure *throughput* (samples/sec/chip,
+``tracing.StepTimer``); a serving engine is judged on *latency under
+load*: TTFT (time to first token — dominated by queueing + prefill),
+inter-token latency (decode-step cadence), queue depth, slot occupancy,
+and goodput (tokens/sec actually delivered). :class:`ServingMetrics`
+accumulates those and emits structured records through the same
+:class:`distkeras_tpu.tracing.MetricStream` JSONL sinks the trainers use;
+:meth:`ServingMetrics.summary` follows ``StepTimer.summary``'s key
+conventions (``*_p50_s`` etc.) with the tail percentiles (p95/p99) that
+matter for serving SLOs.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+from distkeras_tpu.tracing import MetricStream
+
+__all__ = ["ServingMetrics", "percentile"]
+
+
+def percentile(values, q: float) -> float:
+    """Linear-interpolated percentile of ``values`` (any sized iterable
+    of floats); ``q`` in [0, 100]."""
+    if not values:
+        raise ValueError("percentile of empty list")
+    import numpy as np
+
+    return float(np.percentile(np.fromiter(values, dtype=np.float64), q))
+
+
+class ServingMetrics:
+    """Accumulates per-request and per-iteration serving metrics.
+
+    ``stream``: optional :class:`MetricStream`; every :meth:`sample` call
+    (one per engine decode iteration) emits a structured record, so a
+    JSONL sink yields a time series of queue depth / occupancy /
+    cumulative token counts alongside the trainers' step records.
+
+    Sample series are bounded sliding windows (``window`` most-recent
+    entries) — the engine runs for the server's lifetime, and unbounded
+    per-token lists would grow to hundreds of MB over a multi-day run.
+    Counters (completed/rejected/tokens_out) are exact and unbounded;
+    :meth:`summary` percentiles cover the window.
+    """
+
+    def __init__(self, stream: MetricStream | None = None,
+                 window: int = 16384):
+        self.stream = stream
+        self.ttft = collections.deque(maxlen=window)
+        self.inter_token = collections.deque(maxlen=window)
+        self.queue_wait = collections.deque(maxlen=window)
+        self.request_latency = collections.deque(maxlen=window)
+        self.completed = 0
+        self.rejected = 0
+        self.expired = 0
+        self.tokens_out = 0
+        self._occupancy = collections.deque(maxlen=window)
+        self._queue_depth = collections.deque(maxlen=window)
+        self._iterations = 0
+        self._t0 = time.monotonic()
+
+    # -- per-request events -------------------------------------------------
+    def record_admit(self, queue_wait_s: float) -> None:
+        self.queue_wait.append(queue_wait_s)
+
+    def record_first_token(self, ttft_s: float) -> None:
+        self.ttft.append(ttft_s)
+        self.tokens_out += 1
+
+    def record_inter_token(self, gap_s: float) -> None:
+        self.inter_token.append(gap_s)
+        self.tokens_out += 1
+
+    def record_finish(self, latency_s: float) -> None:
+        self.completed += 1
+        self.request_latency.append(latency_s)
+
+    def record_reject(self) -> None:
+        self.rejected += 1
+
+    def record_expire(self) -> None:
+        self.expired += 1
+
+    # -- per-iteration sampling --------------------------------------------
+    def sample(self, queue_depth: int, slots_active: int, slots_total: int) -> None:
+        """Call once per decode iteration; emits one stream record."""
+        self._iterations += 1
+        occ = slots_active / max(1, slots_total)
+        self._occupancy.append(occ)
+        self._queue_depth.append(queue_depth)
+        if self.stream is not None:
+            self.stream.emit(self._iterations, {
+                "queue_depth": queue_depth,
+                "slots_active": slots_active,
+                "slot_occupancy": occ,
+                "tokens_out": self.tokens_out,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+            })
+
+    # -- rollup -------------------------------------------------------------
+    def summary(self) -> dict[str, float]:
+        """Percentile rollup (``StepTimer.summary`` key conventions)."""
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        out: dict[str, float] = {
+            "requests_completed": float(self.completed),
+            "requests_rejected": float(self.rejected),
+            "requests_expired": float(self.expired),
+            "tokens_out": float(self.tokens_out),
+            "tokens_per_sec": self.tokens_out / elapsed,
+            "elapsed_s": elapsed,
+            "decode_iterations": float(self._iterations),
+        }
+        for name, xs in (
+            ("ttft", self.ttft),
+            ("inter_token", self.inter_token),
+            ("queue_wait", self.queue_wait),
+            ("request_latency", self.request_latency),
+        ):
+            if xs:
+                out[f"{name}_p50_s"] = percentile(xs, 50)
+                out[f"{name}_p95_s"] = percentile(xs, 95)
+                out[f"{name}_p99_s"] = percentile(xs, 99)
+                out[f"{name}_mean_s"] = sum(xs) / len(xs)
+        if self._occupancy:
+            out["slot_occupancy_mean"] = (
+                sum(self._occupancy) / len(self._occupancy)
+            )
+            out["queue_depth_max"] = float(max(self._queue_depth))
+        return out
+
+    def emit_summary(self, step: int = -1) -> dict[str, float]:
+        """Emit the rollup through the stream (step -1 marks a summary
+        record among the per-iteration series) and return it."""
+        s = self.summary()
+        if self.stream is not None:
+            self.stream.emit(step, {"summary": 1.0, **s})
+        return s

@@ -1,0 +1,226 @@
+"""Request queue and admission control for the serving engine.
+
+Policy: **FIFO within priority** (lower ``priority`` value is served
+first; ties break by arrival order), **bounded depth** (submission past
+``max_depth`` raises :class:`QueueFullError` — the engine sheds load with
+a typed error instead of growing an unbounded queue toward OOM), and
+**per-request deadlines** (a request that has not *completed* within its
+``timeout`` is expired, whether still queued or mid-decode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import time
+from typing import AsyncIterator, Sequence
+
+__all__ = [
+    "ServingError",
+    "QueueFullError",
+    "RequestTimeout",
+    "EngineStopped",
+    "Request",
+    "Scheduler",
+]
+
+
+class ServingError(Exception):
+    """Base class for typed serving failures (wire ``code`` per subclass)."""
+
+    code = "error"
+
+
+class QueueFullError(ServingError):
+    """Backpressure: queue is at ``max_depth``; retry later."""
+
+    code = "queue_full"
+
+
+class RequestTimeout(ServingError):
+    """The request's deadline passed before it completed."""
+
+    code = "timeout"
+
+
+class EngineStopped(ServingError):
+    """The engine is shutting down and no longer admits requests."""
+
+    code = "stopped"
+
+
+class RequestCancelled(ServingError):
+    """The caller abandoned the request (e.g. client disconnected)."""
+
+    code = "cancelled"
+
+
+class Request:
+    """One generation request plus its streaming output channel.
+
+    The engine pushes ``("token", id)`` events as tokens are decoded, then
+    exactly one terminal event: ``("done", info)`` or ``("error", exc)``.
+    Consume via :meth:`tokens` (async stream) or :meth:`result` (await
+    completion, return the full token list).
+    """
+
+    def __init__(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        priority: int = 0,
+        timeout: float | None = None,
+    ):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)  # <= 0 means greedy
+        self.priority = int(priority)
+        # Cast defensively: this arrives from the wire, and an uncastable
+        # value must fail HERE (a bad_request to one client), not later as
+        # a TypeError inside the engine loop's deadline arithmetic (which
+        # would kill serving for everyone).
+        self.timeout = None if timeout is None else float(timeout)
+        # Engine-owned runtime state.
+        self.events: asyncio.Queue = asyncio.Queue()
+        self.out_tokens: list[int] = []
+        self.error: ServingError | None = None
+        self.done = asyncio.Event()
+        self.cancelled = False
+        self.t_submit: float | None = None
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+
+    def cancel(self) -> None:
+        """Abandon the request: the engine frees its slot (or drops it
+        from the queue) at the next loop iteration instead of decoding
+        tokens nobody will read."""
+        self.cancelled = True
+
+    @property
+    def deadline(self) -> float | None:
+        if self.timeout is None or self.t_submit is None:
+            return None
+        return self.t_submit + self.timeout
+
+    @property
+    def ttft(self) -> float | None:
+        if self.t_submit is None or self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    async def tokens(self) -> AsyncIterator[int]:
+        """Stream token ids as they decode; raises the terminal
+        :class:`ServingError` if the request failed."""
+        while True:
+            kind, payload = await self.events.get()
+            if kind == "token":
+                yield payload
+            elif kind == "done":
+                return
+            else:  # "error"
+                raise payload
+
+    async def result(self) -> list[int]:
+        await self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return self.out_tokens
+
+
+class Scheduler:
+    """Bounded priority-FIFO queue with deadline expiry.
+
+    Pure bookkeeping — no device state. The engine calls :meth:`pop` between
+    decode iterations to fill free slots and :meth:`expire` to shed requests
+    whose deadline passed while queued.
+    """
+
+    def __init__(self, max_depth: int = 64):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = int(max_depth)
+        self._heap: list[tuple[int, int, Request]] = []
+        self._seq = itertools.count()
+        self._arrival = asyncio.Event()
+        # Requests found expired during pop(), awaiting pickup by expire().
+        self._expired_backlog: list[Request] = []
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def submit(self, request: Request, now: float | None = None) -> None:
+        """Enqueue; raises :class:`QueueFullError` at ``max_depth``."""
+        if len(self._heap) >= self.max_depth:
+            raise QueueFullError(
+                f"queue depth {len(self._heap)} at max_depth={self.max_depth}"
+            )
+        request.t_submit = time.monotonic() if now is None else now
+        heapq.heappush(self._heap, (request.priority, next(self._seq), request))
+        self._arrival.set()
+
+    def pop(self, now: float | None = None) -> Request | None:
+        """Highest-priority non-expired request, or None if empty."""
+        now = time.monotonic() if now is None else now
+        while self._heap:
+            _, _, req = heapq.heappop(self._heap)
+            if req.cancelled or (req.deadline is not None
+                                 and now > req.deadline):
+                # Dead while queued: hand back via the expired path so the
+                # caller records/terminates it uniformly.
+                self._expired_backlog.append(req)
+                continue
+            return req
+        return None
+
+    def expire(self, now: float | None = None) -> list[Request]:
+        """Remove and return every queued request whose deadline passed or
+        that was cancelled (distinguish via ``req.cancelled``)."""
+        now = time.monotonic() if now is None else now
+        expired = self._expired_backlog
+        self._expired_backlog = []
+        keep = []
+        for item in self._heap:
+            req = item[2]
+            if req.cancelled or (req.deadline is not None
+                                 and now > req.deadline):
+                expired.append(req)
+            else:
+                keep.append(item)
+        if len(keep) != len(self._heap):
+            heapq.heapify(keep)
+            self._heap = keep
+        return expired
+
+    def drain(self) -> list[Request]:
+        """Remove and return everything queued (engine shutdown path)."""
+        out = [item[2] for item in sorted(self._heap)]
+        self._heap = []
+        out.extend(self._expired_backlog)
+        self._expired_backlog = []
+        return out
+
+    async def wait_for_request(self, timeout: float | None = None) -> bool:
+        """Block until something is submitted (or timeout); True if woken
+        by an arrival."""
+        if self._heap:
+            return True
+        self._arrival.clear()
+        try:
+            await asyncio.wait_for(self._arrival.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def kick(self) -> None:
+        """Wake any waiter (e.g. so the engine loop notices shutdown)."""
+        self._arrival.set()
+
+    def reset_loop_state(self) -> None:
+        """Replace the arrival event: asyncio primitives bind to the loop
+        they are first awaited on, so an engine reopened under a NEW event
+        loop (multi-phase benches, sequential asyncio.run calls) needs a
+        fresh one. Queued requests are untouched."""
+        self._arrival = asyncio.Event()

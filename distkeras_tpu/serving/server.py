@@ -1,0 +1,142 @@
+"""Asyncio TCP front end for the serving engine.
+
+Wire protocol: newline-delimited JSON, one request object per line in,
+a stream of single-line events out:
+
+    -> {"prompt": [1, 2, 3], "max_new_tokens": 8, "temperature": 0.0,
+        "priority": 0, "timeout": 30.0}
+    <- {"token": 17}              (one line per decoded token, streamed)
+    <- {"done": true, "tokens": [17, ...], "ttft_ms": 12.3,
+        "latency_ms": 45.6}
+    or {"error": "...", "code": "queue_full" | "timeout" | "stopped"
+        | "bad_request"}
+
+A connection may send requests sequentially (next request after the
+previous one's terminal line). JSON-over-TCP rather than HTTP keeps the
+dependency surface at zero (same stance as the gRPC-optional PS
+transport) while exercising the full online path: admission backpressure,
+streaming, and graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.scheduler import ServingError
+
+__all__ = ["ServingServer"]
+
+
+class ServingServer:
+    """TCP wrapper: owns the engine's run() task and the listener.
+
+    ``port=0`` binds an ephemeral port (read back via :attr:`port`) —
+    the test/bench-friendly default.
+    """
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.engine = engine
+        self.host = host
+        self._requested_port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._engine_task: asyncio.Task | None = None
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("server not started")
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._engine_task = asyncio.create_task(self.engine.run())
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self._requested_port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        await self._server.serve_forever()
+
+    async def stop(self, drain: bool = True,
+                   handler_grace_s: float = 5.0) -> None:
+        """Graceful shutdown: stop accepting connections, stop admitting,
+        drain in-flight slots (unless ``drain=False``), then return.
+
+        Ordering matters: on Python >= 3.12.1 ``wait_closed()`` blocks
+        until every client handler exits, and handlers only exit on
+        client EOF — so the engine drain must come FIRST (it terminates
+        every stream, letting handlers flush their final lines), and the
+        wait for lingering idle connections is bounded by
+        ``handler_grace_s`` rather than a client's goodwill."""
+        if self._server is not None:
+            self._server.close()
+        self.engine.shutdown(drain=drain)
+        if self._engine_task is not None:
+            try:
+                await self._engine_task
+            except asyncio.CancelledError:
+                # The embedder cancelled the engine task directly; the
+                # engine has already flushed its requests with errors.
+                pass
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(
+                    self._server.wait_closed(), handler_grace_s)
+            except asyncio.TimeoutError:
+                pass  # idle keep-alive clients; loop cleanup cancels them
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    spec = json.loads(line)
+                    req = self.engine.submit(
+                        spec["prompt"], spec["max_new_tokens"],
+                        temperature=float(spec.get("temperature", 0.0)),
+                        priority=int(spec.get("priority", 0)),
+                        timeout=spec.get("timeout"),
+                    )
+                except ServingError as e:
+                    await self._send(writer, {"error": str(e), "code": e.code})
+                    continue
+                except (KeyError, TypeError, ValueError) as e:
+                    await self._send(writer,
+                                     {"error": str(e), "code": "bad_request"})
+                    continue
+                try:
+                    async for tok in req.tokens():
+                        await self._send(writer, {"token": tok})
+                except ServingError as e:
+                    await self._send(writer, {"error": str(e), "code": e.code})
+                    continue
+                except (ConnectionResetError, BrokenPipeError):
+                    # Client walked away mid-stream: release the decode
+                    # slot instead of generating tokens nobody will read.
+                    req.cancel()
+                    raise
+                await self._send(writer, {
+                    "done": True,
+                    "tokens": req.out_tokens,
+                    "ttft_ms": round(1e3 * req.ttft, 3),
+                    "latency_ms": round(1e3 * (req.t_done - req.t_submit), 3),
+                })
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    @staticmethod
+    async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:
+        writer.write((json.dumps(obj) + "\n").encode())
+        await writer.drain()

@@ -116,3 +116,59 @@ def add_platform_flag(parser) -> None:
 def apply_platform_args(args) -> None:
     force_platform(getattr(args, "platform", None),
                    getattr(args, "devices", None))
+
+
+def get_shard_map():
+    """``shard_map`` across jax versions: promoted to ``jax.shard_map``
+    in newer releases, ``jax.experimental.shard_map`` before that (where
+    the replication-check kwarg is also spelled ``check_rep`` rather than
+    ``check_vma``). Every in-repo user imports through here so one jax
+    upgrade path exists."""
+    try:
+        from jax import shard_map
+
+        return shard_map
+    except ImportError:  # pre-promotion jax
+        import functools
+
+        from jax.experimental.shard_map import shard_map
+
+        @functools.wraps(shard_map)
+        def compat(*args, **kwargs):
+            # Callers that explicitly opt out of the VMA check (the
+            # Pallas ring/ulysses kernels, whose pallas_call out_shapes
+            # carry no vma annotations) map onto the legacy check_rep
+            # knob. Everyone else KEEPS the legacy replication checker:
+            # the pipeline paths rely on real pcast semantics (identity
+            # here) to suppress transpose-psums, and without the checker
+            # they would run to silently wrong gradients on this jax —
+            # a loud _SpecError is the correct failure mode.
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return shard_map(*args, **kwargs)
+
+        return compat
+
+
+def axis_size(axis_name) -> int:
+    """Static mesh-axis size inside ``shard_map`` across jax versions:
+    ``lax.axis_size`` where it exists (newer jax); before its promotion,
+    ``jax.core.axis_frame(name)`` returns the size. Must stay a Python
+    int — callers use it for scan lengths and ppermute permutations."""
+    from jax import core, lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return core.axis_frame(axis_name)
+
+
+def pcast(x, axis_name, to="varying"):
+    """``lax.pcast`` across jax versions. Newer jax has a varying-axis
+    type system (VMA) and requires explicit casts for shard_map scan
+    carries; pre-VMA jax has no such annotation — identity is the correct
+    fallback there (the values are already device-varying at runtime)."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    return x
