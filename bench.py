@@ -374,9 +374,30 @@ def _record_history(metric: str, batch: int, on_cpu: bool, value: float,
             hist = json.load(f)
     except (OSError, ValueError):
         hist = {}
-    hist[_config_key(metric, batch, on_cpu, shape, forced)] = {
+    key = _config_key(metric, batch, on_cpu, shape, forced)
+    entry = {
         "value": value, "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    # Keep a bounded trail of displaced entries: the latest-vs-prior drift
+    # check (scripts/check_bench_regression.py) needs the previous
+    # same-config row even after this overwrite. Rows predating the trail
+    # field just start one. Only numeric values enter the trail — a null
+    # row from an aborted child would otherwise occupy trail slots
+    # forever (same filter check_bench_regression applies on read).
+    def _numeric(v):
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    old = hist.get(key)
+    if isinstance(old, dict):
+        prev = [
+            p for p in old.get("prev", [])
+            if isinstance(p, dict) and _numeric(p.get("value"))
+        ]
+        if _numeric(old.get("value")):
+            prev.append({"value": old["value"], "when": old.get("when")})
+        if prev:
+            entry["prev"] = prev[-20:]
+    hist[key] = entry
     try:
         # Write-then-rename: the parent kills this child on its deadline,
         # and a kill landing mid-dump must not truncate the history (the
@@ -506,6 +527,10 @@ def _measure() -> None:
             "model": model.name,
             "batch_size": batch,
             "step_time_mean_s": round(summary["step_time_mean_s"], 5),
+            # Tail percentiles (BASELINE cares about straggler steps, not
+            # just the mean — a p99 spike is a sync-mesh stall).
+            "step_time_p90_s": round(summary["step_time_p90_s"], 5),
+            "step_time_p99_s": round(summary["step_time_p99_s"], 5),
             "step_time_var_s2": round(summary["step_time_var_s2"], 8),
             "device": str(jax.devices()[0]),
             "peak_flops": device_peak_flops() or 0,

@@ -38,16 +38,18 @@ import numpy as np
 def _build(args):
     from distkeras_tpu.models.bert import gpt_small, gpt_tiny
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
+    from distkeras_tpu.telemetry import MetricsRegistry
     from distkeras_tpu.tracing import MetricStream
 
     model = (gpt_tiny(seq_len=args.seq_len, vocab_size=args.vocab)
              if args.model == "gpt_tiny" else gpt_small(seq_len=args.seq_len))
     variables = model.init(0)
-    stream = (MetricStream.to_jsonl(args.metrics_out)
+    registry = MetricsRegistry()
+    stream = (MetricStream.to_jsonl(args.metrics_out, registry=registry)
               if args.metrics_out else None)
     engine = ServingEngine(
         model, variables, slots=args.slots, max_queue=args.max_queue,
-        metrics=ServingMetrics(stream))
+        metrics=ServingMetrics(stream, registry=registry))
     return model, variables, engine, stream
 
 
@@ -125,12 +127,20 @@ def main():
                     help="open-loop offered load, req/s")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-out", default=None)
+    ap.add_argument("--trace-out", default=None,
+                    help="enable spans; export the run as Chrome-trace "
+                         "JSON (loads in Perfetto) at this path")
     ap.add_argument("--skip-parity", action="store_true",
                     help="skip the generate() cross-check (pure load run)")
     args = ap.parse_args()
 
     from distkeras_tpu.serving import ServingMetrics
 
+    tracer = None
+    if args.trace_out:
+        from distkeras_tpu.telemetry import enable_tracing
+
+        tracer = enable_tracing()
     model, variables, engine, stream = _build(args)
     report = {"config": {
         "model": args.model, "slots": args.slots, "requests": args.requests,
@@ -179,17 +189,27 @@ def main():
             engine.reopen()
         return all_results
 
-    all_results = asyncio.run(run_all())
+    try:
+        all_results = asyncio.run(run_all())
 
-    compiles = engine.decode_compile_count()
-    report["decode_compile_count"] = compiles
-    assert compiles in (1, -1), (
-        f"continuous batching retraced the decode step: {compiles} "
-        "compiled executables (expected exactly 1)")
-    if not args.skip_parity:
-        mism = _check_parity(model, variables, all_results, args.new_tokens)
-        report["parity_mismatches"] = mism
-        assert mism == 0, f"{mism} streams diverged from one-shot generate()"
+        compiles = engine.decode_compile_count()
+        report["decode_compile_count"] = compiles
+        assert compiles in (1, -1), (
+            f"continuous batching retraced the decode step: {compiles} "
+            "compiled executables (expected exactly 1)")
+        if not args.skip_parity:
+            mism = _check_parity(model, variables, all_results,
+                                 args.new_tokens)
+            report["parity_mismatches"] = mism
+            assert mism == 0, \
+                f"{mism} streams diverged from one-shot generate()"
+    finally:
+        # Export even when an invariant fired: a failing run is exactly
+        # when the admit/prefill/decode timeline is worth reading.
+        if tracer is not None:
+            report["trace_out"] = tracer.export_chrome_trace(args.trace_out)
+        if stream is not None:
+            stream.close()
     print(json.dumps(report, indent=1))
 
 

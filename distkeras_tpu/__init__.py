@@ -58,6 +58,12 @@ from distkeras_tpu.inference.evaluators import (
 )
 from distkeras_tpu.inference.generate import Generator, beam_search, generate
 from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.telemetry import (
+    MetricsRegistry,
+    RecompileAuditor,
+    enable_tracing,
+    span,
+)
 from distkeras_tpu.utils.config import TrainerConfig
 
 __all__ = [
@@ -91,4 +97,8 @@ __all__ = [
     "Generator",
     "ServingEngine",
     "TrainerConfig",
+    "span",
+    "enable_tracing",
+    "MetricsRegistry",
+    "RecompileAuditor",
 ]

@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from distkeras_tpu.data.dataset import Dataset
+from distkeras_tpu.telemetry import span
 
 __all__ = ["minibatches", "window_batches", "index_windows", "DeviceFeed"]
 
@@ -162,8 +163,19 @@ class DeviceFeed:
         return {k: jax.device_put(v) for k, v in batch.items()}
 
     def __iter__(self):
-        for batch in self._batches:
-            self._buffer.append(self._put(batch))
+        # Two spans per batch: producing the host batch (the generator
+        # pull — dataset gather/stack) vs dispatching the h2d transfer.
+        # On a span timeline they bracket the step span, showing where
+        # host time goes when the chip waits.
+        batches = iter(self._batches)
+        end = object()  # unique sentinel: a (buggy) None batch must still
+        while True:     # crash loudly in _put, not truncate the epoch
+            with span("data_next"):
+                batch = next(batches, end)
+            if batch is end:
+                break
+            with span("h2d_put"):
+                self._buffer.append(self._put(batch))
             if len(self._buffer) >= self._buffer_size:
                 yield self._buffer.popleft()
         while self._buffer:

@@ -42,11 +42,19 @@ class RetryingClient:
         max_retries: int = 5,
         base_delay: float = 0.2,
         max_delay: float = 10.0,
+        registry=None,
     ):
         self._client = client
         self.max_retries = int(max_retries)
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
+        # Optional telemetry (MetricsRegistry): retries are the early
+        # warning of a degrading PS transport — a climbing counter shows
+        # up on a scrape long before the retry budget finally exhausts.
+        self._c_retries = None
+        if registry is not None:
+            self._c_retries = registry.counter(
+                "ps_client_retries_total", help="PS call retries", op="any")
 
     def _with_retries(self, fn: Callable, what: str):
         delay = self.base_delay
@@ -57,6 +65,8 @@ class RetryingClient:
             except Exception as e:  # transport-level failure
                 last_exc = e
                 if attempt < self.max_retries:  # no pointless final sleep
+                    if self._c_retries is not None:
+                        self._c_retries.inc()
                     time.sleep(delay)
                     delay = min(delay * 2, self.max_delay)
         raise ParameterServerUnavailable(
@@ -154,11 +164,17 @@ def watchdog(
     interval: float = 5.0,
     stall_after: int = 3,
     stop_event: threading.Event | None = None,
+    registry=None,
 ) -> threading.Thread:
     """Background thread: calls ``health_fn`` every ``interval`` seconds and
     fires ``on_stall(last_health)`` after ``stall_after`` consecutive checks
-    with no commit progress (or failed health calls)."""
+    with no commit progress (or failed health calls). With a ``registry``,
+    each fired stall also bumps ``ps_watchdog_stalls_total``."""
     stop_event = stop_event or threading.Event()
+    c_stalls = None
+    if registry is not None:
+        c_stalls = registry.counter(
+            "ps_watchdog_stalls_total", help="watchdog stall callbacks fired")
 
     def run():
         last_commits = -1
@@ -171,6 +187,8 @@ def watchdog(
             if not h.get("running", False) or h.get("num_commits", 0) == last_commits:
                 stalls += 1
                 if stalls >= stall_after:
+                    if c_stalls is not None:
+                        c_stalls.inc()
                     on_stall(h)
                     stalls = 0
             else:

@@ -55,10 +55,21 @@ class ParameterServerService:
         center: PyTree,
         num_workers: int,
         dedupe_window: int = 8192,
+        registry=None,
     ):
         self.protocol = protocol
         self.num_workers = int(num_workers)
         self._center = _to_host(center)
+        # Optional telemetry (MetricsRegistry): live commit/duplicate
+        # counters + queue-depth gauge, the scrapeable face of health().
+        self._c_commits = self._c_dups = self._g_depth = None
+        if registry is not None:
+            self._c_commits = registry.counter(
+                "ps_commits_total", help="PS commits applied")
+            self._c_dups = registry.counter(
+                "ps_duplicate_commits_total", help="deduped retried commits")
+            self._g_depth = registry.gauge(
+                "ps_queue_depth", help="pending PS messages")
         self._num_updates = 0
         self._num_commits = 0
         self._num_duplicates = 0
@@ -102,6 +113,8 @@ class ParameterServerService:
     def _run(self) -> None:
         while True:
             action, payload, reply = self._queue.get()
+            if self._g_depth is not None:
+                self._g_depth.set(self._queue.qsize())
             if action == _STOP:
                 break
             if action == _PULL:
@@ -117,6 +130,8 @@ class ParameterServerService:
                     self._center, self._num_updates, payload, self.num_workers
                 )
                 self._num_commits += 1
+                if self._c_commits is not None:
+                    self._c_commits.inc()
                 if reply is not None:
                     reply.put(True)
             elif action == _COMMIT_PULL:
@@ -141,6 +156,8 @@ class ParameterServerService:
                     # don't report it as progress through health().
                     if self._num_updates != before:
                         self._num_commits += 1
+                        if self._c_commits is not None:
+                            self._c_commits.inc()
                 tree, counter = out
                 reply.put((jax.tree.map(np.copy, tree), counter))
 
@@ -153,6 +170,8 @@ class ParameterServerService:
             return False
         if cid in self._seen_ids:
             self._num_duplicates += 1
+            if self._c_dups is not None:
+                self._c_dups.inc()
             return True
         self._seen_ids[cid] = None
         while len(self._seen_ids) > self._dedupe_window:

@@ -83,6 +83,15 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-out", default=None,
                     help="JSONL per-iteration serving metrics")
+    ap.add_argument("--trace-out", default=None,
+                    help="enable spans; write Chrome-trace JSON (Perfetto-"
+                         "loadable) here on shutdown")
+    ap.add_argument("--audit-recompiles", nargs="?", const="report",
+                    choices=["report", "arm"], default=None,
+                    help="count compiles per jitted program (report at "
+                         "exit); 'arm' additionally fails loudly if the "
+                         "decode step ever recompiles after its first "
+                         "iteration")
     args = ap.parse_args(argv)
 
     import asyncio
@@ -90,8 +99,12 @@ def serve_main(argv=None) -> int:
     from distkeras_tpu.serving import (
         ServingEngine, ServingMetrics, ServingServer,
     )
+    from distkeras_tpu.telemetry import RecompileAuditor, enable_tracing
     from distkeras_tpu.tracing import MetricStream
 
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    tracer = enable_tracing() if args.trace_out else None
     model = load_model(args.model, json.loads(args.model_args))
     variables = model.init(args.seed)
     if args.weights:
@@ -99,11 +112,21 @@ def serve_main(argv=None) -> int:
 
         variables = deserialize_pytree(
             open(args.weights, "rb").read(), like=variables)
+    # One registry behind everything this process publishes — serving
+    # metrics, the scheduler, the stream's last-value gauges, the auditor
+    # — so a metricsz scrape shows the whole picture.
+    registry = MetricsRegistry()
     metrics = ServingMetrics(
-        MetricStream.to_jsonl(args.metrics_out) if args.metrics_out else None)
+        MetricStream.to_jsonl(args.metrics_out, registry=registry)
+        if args.metrics_out else None,
+        registry=registry)
+    auditor = (RecompileAuditor(registry=registry)
+               if args.audit_recompiles else None)
     engine = ServingEngine(
         model, variables, slots=args.slots, max_queue=args.max_queue,
-        top_k=args.top_k, metrics=metrics, seed=args.seed)
+        top_k=args.top_k, metrics=metrics, seed=args.seed,
+        auditor=auditor,
+        arm_auditor_after_warmup=args.audit_recompiles == "arm")
     server = ServingServer(engine, host=args.host, port=args.port)
 
     async def go():
@@ -126,14 +149,21 @@ def serve_main(argv=None) -> int:
                 pass
         await stop.wait()
         await server.stop(drain=True)
-        print(json.dumps(
-            {k: round(v, 6) for k, v in metrics.summary().items()}),
-            flush=True)
+        summary = {k: round(v, 6) for k, v in metrics.summary().items()}
+        if auditor is not None:
+            summary["recompile_audit"] = auditor.report()
+        print(json.dumps(summary), flush=True)
 
     try:
         asyncio.run(go())
     except KeyboardInterrupt:
         pass
+    finally:
+        if metrics.stream is not None:
+            metrics.stream.close()
+        if tracer is not None:
+            tracer.export_chrome_trace(args.trace_out)
+            print(json.dumps({"trace_out": args.trace_out}), flush=True)
     return 0
 
 
@@ -149,20 +179,38 @@ def main(argv=None) -> int:
     ap.add_argument("--model-args", default="{}", help="JSON kwargs for the model fn")
     ap.add_argument("--out", default=None, help="path to save trained weights")
     ap.add_argument("--metrics-out", default=None, help="JSONL per-step metrics")
+    ap.add_argument("--trace-out", default=None,
+                    help="enable spans; write Chrome-trace JSON (Perfetto-"
+                         "loadable) of the whole run here")
+    ap.add_argument("--audit-recompiles", action="store_true",
+                    help="count train-step compiles (+ triggering shapes); "
+                         "report appears in the summary line")
     ap.add_argument("--shuffle", action="store_true")
     args = ap.parse_args(argv)
 
+    from distkeras_tpu.telemetry import RecompileAuditor, enable_tracing
     from distkeras_tpu.tracing import MetricStream
     from distkeras_tpu.utils.config import TrainerConfig
 
+    tracer = enable_tracing() if args.trace_out else None
     cfg = TrainerConfig.from_json(open(args.config).read())
     model = load_model(args.model, json.loads(args.model_args))
     ds = load_data(args.data, cfg.features_col, cfg.label_col)
     trainer = cfg.build(model)
     if args.metrics_out:
         trainer.metric_stream = MetricStream.to_jsonl(args.metrics_out)
+    if args.audit_recompiles:
+        trainer.auditor = RecompileAuditor()
 
-    trained = trainer.train(ds, shuffle=args.shuffle)
+    try:
+        trained = trainer.train(ds, shuffle=args.shuffle)
+    finally:
+        # The JSONL stream owns a file handle; the trace is only useful
+        # if it lands on disk even when training dies mid-run.
+        if trainer.metric_stream is not None:
+            trainer.metric_stream.close()
+        if tracer is not None:
+            tracer.export_chrome_trace(args.trace_out)
     summary = {
         "trainer": cfg.trainer,
         "steps": len(trainer.get_history()),
@@ -171,6 +219,10 @@ def main(argv=None) -> int:
             k: round(v, 5) for k, v in trainer.get_averaged_history().items()
         },
     }
+    if args.audit_recompiles:
+        summary["recompile_audit"] = trainer.auditor.report()
+    if args.trace_out:
+        summary["trace_out"] = args.trace_out
     if args.out:
         if isinstance(trained, list):  # EnsembleTrainer
             for i, t in enumerate(trained):

@@ -113,6 +113,31 @@ class ServingClient:
                 on_token(tok)
         return self.last_done
 
+    async def _control(self, spec: dict) -> dict:
+        if self._writer is None:
+            await self.connect()
+        self._writer.write((json.dumps(spec) + "\n").encode())
+        await self._writer.drain()
+        line = await self._reader.readline()
+        if not line:
+            raise ConnectionError("server closed the connection")
+        rec = json.loads(line)
+        if "error" in rec:
+            _raise_for(rec)
+        return rec
+
+    async def metricsz(self, format: str | None = None):
+        """Scrape the server's live metrics registry: a nested dict by
+        default, the Prometheus text page with ``format="prometheus"``."""
+        spec = {"cmd": "metricsz"}
+        if format is not None:
+            spec["format"] = format
+        return (await self._control(spec))["metricsz"]
+
+    async def healthz(self) -> dict:
+        """Engine liveness snapshot (slots, queue depth, compile count)."""
+        return (await self._control({"cmd": "healthz"}))["healthz"]
+
     def generate_sync(self, prompt: Sequence[int], max_new_tokens: int,
                       **kw) -> dict:
         """Blocking one-shot convenience (opens and closes a connection)."""

@@ -31,6 +31,7 @@ engine-wide static config.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import dataclasses
 import functools
 import time
@@ -48,6 +49,7 @@ from distkeras_tpu.inference.generate import (
     sample_rows,
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.telemetry import RecompileAuditor, span
 from distkeras_tpu.serving.scheduler import (
     EngineStopped,
     Request,
@@ -145,6 +147,8 @@ class ServingEngine:
         metrics: ServingMetrics | None = None,
         seed: int = 0,
         min_prefill_bucket: int = 8,
+        auditor: RecompileAuditor | None = None,
+        arm_auditor_after_warmup: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -160,8 +164,9 @@ class ServingEngine:
         self._params = variables["params"]
         self.limit = _context_limit(model, self._cfg)
         self.slots = int(slots)
-        self.scheduler = Scheduler(max_depth=max_queue)
         self.metrics = metrics or ServingMetrics()
+        self.scheduler = Scheduler(max_depth=max_queue,
+                                   registry=self.metrics.registry)
         self._min_bucket = int(min_prefill_bucket)
         self._key = jax.random.PRNGKey(seed)
 
@@ -184,6 +189,20 @@ class ServingEngine:
             functools.partial(_decode_fn, self._module, top_k),
             donate_argnums=(1, 2))
 
+        # Recompile auditing: the compile-count==1 decode invariant as a
+        # RUNTIME check, not just a benchmark assertion. The auditor wraps
+        # all three programs; with ``arm_auditor_after_warmup`` the decode
+        # step is armed after its first iteration, so any later retrace
+        # (admission, dtype drift) raises RecompileError at the offending
+        # call instead of silently stretching tail latency.
+        self.auditor = auditor
+        self._arm_after_warmup = bool(arm_auditor_after_warmup)
+        if auditor is not None:
+            self._prefill = auditor.wrap(self._prefill, "serving_prefill")
+            self._admit_jit = auditor.wrap(self._admit_jit, "serving_admit")
+            self._decode_step = auditor.wrap(
+                self._decode_step, "serving_decode")
+
         self._running = False
         self._stopping = False
         self._draining = True
@@ -191,9 +210,22 @@ class ServingEngine:
     # -- introspection ------------------------------------------------------
     def decode_compile_count(self) -> int:
         """Number of compiled decode executables (must stay 1: admission
-        must never retrace the decode step)."""
+        must never retrace the decode step). -1 when the jit cache probe
+        is unavailable; falls back to the auditor's count if one is
+        attached (so audited engines keep a real count on jax versions
+        without the private probe)."""
         probe = getattr(self._decode_step, "_cache_size", None)
-        return int(probe()) if probe is not None else -1
+        size = None
+        if probe is not None:
+            try:
+                size = probe()
+            except Exception:
+                size = None
+        if size is not None:
+            return int(size)
+        if self.auditor is not None:
+            return self.auditor.compiles("serving_decode")
+        return -1
 
     @property
     def active_slots(self) -> int:
@@ -313,8 +345,10 @@ class ServingEngine:
                         # split admission delay from prefill cost.
                         self.metrics.record_admit(
                             time.monotonic() - req.t_submit)
-                        tok0 = await loop.run_in_executor(
-                            None, self._prefill_admit, req, slot)
+                        with span("admit", slot=slot,
+                                  prompt_len=len(req.prompt)):
+                            tok0 = await self._in_executor(
+                                loop, self._prefill_admit, req, slot)
                         t = time.monotonic()
                         st = _SlotState(req, req.max_new_tokens, t)
                         self._slot_state[slot] = st
@@ -337,15 +371,23 @@ class ServingEngine:
                             self._slot_state[i] = None
                     break
                 # 6. One decode iteration for the whole batch.
-                nxt = await loop.run_in_executor(None, self._decode_sync)
+                with span("decode_tick", active=self.active_slots):
+                    nxt = await self._in_executor(loop, self._decode_sync)
+                if self._arm_after_warmup and self.auditor is not None:
+                    # First decode iteration IS the warmup: the one
+                    # executable exists now, so every later compile is a
+                    # violated invariant.
+                    self._arm_after_warmup = False
+                    self.auditor.arm("serving_decode")
                 t = time.monotonic()
-                for i, st in enumerate(self._slot_state):
-                    if st is None:
-                        continue
-                    self._push_token(st, int(nxt[i]), t)
-                    if st.remaining == 0:
-                        self._finish_ok(st.request)
-                        self._slot_state[i] = None
+                with span("stream", active=self.active_slots):
+                    for i, st in enumerate(self._slot_state):
+                        if st is None:
+                            continue
+                        self._push_token(st, int(nxt[i]), t)
+                        if st.remaining == 0:
+                            self._finish_ok(st.request)
+                            self._slot_state[i] = None
                 self.metrics.sample(
                     len(self.scheduler), self.active_slots, self.slots)
                 # Yield so the server can read sockets between iterations.
@@ -370,6 +412,15 @@ class ServingEngine:
             self._running = False
 
     # -- internals ----------------------------------------------------------
+    @staticmethod
+    def _in_executor(loop, fn, *args):
+        """run_in_executor with contextvars propagated (it doesn't, unlike
+        asyncio.to_thread) so telemetry spans opened in the executor
+        thread parent correctly to the loop-side span that dispatched
+        them. copy_context() is copy-on-write — negligible per-call."""
+        ctx = contextvars.copy_context()
+        return loop.run_in_executor(None, lambda: ctx.run(fn, *args))
+
     def _bucket(self, n: int) -> int:
         """Prefill pad length: next power of two >= n (>= min bucket),
         capped at the decodable context — bounds prefill compiles at
@@ -389,11 +440,13 @@ class ServingEngine:
         padded[0, :s0] = req.prompt
         self._key, sub = jax.random.split(self._key)
         temp = jnp.float32(req.temperature)
-        pre_cache, tok0 = self._prefill(
-            self._params, jnp.asarray(padded), jnp.int32(s0), temp, sub)
-        self._cache, self._tokens, self._temps = self._admit_jit(
-            self._cache, self._tokens, self._temps, jnp.int32(slot),
-            pre_cache, tok0, temp)
+        with span("prefill", bucket=P, prompt_len=s0):
+            pre_cache, tok0 = self._prefill(
+                self._params, jnp.asarray(padded), jnp.int32(s0), temp, sub)
+        with span("cache_splice", slot=slot):
+            self._cache, self._tokens, self._temps = self._admit_jit(
+                self._cache, self._tokens, self._temps, jnp.int32(slot),
+                pre_cache, tok0, temp)
         return int(tok0)
 
     def _decode_sync(self) -> np.ndarray:

@@ -10,26 +10,42 @@ accumulates those and emits structured records through the same
 :meth:`ServingMetrics.summary` follows ``StepTimer.summary``'s key
 conventions (``*_p50_s`` etc.) with the tail percentiles (p95/p99) that
 matter for serving SLOs.
+
+Every event also publishes into a
+:class:`~distkeras_tpu.telemetry.registry.MetricsRegistry` (counters for
+request outcomes, histograms for the latency series, gauges for queue
+depth / occupancy) — the registry is what the server's ``metricsz``
+control verb scrapes live, and the percentile definition is the ONE
+shared :func:`distkeras_tpu.telemetry.registry.percentile`.
 """
 
 from __future__ import annotations
 
 import collections
 import time
+from typing import Iterable
 
+from distkeras_tpu.telemetry.registry import (
+    MetricsRegistry,
+    percentile as _percentile,
+)
 from distkeras_tpu.tracing import MetricStream
 
 __all__ = ["ServingMetrics", "percentile"]
 
+# Decode ticks and inter-token gaps sit well under the default buckets'
+# upper range; keep a finer low end for them.
+_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
 
-def percentile(values, q: float) -> float:
-    """Linear-interpolated percentile of ``values`` (any sized iterable
-    of floats); ``q`` in [0, 100]."""
-    if not values:
-        raise ValueError("percentile of empty list")
-    import numpy as np
 
-    return float(np.percentile(np.fromiter(values, dtype=np.float64), q))
+def percentile(values: Iterable[float], q: float) -> float:
+    """Shared linear-interpolated percentile (``q`` in [0, 100]); see
+    :func:`distkeras_tpu.telemetry.registry.percentile` — kept as a
+    re-export because serving callers historically imported it here."""
+    return _percentile(values, q)
 
 
 class ServingMetrics:
@@ -40,58 +56,118 @@ class ServingMetrics:
     JSONL sink yields a time series of queue depth / occupancy /
     cumulative token counts alongside the trainers' step records.
 
+    ``registry``: optional :class:`MetricsRegistry` to publish into; a
+    private one is created when omitted (tests and multi-engine
+    processes stay isolated; pass a shared registry to aggregate).
+
     Sample series are bounded sliding windows (``window`` most-recent
     entries) — the engine runs for the server's lifetime, and unbounded
     per-token lists would grow to hundreds of MB over a multi-day run.
     Counters (completed/rejected/tokens_out) are exact and unbounded;
-    :meth:`summary` percentiles cover the window.
+    :meth:`summary` percentiles cover the window (the registry histograms
+    cover the full lifetime, O(buckets) memory).
     """
 
     def __init__(self, stream: MetricStream | None = None,
-                 window: int = 16384):
+                 window: int = 16384,
+                 registry: MetricsRegistry | None = None):
         self.stream = stream
+        self.registry = registry if registry is not None else MetricsRegistry()
         self.ttft = collections.deque(maxlen=window)
         self.inter_token = collections.deque(maxlen=window)
         self.queue_wait = collections.deque(maxlen=window)
         self.request_latency = collections.deque(maxlen=window)
-        self.completed = 0
-        self.rejected = 0
-        self.expired = 0
-        self.tokens_out = 0
         self._occupancy = collections.deque(maxlen=window)
         self._queue_depth = collections.deque(maxlen=window)
         self._iterations = 0
         self._t0 = time.monotonic()
 
+        reg = self.registry
+        self._c_completed = reg.counter(
+            "serving_requests_completed_total", help="requests completed")
+        self._c_rejected = reg.counter(
+            "serving_requests_rejected_total", help="backpressure rejects")
+        self._c_expired = reg.counter(
+            "serving_requests_expired_total", help="deadline expiries")
+        self._c_tokens = reg.counter(
+            "serving_tokens_out_total", help="tokens streamed to clients")
+        self._c_iterations = reg.counter(
+            "serving_decode_iterations_total", help="decode loop iterations")
+        self._h = {
+            "ttft": reg.histogram(
+                "serving_ttft_seconds", help="time to first token",
+                buckets=_LATENCY_BUCKETS),
+            "inter_token": reg.histogram(
+                "serving_inter_token_seconds", help="inter-token latency",
+                buckets=_LATENCY_BUCKETS),
+            "queue_wait": reg.histogram(
+                "serving_queue_wait_seconds", help="admission queue wait",
+                buckets=_LATENCY_BUCKETS),
+            "request_latency": reg.histogram(
+                "serving_request_latency_seconds",
+                help="submit-to-done latency", buckets=_LATENCY_BUCKETS),
+        }
+        self._g_queue_depth = reg.gauge(
+            "serving_queue_depth", help="queued requests")
+        self._g_slots_active = reg.gauge(
+            "serving_slots_active", help="occupied decode slots")
+        self._g_occupancy = reg.gauge(
+            "serving_slot_occupancy", help="occupied / total slots")
+
+    # -- counter compatibility surface (pre-registry attribute names) -------
+    @property
+    def completed(self) -> int:
+        return int(self._c_completed.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._c_rejected.value)
+
+    @property
+    def expired(self) -> int:
+        return int(self._c_expired.value)
+
+    @property
+    def tokens_out(self) -> int:
+        return int(self._c_tokens.value)
+
     # -- per-request events -------------------------------------------------
     def record_admit(self, queue_wait_s: float) -> None:
         self.queue_wait.append(queue_wait_s)
+        self._h["queue_wait"].observe(queue_wait_s)
 
     def record_first_token(self, ttft_s: float) -> None:
         self.ttft.append(ttft_s)
-        self.tokens_out += 1
+        self._h["ttft"].observe(ttft_s)
+        self._c_tokens.inc()
 
     def record_inter_token(self, gap_s: float) -> None:
         self.inter_token.append(gap_s)
-        self.tokens_out += 1
+        self._h["inter_token"].observe(gap_s)
+        self._c_tokens.inc()
 
     def record_finish(self, latency_s: float) -> None:
-        self.completed += 1
+        self._c_completed.inc()
         self.request_latency.append(latency_s)
+        self._h["request_latency"].observe(latency_s)
 
     def record_reject(self) -> None:
-        self.rejected += 1
+        self._c_rejected.inc()
 
     def record_expire(self) -> None:
-        self.expired += 1
+        self._c_expired.inc()
 
     # -- per-iteration sampling --------------------------------------------
     def sample(self, queue_depth: int, slots_active: int, slots_total: int) -> None:
         """Call once per decode iteration; emits one stream record."""
         self._iterations += 1
+        self._c_iterations.inc()
         occ = slots_active / max(1, slots_total)
         self._occupancy.append(occ)
         self._queue_depth.append(queue_depth)
+        self._g_queue_depth.set(queue_depth)
+        self._g_slots_active.set(slots_active)
+        self._g_occupancy.set(occ)
         if self.stream is not None:
             self.stream.emit(self._iterations, {
                 "queue_depth": queue_depth,

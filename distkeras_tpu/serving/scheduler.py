@@ -138,7 +138,7 @@ class Scheduler:
     whose deadline passed while queued.
     """
 
-    def __init__(self, max_depth: int = 64):
+    def __init__(self, max_depth: int = 64, registry=None):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = int(max_depth)
@@ -147,9 +147,25 @@ class Scheduler:
         self._arrival = asyncio.Event()
         # Requests found expired during pop(), awaiting pickup by expire().
         self._expired_backlog: list[Request] = []
+        # Optional telemetry (MetricsRegistry): admission counters + live
+        # depth gauge, so a scrape sees queue pressure without waiting for
+        # the engine's next sample() record.
+        self._c_submitted = self._c_shed = self._g_depth = None
+        if registry is not None:
+            self._c_submitted = registry.counter(
+                "scheduler_submitted_total", help="requests enqueued")
+            self._c_shed = registry.counter(
+                "scheduler_shed_total",
+                help="requests shed from the queue (expired or cancelled)")
+            self._g_depth = registry.gauge(
+                "scheduler_queue_depth", help="requests currently queued")
 
     def __len__(self) -> int:
         return len(self._heap)
+
+    def _note_depth(self) -> None:
+        if self._g_depth is not None:
+            self._g_depth.set(len(self._heap))
 
     def submit(self, request: Request, now: float | None = None) -> None:
         """Enqueue; raises :class:`QueueFullError` at ``max_depth``."""
@@ -159,6 +175,9 @@ class Scheduler:
             )
         request.t_submit = time.monotonic() if now is None else now
         heapq.heappush(self._heap, (request.priority, next(self._seq), request))
+        if self._c_submitted is not None:
+            self._c_submitted.inc()
+            self._note_depth()
         self._arrival.set()
 
     def pop(self, now: float | None = None) -> Request | None:
@@ -172,7 +191,9 @@ class Scheduler:
                 # caller records/terminates it uniformly.
                 self._expired_backlog.append(req)
                 continue
+            self._note_depth()
             return req
+        self._note_depth()
         return None
 
     def expire(self, now: float | None = None) -> list[Request]:
@@ -192,6 +213,9 @@ class Scheduler:
         if len(keep) != len(self._heap):
             heapq.heapify(keep)
             self._heap = keep
+        if expired and self._c_shed is not None:
+            self._c_shed.inc(len(expired))
+            self._note_depth()
         return expired
 
     def drain(self) -> list[Request]:
@@ -200,6 +224,7 @@ class Scheduler:
         self._heap = []
         out.extend(self._expired_backlog)
         self._expired_backlog = []
+        self._note_depth()
         return out
 
     async def wait_for_request(self, timeout: float | None = None) -> bool:

@@ -11,6 +11,22 @@ a stream of single-line events out:
     or {"error": "...", "code": "queue_full" | "timeout" | "stopped"
         | "bad_request"}
 
+Control verbs (one reply line, no stream) ride the same protocol:
+
+    -> {"cmd": "metricsz"}                      (live registry snapshot)
+    <- {"metricsz": {"serving_ttft_seconds": {...}, ...}}
+    -> {"cmd": "metricsz", "format": "prometheus"}
+    <- {"metricsz": "# TYPE serving_ttft_seconds histogram\n..."}
+    -> {"cmd": "healthz"}
+    <- {"healthz": {"slots": 4, "active_slots": 1, "queue_depth": 0,
+                    "decode_compile_count": 1, ...}}
+
+``metricsz`` scrapes the engine's
+:class:`~distkeras_tpu.telemetry.registry.MetricsRegistry` — the
+Prometheus form is the standard text exposition format, so a one-line
+sidecar (``echo '{"cmd":"metricsz","format":"prometheus"}' | nc``)
+bridges it to a real scrape endpoint without HTTP in-process.
+
 A connection may send requests sequentially (next request after the
 previous one's terminal line). JSON-over-TCP rather than HTTP keeps the
 dependency surface at zero (same stance as the gRPC-optional PS
@@ -97,6 +113,9 @@ class ServingServer:
                     break
                 try:
                     spec = json.loads(line)
+                    if isinstance(spec, dict) and "cmd" in spec:
+                        await self._send(writer, self._control(spec))
+                        continue
                     req = self.engine.submit(
                         spec["prompt"], spec["max_new_tokens"],
                         temperature=float(spec.get("temperature", 0.0)),
@@ -135,6 +154,30 @@ class ServingServer:
                 await writer.wait_closed()
             except (ConnectionResetError, BrokenPipeError):
                 pass
+
+    def _control(self, spec: dict) -> dict:
+        """Handle a control verb; returns the single reply object."""
+        cmd = spec.get("cmd")
+        if cmd == "metricsz":
+            registry = self.engine.metrics.registry
+            if spec.get("format") == "prometheus":
+                from distkeras_tpu.telemetry import prometheus_text
+
+                return {"metricsz": prometheus_text(registry)}
+            return {"metricsz": registry.snapshot()}
+        if cmd == "healthz":
+            engine = self.engine
+            health = {
+                "slots": engine.slots,
+                "active_slots": engine.active_slots,
+                "queue_depth": len(engine.scheduler),
+                "decode_compile_count": engine.decode_compile_count(),
+                "stopping": engine._stopping,
+            }
+            if engine.auditor is not None:
+                health["recompile_audit"] = engine.auditor.report()
+            return {"healthz": health}
+        return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
 
     @staticmethod
     async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:

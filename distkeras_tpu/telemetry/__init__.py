@@ -1,0 +1,70 @@
+"""Unified observability layer: spans, recompile auditing, metrics.
+
+The reference system's observability was wall-clock getters plus the
+Spark web UI (SURVEY §5); through PR 1 this repo had grown two disjoint
+islands — ``tracing.py`` (training step timers / metric streams) and
+``serving/metrics.py`` (latency percentiles). This package is the single
+layer both sides publish into. Four pillars:
+
+- **spans** (:mod:`.spans`) — hierarchical host-timeline spans
+  (``with span("decode_tick"): ...``) with thread/task-correct parent
+  tracking, near-zero overhead when disabled, exported as Chrome-trace
+  JSON that Perfetto renders as one timeline per run;
+- **recompile auditing** (:mod:`.recompile`) — wrap jitted callables,
+  count compiles with the triggering abstract shapes, and arm after
+  warmup so a silent retrace becomes a loud :class:`RecompileError`;
+- **metrics registry** (:mod:`.registry`) — counter/gauge/histogram
+  get-or-create registry every subsystem publishes into, with the ONE
+  shared :func:`percentile` definition;
+- **exposition** (:mod:`.exposition`) — Prometheus text + JSONL
+  snapshots; the serving server serves both via its ``metricsz`` control
+  verb, ``run.py`` wires ``--trace-out`` / ``--audit-recompiles``.
+"""
+
+from distkeras_tpu.telemetry.spans import (
+    Tracer,
+    active_tracer,
+    disable_tracing,
+    enable_tracing,
+    span,
+)
+from distkeras_tpu.telemetry.recompile import (
+    CompileEvent,
+    RecompileAuditor,
+    RecompileError,
+    abstract_signature,
+)
+from distkeras_tpu.telemetry.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    percentile,
+    sanitize_metric_name,
+)
+from distkeras_tpu.telemetry.exposition import (
+    prometheus_text,
+    write_snapshot_jsonl,
+)
+
+__all__ = [
+    "Tracer",
+    "span",
+    "enable_tracing",
+    "disable_tracing",
+    "active_tracer",
+    "RecompileAuditor",
+    "RecompileError",
+    "CompileEvent",
+    "abstract_signature",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "percentile",
+    "sanitize_metric_name",
+    "DEFAULT_BUCKETS",
+    "prometheus_text",
+    "write_snapshot_jsonl",
+]

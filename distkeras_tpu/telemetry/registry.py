@@ -1,0 +1,295 @@
+"""Counter/gauge/histogram metrics registry.
+
+The single sink the whole system publishes into — serving
+(:class:`~distkeras_tpu.serving.metrics.ServingMetrics`, the scheduler),
+trainers, the PS/HA layer, and the recompile auditor — replacing the
+ad-hoc per-module lists each of those grew separately. One registry is a
+point-in-time queryable surface: :func:`~distkeras_tpu.telemetry.
+exposition.prometheus_text` renders it as a Prometheus scrape page,
+``snapshot()`` as a JSON object for the serving server's ``metricsz``
+control verb.
+
+Conventions (Prometheus-shaped, dependency-free):
+
+- metric names ``[a-zA-Z_:][a-zA-Z0-9_:]*``; counters end in ``_total``,
+  durations are ``_seconds``;
+- labels are a frozen kwargs dict at get-or-create time; the same
+  (name, labels) pair always returns the same metric object;
+- histograms use fixed cumulative buckets (defaults tuned for
+  sub-second latencies) with linear-interpolated percentile estimation.
+
+Percentile semantics are defined ONCE here — :func:`percentile` (exact,
+over any sized sequence) and :meth:`Histogram.percentile` (bucket
+estimate) agree on the edge cases: empty input raises ``ValueError``,
+a single sample is returned exactly for every q.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import re
+import threading
+import time
+from typing import Iterable
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "percentile",
+    "sanitize_metric_name",
+    "DEFAULT_BUCKETS",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def sanitize_metric_name(key: str) -> str:
+    """Coerce an arbitrary metric key (a stream/history dict key) into a
+    valid registry metric name — the ONE encoding of the naming rule
+    ``_NAME_RE`` enforces. Invalid characters become ``_``; a leading
+    digit gets a ``_`` prefix."""
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in str(key))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+# Cumulative upper bounds tuned for latencies from sub-millisecond decode
+# ticks to multi-second cold compiles; +Inf is implicit.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def percentile(values: Iterable[float], q: float) -> float:
+    """Linear-interpolated percentile of ``values`` (any sized iterable);
+    ``q`` in [0, 100]. Raises ``ValueError`` on empty input; a single
+    sample is returned exactly for every q. The ONE percentile definition
+    serving metrics, step timers, and histograms all share."""
+    xs = sorted(float(v) for v in values)
+    if not xs:
+        raise ValueError("percentile of empty sequence")
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"q must be in [0, 100], got {q}")
+    if len(xs) == 1:
+        return xs[0]
+    pos = (q / 100.0) * (len(xs) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.help = help
+        self.labels = dict(labels or {})
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic float counter (``inc`` only)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge(_Metric):
+    """Set/inc/dec point-in-time value."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram with percentile estimation.
+
+    ``observe(v)`` is O(log buckets); memory is O(buckets) regardless of
+    sample count — the unbounded-list failure mode of per-module metric
+    lists cannot recur here. ``percentile(q)`` linearly interpolates
+    within the bucket containing the q-th sample, clamped to the observed
+    [min, max] so estimates never leave the data's range.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labels=None, buckets=None):
+        super().__init__(name, help, labels)
+        bs = tuple(sorted(float(b) for b in (buckets or DEFAULT_BUCKETS)))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bucket_bounds = bs  # +Inf bucket is implicit (the overflow)
+        self._counts = [0] * (len(bs) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.bucket_bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float | None:
+        return self._sum / self._count if self._count else None
+
+    def cumulative_counts(self) -> list[tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs, ending at (+inf, count)
+        — the Prometheus ``_bucket{le=...}`` series."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for bound, c in zip(self.bucket_bounds, counts):
+            acc += c
+            out.append((bound, acc))
+        out.append((math.inf, acc + counts[-1]))
+        return out
+
+    def percentile(self, q: float) -> float:
+        """Bucket-interpolated percentile estimate; agrees with the exact
+        :func:`percentile` on the edge cases (empty raises, one sample is
+        returned exactly)."""
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"q must be in [0, 100], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            n = self._count
+            lo_obs, hi_obs = self._min, self._max
+            total = self._sum
+        if n == 0:
+            raise ValueError("percentile of empty histogram")
+        if n == 1:
+            return total  # sum of one sample IS the sample — exact
+        rank = (q / 100.0) * n
+        acc = 0.0
+        for i, c in enumerate(counts):
+            if acc + c >= rank and c > 0:
+                lo = self.bucket_bounds[i - 1] if i > 0 else lo_obs
+                hi = (self.bucket_bounds[i]
+                      if i < len(self.bucket_bounds) else hi_obs)
+                frac = (rank - acc) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, lo_obs), hi_obs)
+            acc += c
+        return hi_obs
+
+
+class MetricsRegistry:
+    """Get-or-create home for metrics, keyed by (name, labels).
+
+    Asking twice for the same (name, labels) returns the same object;
+    asking with a different metric kind for an existing name raises —
+    publisher modules can therefore declare their metrics at call sites
+    without coordinating ownership.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[tuple, _Metric] = {}
+        self._created = time.time()
+
+    def _get_or_create(self, cls, name, help, labels, **kw):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as {m.kind}"
+                    )
+                return m
+            m = cls(name, help=help, labels=labels, **kw)
+            self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", buckets=None,
+                  **labels) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def collect(self) -> list[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> dict:
+        """JSON-able point-in-time dump (the ``metricsz`` JSON body)."""
+        out: dict = {}
+        for m in self.collect():
+            key = m.name
+            if m.labels:
+                key += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(m.labels.items())) + "}"
+            if m.kind == "histogram":
+                entry: dict = {"kind": m.kind, "count": m.count,
+                               "sum": round(m.sum, 9)}
+                if m.count:
+                    entry.update({
+                        "p50": m.percentile(50), "p90": m.percentile(90),
+                        "p99": m.percentile(99), "mean": m.mean,
+                    })
+                out[key] = entry
+            else:
+                out[key] = {"kind": m.kind, "value": m.value}
+        return out

@@ -4,11 +4,18 @@ The reference's observability is wall-clock getters plus the Spark web UI
 (SURVEY §5). Here:
 
 - :class:`StepTimer` — per-step wall times with the derived metrics the
-  BASELINE cares about (samples/sec/chip, step-time variance, MFU);
+  BASELINE cares about (samples/sec/chip, step-time variance, tail
+  percentiles, MFU);
 - :class:`MetricStream` — structured per-step metric records with pluggable
   sinks (in-memory, JSONL file, stdout);
 - :func:`trace` — context manager around ``jax.profiler`` for
   TensorBoard/Perfetto traces of the XLA timeline.
+
+Spans, the recompile auditor, and the metrics registry live in
+:mod:`distkeras_tpu.telemetry` — the unified observability layer this
+module now publishes into. ``span`` / ``enable_tracing`` / ``Tracer``
+are re-exported here for callers that treat ``tracing`` as the one
+observability import; new code should import from ``telemetry``.
 """
 
 from __future__ import annotations
@@ -21,12 +28,27 @@ from typing import Any, Callable
 
 import jax
 
+from distkeras_tpu.telemetry.registry import percentile, sanitize_metric_name
+from distkeras_tpu.telemetry.spans import (  # noqa: F401 — re-export shims
+    Tracer,
+    active_tracer,
+    disable_tracing,
+    enable_tracing,
+    span,
+)
+
 __all__ = [
     "StepTimer",
     "MetricStream",
     "trace",
     "device_peak_flops",
     "compiled_step_flops",
+    # re-exported from distkeras_tpu.telemetry (canonical home):
+    "span",
+    "enable_tracing",
+    "disable_tracing",
+    "active_tracer",
+    "Tracer",
 ]
 
 
@@ -116,6 +138,11 @@ class StepTimer:
             "steps": float(len(times)),
             "step_time_mean_s": mean,
             "step_time_p50_s": statistics.median(times),
+            # Tail percentiles: mean/p50 hide exactly the stragglers the
+            # BASELINE's step-time-variance concern is about — one slow
+            # step per N stalls every chip in a synchronous mesh.
+            "step_time_p90_s": percentile(times, 90),
+            "step_time_p99_s": percentile(times, 99),
             "step_time_var_s2": statistics.pvariance(times) if len(times) > 1 else 0.0,
             "step_time_min_s": min(times),
         }
@@ -138,27 +165,73 @@ class StepTimer:
 
 
 class MetricStream:
-    """Structured metric records: ``emit(step, {...})`` fans out to sinks."""
+    """Structured metric records: ``emit(step, {...})`` fans out to sinks.
 
-    def __init__(self, sinks: list[Callable[[dict], None]] | None = None):
+    ``registry``: optional :class:`~distkeras_tpu.telemetry.registry.
+    MetricsRegistry`; every numeric metric emitted also sets a
+    ``stream_<key>`` gauge (latest value) and bumps
+    ``stream_records_total``, so a scrape of the registry shows the live
+    tail of the step series without replaying the JSONL.
+
+    Close when done: ``to_jsonl`` owns an open file handle. Use as a
+    context manager, or call :meth:`close` — emitting after close raises.
+    """
+
+    def __init__(self, sinks: list[Callable[[dict], None]] | None = None,
+                 registry=None):
         self.records: list[dict] = []
         self._sinks = sinks or []
+        self._files: list[Any] = []  # handles owned by this stream
+        self._closed = False
+        self._registry = registry
 
     @classmethod
-    def to_jsonl(cls, path: str) -> "MetricStream":
+    def to_jsonl(cls, path: str, registry=None) -> "MetricStream":
         f = open(path, "a")
 
         def sink(rec: dict):
             f.write(json.dumps(rec) + "\n")
             f.flush()
 
-        return cls([sink])
+        stream = cls([sink], registry=registry)
+        stream._files.append(f)
+        return stream
+
+    def close(self) -> None:
+        """Flush and close owned file handles; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for f in self._files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()
+
+    def __enter__(self) -> "MetricStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def emit(self, step: int, metrics: dict[str, Any]) -> None:
+        if self._closed:
+            raise ValueError("emit() on a closed MetricStream")
         rec = {"step": int(step), "ts": time.time(), **_floats(metrics)}
         self.records.append(rec)
         for sink in self._sinks:
             sink(rec)
+        if self._registry is not None:
+            self._registry.counter(
+                "stream_records_total", help="MetricStream records emitted"
+            ).inc()
+            for k, v in rec.items():
+                if k in ("step", "ts") or not isinstance(v, (int, float)):
+                    continue
+                self._registry.gauge(
+                    "stream_" + sanitize_metric_name(k),
+                    help="latest stream value").set(v)
 
     def last(self) -> dict | None:
         return self.records[-1] if self.records else None

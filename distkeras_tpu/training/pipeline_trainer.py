@@ -39,6 +39,7 @@ from distkeras_tpu.parallel.pipeline import (
     pipeline_apply,
     stack_stage_params,
 )
+from distkeras_tpu.telemetry import span
 from distkeras_tpu.training.trainers import Trainer, _StepCheckpointer
 
 __all__ = ["PipelineTrainer"]
@@ -101,6 +102,8 @@ class PipelineTrainer(Trainer):
         mesh=None,
         loss_weights=None,
         metric_stream=None,
+        registry=None,
+        auditor=None,
         aux_loss_weight: float = 0.01,
         checkpoint_dir: str | None = None,
         checkpoint_interval_s: float = 60.0,
@@ -108,7 +111,8 @@ class PipelineTrainer(Trainer):
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate=learning_rate, seed=seed,
-                         loss_weights=loss_weights, metric_stream=metric_stream)
+                         loss_weights=loss_weights, metric_stream=metric_stream,
+                         registry=registry, auditor=auditor)
         cfg = getattr(self.model, "config", None)
         if cfg is None or not hasattr(cfg, "num_layers"):
             raise ValueError(
@@ -575,14 +579,16 @@ class PipelineTrainer(Trainer):
             seed=self.seed if shuffle else None,
             start_batch=ck.start_step,
         )
+        step = self._audit(step, f"pipeline_step_{self.schedule}")
         feed = DeviceFeed(batches, sharding=batch_sh, buffer_size=2)
         base_key = jax.random.PRNGKey(self.seed)
         step_no = ck.start_step
         try:
             for i, batch in enumerate(feed, start=ck.start_step):
                 rng = jax.random.fold_in(base_key, i) if self._dropout else None
-                train_params, opt_state, m = step(train_params, opt_state,
-                                                  batch, rng)
+                with span("pipeline_step"):
+                    train_params, opt_state, m = step(train_params, opt_state,
+                                                      batch, rng)
                 self.history.append(m)
                 step_no = i + 1
                 ck.maybe_save(

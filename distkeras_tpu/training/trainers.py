@@ -54,6 +54,7 @@ from distkeras_tpu.parallel.protocols import (
     EAMSGDProtocol,
 )
 from distkeras_tpu.parallel.ps import ParameterServerService
+from distkeras_tpu.telemetry import span
 from distkeras_tpu.training.step import (
     TrainState,
     make_cached_window_train_step,
@@ -114,7 +115,8 @@ class _StepCheckpointer:
             self.mgr is not None
             and time.monotonic() - self._last >= self.interval_s
         ):
-            self.mgr.save(step, state=state, wait=False)
+            with span("checkpoint_save", step=step):
+                self.mgr.save(step, state=state, wait=False)
             self._last = time.monotonic()
 
     def finalize(self, step, state):
@@ -126,7 +128,8 @@ class _StepCheckpointer:
                 # line — just drain the in-flight write instead.
                 self.mgr.wait_until_finished()
             else:
-                self.mgr.save(step, state=state)
+                with span("checkpoint_save", step=step):
+                    self.mgr.save(step, state=state)
 
     def close(self):
         if self.mgr is not None:
@@ -148,6 +151,8 @@ class Trainer:
         seed: int = 0,
         loss_weights=None,
         metric_stream=None,
+        registry=None,
+        auditor=None,
     ):
         self.model = _as_model(keras_model)
         # Reference API parity (`Trainer.__init__(..., loss_weights=None)`).
@@ -170,6 +175,12 @@ class Trainer:
         # Optional distkeras_tpu.tracing.MetricStream receiving per-step
         # records (loss/accuracy/worker) as training runs.
         self.metric_stream = metric_stream
+        # Optional telemetry (distkeras_tpu.telemetry): a MetricsRegistry
+        # the trainer publishes run counters/last-step gauges into, and a
+        # RecompileAuditor that wraps the jitted step so compile counts
+        # (and, armed, compile-after-warmup violations) are tracked.
+        self.registry = registry
+        self.auditor = auditor
         self.history: list[dict] = []
         self._training_start: float | None = None
         self._training_stop: float | None = None
@@ -208,10 +219,34 @@ class Trainer:
         return out
 
     def _emit_history(self) -> None:
-        if self.metric_stream is None:
-            return
-        for i, h in enumerate(self.history):
-            self.metric_stream.emit(i, h)
+        if self.metric_stream is not None:
+            for i, h in enumerate(self.history):
+                self.metric_stream.emit(i, h)
+        if self.registry is not None and self.history:
+            self.registry.counter(
+                "train_steps_total", help="train steps recorded",
+            ).inc(len(self.history))
+            self.registry.gauge(
+                "train_time_seconds", help="wall clock of the last train()",
+            ).set(self.get_training_time())
+            from distkeras_tpu.telemetry import sanitize_metric_name
+
+            for k, v in self.history[-1].items():
+                if isinstance(v, (int, float)):
+                    self.registry.gauge(
+                        "train_last_" + sanitize_metric_name(k),
+                        help="last-step train metric").set(v)
+
+    def _audit(self, step_fn, name: str):
+        """Wrap a jitted step with the attached recompile auditor (no-op
+        without one). Auditor names are unique per auditor, so a second
+        train() on the same trainer runs unaudited rather than failing."""
+        if self.auditor is None:
+            return step_fn
+        try:
+            return self.auditor.wrap(step_fn, name)
+        except ValueError:  # name already wrapped (trainer re-used)
+            return step_fn
 
     def _optimizer(self):
         return get_optimizer(self.worker_optimizer, self.learning_rate)
@@ -270,10 +305,13 @@ class SingleTrainer(Trainer):
         validation_data: Dataset | None = None,
         loss_weights=None,
         metric_stream=None,
+        registry=None,
+        auditor=None,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate=learning_rate, seed=seed,
-                         loss_weights=loss_weights, metric_stream=metric_stream)
+                         loss_weights=loss_weights, metric_stream=metric_stream,
+                         registry=registry, auditor=auditor)
         self.features_col = features_col
         self.label_col = label_col
         self.batch_size = int(batch_size)
@@ -289,11 +327,11 @@ class SingleTrainer(Trainer):
     def train(self, dataset: Dataset, shuffle: bool = False) -> TrainedModel:
         self.record_training_start()
         optimizer = self._optimizer()
-        step_fn = make_train_step(
+        step_fn = self._audit(make_train_step(
             self.model, optimizer, self.loss, self.metrics,
             remat=self.remat, grad_accum_steps=self.grad_accum_steps,
             aux_loss_weight=self.aux_loss_weight,
-        )
+        ), "train_step")
         state = TrainState.create(self.model, optimizer, rng=self.seed)
         self.history = []
         self.validation_history = []
@@ -309,14 +347,17 @@ class SingleTrainer(Trainer):
             # Double-buffered host->HBM feed: the next batch's transfer
             # overlaps the current step's compute.
             for batch in DeviceFeed(batches, buffer_size=2):
-                state, m = step_fn(state, batch)
+                with span("train_step"):
+                    state, m = step_fn(state, batch)
                 self.history.append(m)
             if self.validation_data is not None:
                 snapshot = TrainedModel(self.model, state.variables)
-                val = self.evaluate(
-                    snapshot, self.validation_data,
-                    features_col=self.features_col, label_col=self.label_col,
-                )
+                with span("validation", epoch=epoch):
+                    val = self.evaluate(
+                        snapshot, self.validation_data,
+                        features_col=self.features_col,
+                        label_col=self.label_col,
+                    )
                 self.validation_history.append(
                     {"epoch": epoch, **{f"val_{k}": v for k, v in val.items()}}
                 )
@@ -349,10 +390,13 @@ class _VmappedReplicasTrainer(Trainer):
         seed: int = 0,
         loss_weights=None,
         metric_stream=None,
+        registry=None,
+        auditor=None,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate=learning_rate, seed=seed,
-                         loss_weights=loss_weights, metric_stream=metric_stream)
+                         loss_weights=loss_weights, metric_stream=metric_stream,
+                         registry=registry, auditor=auditor)
         self.num_models = int(num_models)
         self.features_col = features_col
         self.label_col = label_col
@@ -364,7 +408,8 @@ class _VmappedReplicasTrainer(Trainer):
         step_fn = make_train_step(
             self.model, optimizer, self.loss, self.metrics, jit=False
         )
-        vstep = jax.jit(jax.vmap(step_fn), donate_argnums=(0,))
+        vstep = self._audit(
+            jax.jit(jax.vmap(step_fn), donate_argnums=(0,)), "vmapped_step")
 
         # Pad the replica axis up to a device-count multiple so the stack
         # ALWAYS shards over devices (round 1 fell back to one device with
@@ -433,7 +478,8 @@ class _VmappedReplicasTrainer(Trainer):
                 batch = {
                     k: jax.device_put(v, replica_sharding) for k, v in batch.items()
                 }
-            stacked, m = vstep(stacked, batch)
+            with span("train_step"):
+                stacked, m = vstep(stacked, batch)
             self.history.append(m)
         steps = len(self.history)
         self.dropped_batches = [e - steps for e in expected[: self.num_models]]
@@ -527,10 +573,13 @@ class SynchronousDistributedTrainer(Trainer):
         resume: bool = False,
         loss_weights=None,
         metric_stream=None,
+        registry=None,
+        auditor=None,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate=learning_rate, seed=seed,
-                         loss_weights=loss_weights, metric_stream=metric_stream)
+                         loss_weights=loss_weights, metric_stream=metric_stream,
+                         registry=registry, auditor=auditor)
         self.num_workers = num_workers
         self.batch_size = int(batch_size)
         self.features_col = features_col
@@ -618,11 +667,13 @@ class SynchronousDistributedTrainer(Trainer):
             seed=self.seed if shuffle else None,
             start_batch=ck.start_step,
         )
+        step_fn = self._audit(step_fn, "sync_train_step")
         feed = DeviceFeed(batches, put_fn=shard_fn, buffer_size=2)
         step_no = ck.start_step
         try:
             for i, batch in enumerate(feed, start=ck.start_step):
-                state, m = step_fn(state, batch)
+                with span("train_step"):
+                    state, m = step_fn(state, batch)
                 self.history.append(m)
                 step_no = i + 1
                 ck.maybe_save(step_no, state)
@@ -675,11 +726,14 @@ class AsynchronousDistributedTrainer(Trainer):
         device_cache: bool | str = "auto",
         loss_weights=None,
         metric_stream=None,
+        registry=None,
+        auditor=None,
         **protocol_kwargs,
     ):
         super().__init__(keras_model, worker_optimizer, loss, metrics,
                          learning_rate=learning_rate, seed=seed,
-                         loss_weights=loss_weights, metric_stream=metric_stream)
+                         loss_weights=loss_weights, metric_stream=metric_stream,
+                         registry=registry, auditor=auditor)
         self.num_workers = int(num_workers)
         # devices_per_worker > 1 turns each worker into an *island*: a sync
         # data-parallel sub-mesh (gradient all-reduce over ICI inside the
@@ -802,7 +856,8 @@ class AsynchronousDistributedTrainer(Trainer):
             return grpc_ps
         self._grpc_ps = None
         self.parameter_server = ParameterServerService(
-            self.protocol, center_params, self.num_workers
+            self.protocol, center_params, self.num_workers,
+            registry=self.registry,
         )
         self.parameter_server.start()
         return self.parameter_server
@@ -829,12 +884,12 @@ class AsynchronousDistributedTrainer(Trainer):
         # the GIL — free for the overlapped PS exchange while the device
         # crunches. donate=False: the params snapshot taken at the exchange
         # launch must stay valid while the next window computes.
-        window_fn = make_window_train_step(
+        window_fn = self._audit(make_window_train_step(
             self.model, optimizer, self.loss, self.metrics, donate=False
-        )
-        cached_window_fn = make_cached_window_train_step(
+        ), "async_window_step")
+        cached_window_fn = self._audit(make_cached_window_train_step(
             self.model, optimizer, self.loss, self.metrics, donate=False
-        )
+        ), "async_cached_window_step")
         init_state = TrainState.create(self.model, optimizer, rng=self.seed)
         center_init = init_state.params
         ckpt_mgr = None
@@ -987,11 +1042,13 @@ class AsynchronousDistributedTrainer(Trainer):
                     """One window at a time: compute, record, rebase the
                     previous exchange, launch the next."""
                     for item in windows:
-                        state, ms, wsize = exec_window(state, item)
-                        jax.block_until_ready(ms["loss"])
+                        with span("window_step", worker=widx):
+                            state, ms, wsize = exec_window(state, item)
+                            jax.block_until_ready(ms["loss"])
                         win_histories[widx].append((ms, wsize, time.time()))
                         if pending is not None:
-                            state, carry = _rebase(state, pending)
+                            with span("ps_rebase", worker=widx):
+                                state, carry = _rebase(state, pending)
                             pending = None
                         if exchanger is not None:
                             snap = state.params

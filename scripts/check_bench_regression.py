@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Warn-only bench drift gate over ``bench_history.json``.
+
+``bench.py`` records every measurement into ``bench_history.json`` keyed
+by config (metric/batch/platform/shape/forced), keeping a bounded trail
+of displaced entries under ``prev``. This script compares the latest
+entry of each config (by default only the most recently updated one)
+against its prior same-config entry and WARNS when throughput dropped by
+more than ``--threshold`` (default 10%).
+
+Warn-only by design: CPU rows in a shared container are noisy, and a
+hard gate on them would train people to delete the history. Exit code is
+0 unless ``--strict`` is passed AND a regression was found. Stdlib only
+— runnable from the tier-1 environment (no jax import):
+
+    python scripts/check_bench_regression.py            # latest config
+    python scripts/check_bench_regression.py --all      # every config
+    python scripts/check_bench_regression.py --strict --threshold 0.15
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_history(path: str) -> dict:
+    with open(path) as f:
+        hist = json.load(f)
+    if not isinstance(hist, dict):
+        raise ValueError(f"{path}: expected a JSON object at top level")
+    return hist
+
+
+def check_entry(key: str, entry: dict, threshold: float) -> dict | None:
+    """Compare ``entry['value']`` to its most recent prior; returns a
+    finding dict (regressed or not), or None when there is no usable
+    prior / value to compare."""
+    if not isinstance(entry, dict):
+        return None
+    value = entry.get("value")
+    prevs = [
+        p for p in entry.get("prev", [])
+        if isinstance(p, dict) and isinstance(p.get("value"), (int, float))
+        and not isinstance(p.get("value"), bool) and p["value"] > 0
+    ]
+    if (not isinstance(value, (int, float)) or isinstance(value, bool)
+            or not prevs):
+        return None
+    prior = prevs[-1]
+    ratio = float(value) / float(prior["value"])
+    return {
+        "config": key,
+        "value": float(value),
+        "prior": float(prior["value"]),
+        "prior_when": prior.get("when"),
+        "when": entry.get("when"),
+        "ratio": round(ratio, 4),
+        "regressed": ratio < 1.0 - threshold,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--history",
+                    default=os.path.join(HERE, "bench_history.json"))
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="warn when value < (1 - threshold) * prior")
+    ap.add_argument("--all", action="store_true",
+                    help="check every config, not just the latest-updated")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regression (default: warn-only, exit 0)")
+    args = ap.parse_args(argv)
+
+    try:
+        hist = load_history(args.history)
+    except (OSError, ValueError) as e:
+        print(f"bench-regression: no usable history ({e}); nothing to check")
+        return 0
+
+    keys = list(hist)
+    if not args.all:
+        # Most recently updated config only — the row the run just wrote.
+        dated = [k for k in keys if isinstance(hist[k], dict)
+                 and hist[k].get("when")]
+        keys = [max(dated, key=lambda k: hist[k]["when"])] if dated else []
+
+    findings = []
+    for key in keys:
+        f = check_entry(key, hist[key], args.threshold)
+        if f is not None:
+            findings.append(f)
+
+    regressed = [f for f in findings if f["regressed"]]
+    for f in findings:
+        tag = "REGRESSION" if f["regressed"] else "ok"
+        print(f"bench-regression [{tag}] {f['config']}: "
+              f"{f['value']:.2f} vs prior {f['prior']:.2f} "
+              f"(x{f['ratio']}, prior from {f['prior_when']})")
+    if not findings:
+        print("bench-regression: no config with a prior same-config entry")
+    if regressed:
+        print(f"bench-regression: {len(regressed)} config(s) dropped more "
+              f"than {args.threshold:.0%} vs their prior entry (warn-only"
+              f"{'' if not args.strict else ', strict'})")
+    return 1 if (regressed and args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,78 @@ def test_append_baseline_check_accepts_and_refuses(tmp_path):
     assert rec["detail"]["infrastructure_failure"]
 
 
+def test_record_history_keeps_prior_trail(tmp_path, monkeypatch):
+    """Overwrites push the displaced entry onto a bounded prev trail —
+    the raw material of the latest-vs-prior drift check."""
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+    m = "mnist_mlp_train_samples_per_sec_per_chip"
+    bench._record_history(m, 256, True, 100.0)
+    bench._record_history(m, 256, True, 95.0)
+    bench._record_history(m, 256, True, 90.0)
+    hist = json.loads((tmp_path / "bench_history.json").read_text())
+    entry = hist[bench._config_key(m, 256, True)]
+    assert entry["value"] == 90.0
+    assert [p["value"] for p in entry["prev"]] == [100.0, 95.0]
+    # _previous_same_config still reads the flat value.
+    assert bench._previous_same_config(m, 256, True)[0] == 90.0
+    # A null row (aborted child) never enters or pollutes the trail.
+    hist[bench._config_key(m, 256, True)]["value"] = None
+    (tmp_path / "bench_history.json").write_text(json.dumps(hist))
+    bench._record_history(m, 256, True, 85.0)
+    entry = json.loads((tmp_path / "bench_history.json").read_text())[
+        bench._config_key(m, 256, True)]
+    assert entry["value"] == 85.0
+    assert [p["value"] for p in entry["prev"]] == [100.0, 95.0]
+
+
+def test_check_bench_regression_warns_and_strict_gates(tmp_path, capsys):
+    from scripts import check_bench_regression as cbr
+
+    path = tmp_path / "bench_history.json"
+    path.write_text(json.dumps({
+        "a/batch256/cpu": {"value": 80.0, "when": "2026-08-03T00:00:02Z",
+                           "prev": [{"value": 100.0,
+                                     "when": "2026-08-02T00:00:01Z"}]},
+        "b/batch64/cpu": {"value": 99.0, "when": "2026-08-01T00:00:00Z",
+                          "prev": [{"value": 100.0,
+                                    "when": "2026-07-31T00:00:00Z"}]},
+    }))
+    # Default: latest-updated config only ('a'), 20% drop -> warn, exit 0.
+    rc = cbr.main(["--history", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "REGRESSION" in out and "a/batch256/cpu" in out
+    assert "b/batch64" not in out
+    # --all covers both; 'b' is within threshold.
+    rc = cbr.main(["--history", str(path), "--all"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "[ok] b/batch64/cpu" in out
+    # --strict turns the warning into a gate.
+    assert cbr.main(["--history", str(path), "--strict"]) == 1
+    # A looser threshold passes strict.
+    assert cbr.main(["--history", str(path), "--strict",
+                     "--threshold", "0.5"]) == 0
+    # Missing/corrupt history degrades to exit 0, never a crash.
+    assert cbr.main(["--history", str(tmp_path / "nope.json")]) == 0
+    path.write_text("{truncated")
+    assert cbr.main(["--history", str(path)]) == 0
+
+
+def test_check_bench_regression_skips_unusable_rows(tmp_path):
+    from scripts import check_bench_regression as cbr
+
+    path = tmp_path / "bench_history.json"
+    path.write_text(json.dumps({
+        # No prior trail at all.
+        "a/batch1/cpu": {"value": 1.0, "when": "2026-08-03T00:00:00Z"},
+        # Null value (aborted child) and zero prior must both be skipped.
+        "b/batch1/cpu": {"value": None, "when": "2026-08-03T00:00:01Z",
+                         "prev": [{"value": 2.0, "when": "x"}]},
+        "c/batch1/cpu": {"value": 5.0, "when": "2026-08-03T00:00:02Z",
+                         "prev": [{"value": 0.0, "when": "x"}]},
+    }))
+    assert cbr.main(["--history", str(path), "--all"]) == 0
+
+
 def test_ring_balance_combinatorics():
     """The analytic ring-balance bench conserves total causal work in both
     layouts and the striped makespan approaches the 2x asymptote."""

@@ -305,6 +305,98 @@ def test_metrics_summary_and_stream(lm, rng, tmp_path):
     assert any("queue_depth" in rec for rec in lines)
 
 
+# -- telemetry integration ---------------------------------------------------
+
+def test_recompile_auditor_armed_is_runtime_invariant(lm, rng):
+    """THE engine invariant, as a runtime check instead of a benchmark
+    assertion: with the auditor armed after the first decode iteration,
+    admissions into freed slots mid-decode must not retrace the decode
+    step — any retrace would raise RecompileError and fail this test."""
+    from distkeras_tpu.telemetry import RecompileAuditor
+
+    model, variables = lm
+    auditor = RecompileAuditor()
+    engine = ServingEngine(model, variables, slots=2, max_queue=8,
+                           auditor=auditor, arm_auditor_after_warmup=True)
+    prompts = [_prompt(rng, n) for n in (5, 9, 3, 7, 4)]
+
+    async def work():
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(engine.submit(p, 6))
+            await asyncio.sleep(0.01 * i)  # arrive mid-decode, post-arming
+        return [await r.result() for r in reqs]
+
+    outs = asyncio.run(_run_engine(engine, work()))
+    for p, got in zip(prompts, outs):
+        assert got == _want(lm, p, 6)
+    # Armed + completed == the invariant held at runtime; the counts agree.
+    assert auditor.compiles("serving_decode") == 1
+    assert auditor.report()["serving_decode"]["armed"]
+    assert engine.decode_compile_count() in (1, -1)
+    # The admit splice compiles at most once per process: it wraps the
+    # module-level _admit_fn, so jax shares its executable cache across
+    # engines — an earlier engine in this test session may have already
+    # paid the one compile (0 new compiles here is the cache working).
+    assert auditor.compiles("serving_admit") <= 1
+    assert auditor.report()["serving_admit"]["calls"] == len(prompts)
+
+
+def test_engine_spans_export_chrome_trace(lm, rng):
+    """A traced serving run yields one Perfetto-loadable timeline:
+    admit/prefill/decode_tick spans present, B/E matched per lane even
+    though engine iterations and client tasks interleave on one loop."""
+    import distkeras_tpu.telemetry as T
+
+    model, variables = lm
+    tracer = T.enable_tracing()
+    try:
+        engine = ServingEngine(model, variables, slots=2)
+
+        async def work():
+            reqs = [engine.submit(_prompt(rng, n), 4) for n in (3, 6)]
+            return [await r.result() for r in reqs]
+
+        asyncio.run(_run_engine(engine, work()))
+    finally:
+        T.disable_tracing()
+    trace = tracer.chrome_trace()
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "B"}
+    assert {"admit", "prefill", "decode_tick", "stream"} <= names
+    # Matched B/E per lane (the Perfetto structural requirement).
+    stacks = {}
+    for ev in trace["traceEvents"]:
+        if ev["ph"] == "B":
+            stacks.setdefault(ev["tid"], []).append(ev["name"])
+        elif ev["ph"] == "E":
+            assert stacks.get(ev["tid"]), "E without matching B"
+            assert stacks[ev["tid"]].pop() == ev["name"]
+    assert all(not s for s in stacks.values())
+    # prefill nests under admit (executor thread lane tracks the caller's
+    # context because contextvars flow into run_in_executor).
+    prefill_b = next(e for e in trace["traceEvents"]
+                     if e["ph"] == "B" and e["name"] == "prefill")
+    assert prefill_b["args"]["parent"] == "admit"
+
+
+def test_serving_metrics_publish_to_registry(lm, rng):
+    model, variables = lm
+    engine = ServingEngine(model, variables, slots=2)
+
+    async def work():
+        reqs = [engine.submit(_prompt(rng, n), 4) for n in (3, 5)]
+        return [await r.result() for r in reqs]
+
+    asyncio.run(_run_engine(engine, work()))
+    snap = engine.metrics.registry.snapshot()
+    assert snap["serving_requests_completed_total"]["value"] == 2
+    assert snap["serving_tokens_out_total"]["value"] == 8
+    assert snap["serving_ttft_seconds"]["count"] == 2
+    assert snap["scheduler_submitted_total"]["value"] == 2
+    # Counter compatibility surface still reads through.
+    assert engine.metrics.completed == 2 and engine.metrics.tokens_out == 8
+
+
 # -- TCP front end -----------------------------------------------------------
 
 def test_tcp_server_streams_and_matches_generate(lm, rng):
@@ -330,6 +422,42 @@ def test_tcp_server_streams_and_matches_generate(lm, rng):
     assert s1 == d1["tokens"] == _want(lm, p1, 5)
     assert s2 == d2["tokens"] == _want(lm, p2, 5)
     assert d1["ttft_ms"] > 0 and d1["latency_ms"] >= d1["ttft_ms"]
+
+
+def test_tcp_server_metricsz_and_healthz_verbs(lm, rng):
+    """Live metrics exposition over the existing JSONL protocol: one
+    request line in, one reply line out — JSON snapshot, the Prometheus
+    text page, and the engine health view."""
+    model, variables = lm
+
+    async def go():
+        engine = ServingEngine(model, variables, slots=2)
+        server = ServingServer(engine, port=0)
+        await server.start()
+        async with ServingClient("127.0.0.1", server.port) as c:
+            await c.generate(_prompt(rng, 4), 3)
+            snap = await c.metricsz()
+            prom = await c.metricsz(format="prometheus")
+            health = await c.healthz()
+            c._writer.write(b'{"cmd": "nope"}\n')
+            await c._writer.drain()
+            import json as _json
+
+            bad = _json.loads(await c._reader.readline())
+            # The connection still serves generation after control verbs.
+            toks = [t async for t in c.stream(_prompt(rng, 3), 2)]
+        await server.stop(drain=True)
+        return snap, prom, health, bad, toks
+
+    snap, prom, health, bad, toks = asyncio.run(go())
+    assert snap["serving_requests_completed_total"]["value"] == 1
+    assert snap["serving_ttft_seconds"]["count"] == 1
+    assert "# TYPE serving_ttft_seconds histogram" in prom
+    assert "serving_requests_completed_total 1" in prom
+    assert health["slots"] == 2 and health["active_slots"] == 0
+    assert health["decode_compile_count"] in (1, -1)
+    assert bad["code"] == "bad_request"
+    assert len(toks) == 2
 
 
 def test_tcp_server_rejects_bad_and_overflow_requests(lm, rng):
