@@ -366,28 +366,20 @@ def _previous_same_config(metric: str, batch: int, on_cpu: bool,
     return None, None
 
 
-def _record_history(metric: str, batch: int, on_cpu: bool, value: float,
-                    shape: str = "", forced: bool = False) -> None:
-    path = os.path.join(HERE, "bench_history.json")
-    try:
-        with open(path) as f:
-            hist = json.load(f)
-    except (OSError, ValueError):
-        hist = {}
-    key = _config_key(metric, batch, on_cpu, shape, forced)
-    entry = {
-        "value": value, "when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-    }
-    # Keep a bounded trail of displaced entries: the latest-vs-prior drift
-    # check (scripts/check_bench_regression.py) needs the previous
-    # same-config row even after this overwrite. Rows predating the trail
-    # field just start one. Only numeric values enter the trail — a null
-    # row from an aborted child would otherwise occupy trail slots
-    # forever (same filter check_bench_regression applies on read).
+def history_entry(old: dict | None, value: float, when: str) -> dict:
+    """Next ``bench_history.json`` row: the new value plus a bounded
+    trail of displaced entries — the latest-vs-prior drift check
+    (scripts/check_bench_regression.py) needs the previous same-config
+    row even after an overwrite. Rows predating the trail field just
+    start one. Only numeric values enter the trail — a null row from an
+    aborted child would otherwise occupy trail slots forever (same
+    filter check_bench_regression applies on read). Shared with
+    benchmarks/serving_bench.py so training and serving rows keep one
+    entry shape."""
     def _numeric(v):
         return isinstance(v, (int, float)) and not isinstance(v, bool)
 
-    old = hist.get(key)
+    entry = {"value": value, "when": when}
     if isinstance(old, dict):
         prev = [
             p for p in old.get("prev", [])
@@ -397,11 +389,14 @@ def _record_history(metric: str, batch: int, on_cpu: bool, value: float,
             prev.append({"value": old["value"], "when": old.get("when")})
         if prev:
             entry["prev"] = prev[-20:]
-    hist[key] = entry
+    return entry
+
+
+def write_history(path: str, hist: dict) -> None:
+    """Write-then-rename: the parent kills a bench child on its deadline,
+    and a kill landing mid-dump must not truncate the history (the next
+    run would silently reset it and lose every drift baseline)."""
     try:
-        # Write-then-rename: the parent kills this child on its deadline,
-        # and a kill landing mid-dump must not truncate the history (the
-        # next run would silently reset it and lose every drift baseline).
         tmp = path + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(hist, f, indent=1, sort_keys=True)
@@ -409,6 +404,28 @@ def _record_history(metric: str, batch: int, on_cpu: bool, value: float,
         os.replace(tmp, path)
     except OSError:
         pass
+
+
+def load_history(path: str) -> dict:
+    """Current ``bench_history.json`` contents, or an empty dict when the
+    file is missing/corrupt (a fresh history starts over rather than
+    crashing the measurement that wants to record into it)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _record_history(metric: str, batch: int, on_cpu: bool, value: float,
+                    shape: str = "", forced: bool = False) -> None:
+    path = os.path.join(HERE, "bench_history.json")
+    hist = load_history(path)
+    key = _config_key(metric, batch, on_cpu, shape, forced)
+    hist[key] = history_entry(
+        hist.get(key), value,
+        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    write_history(path, hist)
 
 
 def _measure() -> None:

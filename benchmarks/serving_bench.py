@@ -12,17 +12,36 @@ two canonical load shapes:
   (p50/p95/p99 TTFT, queue growth, backpressure rejects when λ exceeds
   capacity).
 
+``--prefix-ratio R`` (with ``--prefix-cache-mb``) switches the workload
+to **shared-prefix traffic**: every prompt is a fixed length
+(``--prompt-len``), the first ``R`` of it drawn from ``--prefix-count``
+distinct "system prompts" and the tail random — the synthetic version of
+template-dominated production traffic. The report then carries the
+prefix-cache hit rate and the split TTFT series (``queue_wait`` vs
+``prefill_device``) alongside the latency percentiles, so a cache-on vs
+cache-off pair of runs shows exactly what the hits buy.
+
 Also verifies the two engine invariants the subsystem is built on, so a
 CPU demo run IS the acceptance test:
 
 1. admission never retraces decode — exactly ONE compiled decode
    executable after the whole run (compile-count probe);
 2. continuous-batched greedy streams match one-shot ``generate()``
-   token-for-token for the same prompts.
+   token-for-token for the same prompts — including chunked
+   (``--prefill-chunk``) and prefix-cached admission.
+
+``--record-history`` appends the run's headline numbers (TTFT/ITL
+percentiles, goodput, hit rate) to ``bench_history.json`` under
+``serving/...`` keys; ``scripts/check_bench_regression.py`` diffs them
+against the prior same-config run (direction-aware: latency up = bad).
 
 Run (CPU):
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py \
         --mode both --requests 24 --slots 4 --metrics-out /tmp/serve.jsonl
+    # shared-prefix workload, cache on:
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mode closed \
+        --seq-len 128 --prompt-len 96 --prefix-ratio 0.75 \
+        --prefix-cache-mb 16 --requests 24
 """
 
 from __future__ import annotations
@@ -49,15 +68,45 @@ def _build(args):
               if args.metrics_out else None)
     engine = ServingEngine(
         model, variables, slots=args.slots, max_queue=args.max_queue,
-        metrics=ServingMetrics(stream, registry=registry))
+        metrics=ServingMetrics(stream, registry=registry),
+        prefill_chunk=args.prefill_chunk,
+        prefix_cache_mb=args.prefix_cache_mb,
+        prefix_block_tokens=args.prefix_block)
     return model, variables, engine, stream
 
 
-def _prompts(args, n):
+def _prompts(args, n, salt=0):
+    # ``salt`` varies per phase so a --mode both run doesn't replay the
+    # closed phase's exact prompts in the open phase — with a prefix
+    # cache that would match the FULL prompts (cached whole in phase one)
+    # and report a hit rate far above the configured --prefix-ratio. The
+    # shared prefixes themselves must NOT vary: draw them pre-salt.
+    rng = np.random.default_rng(args.seed)
+    if args.prefix_ratio > 0:
+        # Shared-prefix workload: fixed-length prompts whose first
+        # prefix_ratio share is one of --prefix-count "system prompts"
+        # (round-robin) and whose tail is per-request random. One prompt
+        # length keeps the parity cross-check at one generate() compile.
+        plen = args.prompt_len or max(args.seq_len - args.new_tokens - 1, 2)
+        plen = min(plen, args.seq_len - args.new_tokens)
+        pre_len = min(int(plen * args.prefix_ratio), plen - 1)
+        prefixes = [rng.integers(0, args.vocab, size=pre_len).tolist()
+                    for _ in range(max(1, args.prefix_count))]
+        tail_rng = np.random.default_rng(args.seed + 7919 * salt)
+        return [prefixes[i % len(prefixes)]
+                + tail_rng.integers(0, args.vocab,
+                                    size=plen - pre_len).tolist()
+                for i in range(n)]
+    if args.prompt_len:
+        rng = np.random.default_rng(args.seed + 7919 * salt)
+        return [rng.integers(0, args.vocab, size=args.prompt_len).tolist()
+                for _ in range(n)]
     # Lengths from a small fixed set: the engine handles any length, but
     # the parity cross-check's generate() compiles once per distinct
     # prompt shape — a handful of lengths keeps a CPU demo run fast.
-    rng = np.random.default_rng(args.seed)
+    # Salted like the branches above (same shapes, fresh tokens), so a
+    # cache-enabled --mode both run doesn't replay phase one's prompts.
+    rng = np.random.default_rng(args.seed + 7919 * salt)
     pool = [k for k in (3, 5, 8, 13) if k < args.seq_len // 2] or [3]
     lens = rng.choice(pool, size=n)
     return [rng.integers(0, args.vocab, size=int(k)).tolist() for k in lens]
@@ -109,6 +158,51 @@ def _check_parity(model, variables, results, new_tokens):
     return mismatches
 
 
+# Headline metrics worth a drift gate, per mode section of the report.
+_HISTORY_METRICS = (
+    "ttft_p50_s", "ttft_p99_s", "inter_token_p50_s", "inter_token_p99_s",
+    "prefill_device_p50_s", "goodput_tokens_per_sec", "prefix_hit_rate",
+)
+
+
+def _record_history(args, report):
+    """Append this run's headline numbers to ``bench_history.json`` under
+    ``serving/...`` keys, via ``bench.py``'s shared ``history_entry`` /
+    ``write_history`` helpers — training and serving rows keep ONE entry
+    shape for ``scripts/check_bench_regression.py`` to diff. Latency
+    metrics are named so the checker knows lower-is-better."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench  # stdlib-only parent module
+
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    base = f"serving/{args.model}/slots{args.slots}"
+    if args.prefix_ratio > 0:
+        base += f"/prefix{args.prefix_ratio:g}x{args.prefix_count}"
+    if args.prefix_cache_mb > 0:
+        base += f"/cache{args.prefix_cache_mb:g}mb"
+    if args.prefill_chunk:
+        base += f"/chunk{args.prefill_chunk}"
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    for mode in ("closed", "open"):
+        sec = report.get(mode)
+        if not isinstance(sec, dict):
+            continue
+        for metric in _HISTORY_METRICS:
+            v = sec.get(metric)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            key = f"{base}/{mode}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="both",
@@ -126,6 +220,23 @@ def main():
     ap.add_argument("--rate", type=float, default=30.0,
                     help="open-loop offered load, req/s")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--prompt-len", type=int, default=None,
+                    help="fixed prompt length (default: small mixed pool; "
+                         "required basis for the shared-prefix workload)")
+    ap.add_argument("--prefix-ratio", type=float, default=0.0,
+                    help="> 0: shared-prefix workload — this share of "
+                         "every prompt comes from a shared system prompt")
+    ap.add_argument("--prefix-count", type=int, default=1,
+                    help="distinct shared prefixes in the workload")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="engine chunked-prefill size (tokens)")
+    ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="engine prefix-cache byte budget (MB); 0 = off")
+    ap.add_argument("--prefix-block", type=int, default=16,
+                    help="prefix-cache block granularity (tokens)")
+    ap.add_argument("--record-history", action="store_true",
+                    help="append serving/* rows to bench_history.json for "
+                         "scripts/check_bench_regression.py")
     ap.add_argument("--metrics-out", default=None)
     ap.add_argument("--trace-out", default=None,
                     help="enable spans; export the run as Chrome-trace "
@@ -145,17 +256,23 @@ def main():
     report = {"config": {
         "model": args.model, "slots": args.slots, "requests": args.requests,
         "new_tokens": args.new_tokens, "mode": args.mode,
+        "prompt_len": args.prompt_len, "prefix_ratio": args.prefix_ratio,
+        "prefix_count": args.prefix_count,
+        "prefill_chunk": args.prefill_chunk,
+        "prefix_cache_mb": args.prefix_cache_mb,
+        "prefix_block": args.prefix_block,
     }}
 
-    async def run_mode(mode):
+    async def run_mode(mode, phase):
         task = asyncio.create_task(engine.run())
         t0 = time.monotonic()
         if mode == "closed":
-            results = await _closed_loop(engine, _prompts(args, args.requests), args)
+            results = await _closed_loop(
+                engine, _prompts(args, args.requests, salt=phase), args)
             rejects = 0
         else:
             results, rejects = await _open_loop(
-                engine, _prompts(args, args.requests), args)
+                engine, _prompts(args, args.requests, salt=phase), args)
         elapsed = time.monotonic() - t0
         engine.shutdown(drain=True)
         await task
@@ -166,13 +283,14 @@ def main():
         # loop they first run on, so sequential asyncio.run loops would
         # strand the engine's scheduler (reopen() also guards this).
         all_results = []
-        for mode in (["closed", "open"] if args.mode == "both"
-                     else [args.mode]):
+        for phase, mode in enumerate(["closed", "open"]
+                                     if args.mode == "both"
+                                     else [args.mode]):
             # Fresh metrics per phase (shared JSONL stream): the report's
             # per-mode percentiles must cover THIS load shape only, and
             # tokens_per_sec must divide by this phase's clock.
             engine.metrics = ServingMetrics(stream)
-            results, rejects, elapsed = await run_mode(mode)
+            results, rejects, elapsed = await run_mode(mode, phase)
             all_results.extend(results)
             done_tokens = sum(len(t) for _, t in results)
             summary = engine.metrics.emit_summary()
@@ -184,7 +302,8 @@ def main():
                 **{k: (round(v, 6) if isinstance(v, float) else v)
                    for k, v in summary.items()
                    if k.startswith(("ttft", "inter_token", "queue", "slot",
-                                    "tokens_per_sec", "requests"))},
+                                    "tokens_per_sec", "requests",
+                                    "prefill", "prefix"))},
             }
             engine.reopen()
         return all_results
@@ -192,6 +311,8 @@ def main():
     try:
         all_results = asyncio.run(run_all())
 
+        if engine.prefix_cache is not None:
+            report["prefix_cache"] = engine.prefix_cache.stats()
         compiles = engine.decode_compile_count()
         report["decode_compile_count"] = compiles
         assert compiles in (1, -1), (
@@ -210,6 +331,8 @@ def main():
             report["trace_out"] = tracer.export_chrome_trace(args.trace_out)
         if stream is not None:
             stream.close()
+    if args.record_history:
+        _record_history(args, report)
     print(json.dumps(report, indent=1))
 
 

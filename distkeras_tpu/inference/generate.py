@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["generate", "beam_search", "Generator"]
+__all__ = ["generate", "beam_search", "Generator", "cache_with_index"]
 
 
 def _decode_module(model, slots: bool = False):
@@ -118,6 +118,18 @@ def _empty_cache(module, batch_size: int):
         jax.random.PRNGKey(0),
     )["cache"]
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def cache_with_index(cache, index):
+    """Return ``cache`` with every 1-D index leaf (the per-row cache and
+    positional counters) set to ``index`` — the ONE way offsets move in a
+    decode cache. Serving uses it to start a prefill chunk at a non-zero
+    offset (after a prefix-cache splice or an earlier chunk) and to rewind
+    a right-padded prefill from the padded length back to the true one;
+    K/V leaves pass through untouched. ``index`` may be traced (safe
+    inside jit)."""
+    return jax.tree.map(
+        lambda a: jnp.full_like(a, index) if a.ndim == 1 else a, cache)
 
 
 def sample_rows(logits, temps, key, top_k):

@@ -73,8 +73,12 @@ class BertConfig:
     # one shared scalar, so each batch slot can sit at a different
     # sequence position — the property that lets a serving engine admit a
     # new request into a free slot while other slots are mid-decode,
-    # inside one compiled step. Requires ``decode=True``; params are
-    # still layout-identical to the training model.
+    # inside one compiled step. The same index leaves make prefill
+    # restartable at any offset (pre-set them to ``n`` and an apply
+    # continues the sequence at position ``n`` — see _decode_attention's
+    # non-zero-offset contract), which is what the engine's chunked
+    # prefill and prefix-cache splice build on. Requires ``decode=True``;
+    # params are still layout-identical to the training model.
     decode_slots: bool = False
 
 
@@ -173,7 +177,19 @@ class SelfAttention(nn.Module):
         serves prefill (S = prompt length, cache index 0) and per-token
         decode (S = 1): new K/V write at the cache index, the query attends
         to the full fixed-length cache under a global-position mask, and the
-        index advances by S — every shape static for XLA."""
+        index advances by S — every shape static for XLA.
+
+        Non-zero-offset contract (what chunked prefill and the serving
+        prefix cache rely on): the write position, the query positions,
+        and the positional-embedding slice all derive from the cache/pos
+        index leaves, never from an implicit "start at 0" — so an apply
+        whose index leaves were pre-set to ``n`` (``inference.generate.
+        cache_with_index``) continues a sequence at position ``n``
+        exactly as if positions ``[0, n)`` had been run through this same
+        module, provided the cache rows ``[0, n)`` hold that prefix's
+        K/V (e.g. spliced from ``serving.prefix_cache.PrefixCache``).
+        Garbage rows at ``>= n`` stay invisible: ``k_pos <= q_pos`` masks
+        every position not yet written by a real token."""
         import jax
         import jax.lax as lax
 

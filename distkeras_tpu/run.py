@@ -80,6 +80,18 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--max-queue", type=int, default=64,
                     help="admission queue depth before queue_full rejects")
     ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="split prompt prefill into chunks of this many "
+                         "tokens, one per decode tick — bounds the decode "
+                         "stall (p99 ITL) a long prompt can cause; "
+                         "default: monolithic prefill")
+    ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="> 0 enables the device-resident prompt prefix "
+                         "cache under this byte budget: shared prefixes "
+                         "(system prompts, templates) splice cached KV "
+                         "blocks instead of recomputing prefill")
+    ap.add_argument("--prefix-block", type=int, default=16,
+                    help="prefix-cache block granularity in tokens")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-out", default=None,
                     help="JSONL per-iteration serving metrics")
@@ -126,7 +138,10 @@ def serve_main(argv=None) -> int:
         model, variables, slots=args.slots, max_queue=args.max_queue,
         top_k=args.top_k, metrics=metrics, seed=args.seed,
         auditor=auditor,
-        arm_auditor_after_warmup=args.audit_recompiles == "arm")
+        arm_auditor_after_warmup=args.audit_recompiles == "arm",
+        prefill_chunk=args.prefill_chunk,
+        prefix_cache_mb=args.prefix_cache_mb,
+        prefix_block_tokens=args.prefix_block)
     server = ServingServer(engine, host=args.host, port=args.port)
 
     async def go():
@@ -136,6 +151,8 @@ def serve_main(argv=None) -> int:
         print(json.dumps({
             "serving": args.model, "host": args.host, "port": server.port,
             "slots": args.slots, "max_queue": args.max_queue,
+            "prefill_chunk": args.prefill_chunk,
+            "prefix_cache_mb": args.prefix_cache_mb,
         }), flush=True)
         # Signal-driven shutdown INSIDE the loop: a raw KeyboardInterrupt
         # out of asyncio.run would cancel the engine task before the
@@ -150,6 +167,8 @@ def serve_main(argv=None) -> int:
         await stop.wait()
         await server.stop(drain=True)
         summary = {k: round(v, 6) for k, v in metrics.summary().items()}
+        if engine.prefix_cache is not None:
+            summary["prefix_cache"] = engine.prefix_cache.stats()
         if auditor is not None:
             summary["recompile_audit"] = auditor.report()
         print(json.dumps(summary), flush=True)

@@ -8,9 +8,16 @@ request path:
 
 - :class:`ServingEngine` — fixed-slot continuous batching: one compiled
   decode step for the lifetime of the server, requests admitted into free
-  slots mid-decode (no retrace, no drain);
+  slots mid-decode (no retrace, no drain), optionally with **chunked
+  prefill** (``prefill_chunk``: long prompts admit one bounded chunk per
+  decode tick) and a **prefix cache** (``prefix_cache_mb``);
+- :class:`PrefixCache` — device-resident pool of fixed-size KV blocks
+  keyed by a radix trie over prompt prefixes (ref-counted, LRU-evicted
+  under a byte budget): a hit splices cached blocks instead of
+  recomputing the shared prefix's prefill;
 - :class:`Scheduler` / :class:`Request` — priority-FIFO admission with
-  max-depth backpressure and per-request deadlines;
+  max-depth backpressure, per-request deadlines, and (with a prefix
+  cache) bounded cache-aware reordering within a priority class;
 - :class:`ServingServer` / :class:`ServingClient` — asyncio TCP front end
   with newline-delimited-JSON streaming token output;
 - :class:`ServingMetrics` — TTFT / inter-token latency / occupancy
@@ -27,12 +34,14 @@ from distkeras_tpu.serving.scheduler import (
     ServingError,
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.serving.prefix_cache import PrefixCache
 from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.server import ServingServer
 from distkeras_tpu.serving.client import ServingClient
 
 __all__ = [
     "ServingEngine",
+    "PrefixCache",
     "Scheduler",
     "Request",
     "ServingServer",

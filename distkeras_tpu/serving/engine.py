@@ -18,6 +18,15 @@ step for the lifetime of the server) while making the batch *open*:
   power-of-two prompt-length bucket) whose single-row KV cache is spliced
   into the live batch cache with ``dynamic_update_slice`` — the decode
   step itself never retraces and never stops for admission;
+- with a **prefix cache** (``prefix_cache_mb``), the prompt's longest
+  cached block-chain prefix is spliced from a device-resident pool
+  (:mod:`distkeras_tpu.serving.prefix_cache`) instead of recomputed —
+  only the uncached tail runs through the prefill program;
+- with **chunked prefill** (``prefill_chunk``), that tail is split into
+  fixed-size chunks and ONE chunk runs per engine iteration, interleaved
+  with decode ticks — admitting a long prompt never stalls the decode
+  batch for more than one chunk's device time, bounding every in-flight
+  request's inter-token latency;
 - free rows keep decoding garbage (their output is discarded) — the cost
   of a fixed-shape batch, and exactly the trade the training side makes
   with padded microbatches.
@@ -46,9 +55,11 @@ from distkeras_tpu.inference.generate import (
     _context_limit,
     _decode_module,
     _empty_cache,
+    cache_with_index,
     sample_rows,
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.serving.prefix_cache import PrefixCache
 from distkeras_tpu.telemetry import RecompileAuditor, span
 from distkeras_tpu.serving.scheduler import (
     EngineStopped,
@@ -62,26 +73,33 @@ from distkeras_tpu.serving.scheduler import (
 __all__ = ["ServingEngine"]
 
 
-def _prefill_fn(module, top_k, params, padded, true_len, temp, key):
-    """Run a right-padded ``[1, P]`` prompt through the decode module,
-    producing the slot's KV cache and first sampled token.
+def _prefill_fn(module, top_k, params, cache, padded, start, true_len, temp,
+                key):
+    """Run a right-padded ``[1, P]`` prompt *chunk* through the decode
+    module at cache offset ``start``, extending the slot's KV cache and
+    sampling the token that follows the chunk.
+
+    ``start`` and ``true_len`` are traced scalars, so ONE compiled program
+    serves every offset and every true length of a given pad width ``P``
+    — monolithic prefill is the ``start == 0, P == bucket(prompt)`` case,
+    a chunk of a longer prompt (or of the uncached tail after a
+    prefix-cache splice) is the same program at a non-zero start.
 
     Padding is benign: causal attention means real positions never see the
-    pad tail, the first token samples from the logits at ``true_len - 1``,
-    and the garbage K/V at ``[true_len, P)`` is masked out of every later
-    decode step (``k_pos <= q_pos``) until overwritten by real tokens. The
-    index leaves are rewound from ``P`` to ``true_len`` so decode resumes
-    at the real end of the prompt.
+    pad tail, the sampled token comes from the logits at ``true_len - 1``,
+    and the garbage K/V at ``[start + true_len, start + P)`` is masked out
+    of every later step (``k_pos <= q_pos``) until overwritten by real
+    tokens. The index leaves are set to ``start`` on entry (so a
+    prefix-cache splice never has to touch them) and rewound from
+    ``start + P`` to ``start + true_len`` on exit so the next chunk — or
+    decode — resumes at the real end.
     """
-    cache = _empty_cache(module, 1)
+    cache = cache_with_index(cache, start)
     logits, mut = module.apply(
         {"params": params, "cache": cache}, padded, train=False,
         mutable=["cache"],
     )
-    cache = jax.tree.map(
-        lambda a: jnp.full_like(a, true_len) if a.ndim == 1 else a,
-        mut["cache"],
-    )
+    cache = cache_with_index(mut["cache"], start + true_len)
     last = jnp.take(logits[0], true_len - 1, axis=0)[None]  # [1, V]
     tok = sample_rows(last, temp[None], key, top_k)[0]
     return cache, tok
@@ -116,10 +134,28 @@ def _decode_fn(module, top_k, params, cache, tokens, temps, key):
 
 
 @dataclasses.dataclass
+class _PrefillJob:
+    """Partial-prefill progress for a slot still being admitted: the
+    single-row cache under construction, how far into the prompt it is
+    (prefix-cache splice included), and the pinned match to release."""
+
+    cache: object                 # single-row KV cache pytree
+    pos: int                      # prompt tokens already in the cache
+    match: object | None          # PrefixMatch to release on completion
+    matched_tokens: int
+    chunks_done: int = 0
+    device_s: float = 0.0         # prefill device time (TTFT's other half)
+
+
+@dataclasses.dataclass
 class _SlotState:
     request: Request
     remaining: int  # tokens still to decode after the prefill token
     last_token_t: float
+    # Non-None while the slot's prompt is still prefilling (chunked
+    # admission): the row sits in the decode batch but its garbage output
+    # is discarded until the finished cache is spliced in.
+    prefill: _PrefillJob | None = None
 
 
 class ServingEngine:
@@ -130,6 +166,25 @@ class ServingEngine:
     ``slots``: decode batch width (concurrent in-flight requests).
     ``max_queue``: admission backpressure depth (:class:`QueueFullError`
     beyond it). ``top_k``: engine-wide top-k sampling (None = full vocab).
+
+    ``prefill_chunk``: split each prompt's (uncached) prefill into chunks
+    of this many tokens, ONE chunk per engine iteration (round-robin
+    across concurrently admitting slots) interleaved with decode ticks —
+    bounds the decode stall (and thus every in-flight request's p99
+    inter-token latency) by a single chunk's device time instead of a
+    whole prompt's, regardless of how many prompts are admitting. None
+    (default) keeps monolithic admission. Greedy output is
+    token-identical either way.
+
+    ``prefix_cache_mb``: > 0 enables the device-resident prefix cache
+    (:class:`~distkeras_tpu.serving.prefix_cache.PrefixCache`) under that
+    byte budget, with ``prefix_block_tokens``-token blocks: prompts
+    sharing a cached prefix (system prompts, few-shot templates) splice
+    the matched blocks instead of recomputing them, and the scheduler
+    prefers cache-hitting requests within a priority class. Pass
+    ``prefix_cache=`` to inject a pre-built pool (exact capacity
+    control, test fixtures); the cache is NOT thread-safe — it must be
+    driven by a single engine's loop at a time.
 
     Drive it with :meth:`submit` + :meth:`run` (asyncio); blocking device
     work (prefill, decode step) runs in the default executor so the event
@@ -149,9 +204,16 @@ class ServingEngine:
         min_prefill_bucket: int = 8,
         auditor: RecompileAuditor | None = None,
         arm_auditor_after_warmup: bool = False,
+        prefill_chunk: int | None = None,
+        prefix_cache_mb: float = 0.0,
+        prefix_block_tokens: int = 16,
+        prefix_cache: PrefixCache | None = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
         self.model = model
         self._module, self._cfg = _decode_module(model, slots=True)
         if top_k is not None and not 1 <= top_k <= self._cfg.vocab_size:
@@ -168,6 +230,8 @@ class ServingEngine:
         self.scheduler = Scheduler(max_depth=max_queue,
                                    registry=self.metrics.registry)
         self._min_bucket = int(min_prefill_bucket)
+        self._chunk = None if prefill_chunk is None else int(prefill_chunk)
+        self._prefill_rr = 0  # round-robin cursor over prefilling slots
         self._key = jax.random.PRNGKey(seed)
 
         # Device-resident batch state.
@@ -176,14 +240,50 @@ class ServingEngine:
         self._temps = jnp.zeros((self.slots,), jnp.float32)
         self._slot_state: list[_SlotState | None] = [None] * self.slots
 
+        # Single-row cache geometry, captured ONCE: eval_shape traces the
+        # module's init, far too slow to re-run per admission. The zeroed
+        # cache itself comes from ONE jitted factory (fused device-side
+        # zeros, same cost profile as the zeros the prefill program used
+        # to create in-jit) instead of a per-leaf host dispatch per
+        # admission.
+        self._row_shapes = jax.eval_shape(
+            lambda r: self._module.init(
+                r, jnp.zeros((1, 1), jnp.int32), train=False),
+            jax.random.PRNGKey(0),
+        )["cache"]
+        self._fresh_row_cache = jax.jit(lambda: jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._row_shapes))
+
+        # Prefix cache: a byte-budgeted pool of KV blocks shared across
+        # requests (serving/prefix_cache.py). An explicit instance wins
+        # (tests / multi-engine sharing); prefix_cache_mb > 0 builds one.
+        if prefix_cache is not None:
+            self.prefix_cache = prefix_cache
+        elif prefix_cache_mb > 0:
+            self.prefix_cache = PrefixCache(
+                self._row_shapes, block_tokens=prefix_block_tokens,
+                budget_bytes=int(prefix_cache_mb * 2**20),
+                registry=self.metrics.registry)
+        else:
+            self.prefix_cache = None
+        if self.prefix_cache is not None:
+            # Cache-aware admission: the scheduler may prefer (within one
+            # priority class, bounded window) the queued request whose
+            # prefix is already resident — see Scheduler.pop.
+            self.scheduler.cache_probe = self.prefix_cache.probe
+
         # One jit wrapper per engine so compile counts are per-instance:
         # the decode step must stay at exactly one executable for the
         # server's lifetime (see decode_compile_count()). The live batch
         # cache/tokens are donated — the engine rebinds them from each
         # call's outputs, and donation keeps the multi-MB KV caches
         # updating in place instead of copying per decoded token. _temps
-        # is NOT donated in decode (it persists across iterations).
-        self._prefill = jax.jit(functools.partial(_prefill_fn, self._module, top_k))
+        # is NOT donated in decode (it persists across iterations). The
+        # prefill's incoming single-row cache is donated too: a chunk
+        # chain threads one cache through every call, updating in place.
+        self._prefill = jax.jit(
+            functools.partial(_prefill_fn, self._module, top_k),
+            donate_argnums=(1,))
         self._admit_jit = jax.jit(_admit_fn, donate_argnums=(0, 1, 2))
         self._decode_step = jax.jit(
             functools.partial(_decode_fn, self._module, top_k),
@@ -317,12 +417,14 @@ class ServingEngine:
                     if st.request.cancelled:
                         self._finish_error(st.request, RequestCancelled(
                             f"cancelled with {st.remaining} tokens undecoded"))
+                        self._release_prefill(st)
                         self._slot_state[i] = None
                     elif dl is not None and now > dl:
                         self.metrics.record_expire()
                         self._finish_error(st.request, RequestTimeout(
                             f"deadline exceeded after {st.request.timeout}s "
                             f"with {st.remaining} tokens undecoded"))
+                        self._release_prefill(st)
                         self._slot_state[i] = None
                 # 3. Shutdown: flush the queue with typed errors.
                 if self._stopping:
@@ -335,28 +437,68 @@ class ServingEngine:
                 # events are not thread-safe).
                 if not self._stopping:
                     while self.free_slots and len(self.scheduler):
-                        req = self.scheduler.pop(now)
+                        # Fresh clock per pop: an earlier admission's
+                        # prefill may have taken long enough that more
+                        # queued deadlines expired — a stale `now` would
+                        # admit (and fully prefill) an already-dead
+                        # request.
+                        req = self.scheduler.pop(time.monotonic())
                         if req is None:
                             break
                         slot = self._slot_state.index(None)
-                        # Queue wait ends HERE (slot granted); TTFT below
-                        # additionally includes the prefill device time —
-                        # recording both apart is what lets an operator
-                        # split admission delay from prefill cost.
-                        self.metrics.record_admit(
-                            time.monotonic() - req.t_submit)
-                        with span("admit", slot=slot,
-                                  prompt_len=len(req.prompt)):
-                            tok0 = await self._in_executor(
-                                loop, self._prefill_admit, req, slot)
-                        t = time.monotonic()
-                        st = _SlotState(req, req.max_new_tokens, t)
+                        # ADMISSION WAIT ends HERE (slot granted); the
+                        # PREFILL DEVICE TIME is recorded separately when
+                        # the prefill completes (record_prefill). The two
+                        # series — plus chunk-interleave wait in chunked
+                        # mode — make up TTFT, so an operator can tell
+                        # queueing delay from prefill cost.
+                        wait = time.monotonic() - req.t_submit
+                        self.metrics.record_admit(wait)
+                        st = _SlotState(req, req.max_new_tokens,
+                                        time.monotonic())
                         self._slot_state[slot] = st
-                        self._push_token(st, tok0, t, first=True)
-                        st.remaining -= 1
-                        if st.remaining == 0:
-                            self._finish_ok(req)
-                            self._slot_state[slot] = None
+                        with span("admit", slot=slot,
+                                  prompt_len=len(req.prompt),
+                                  queue_wait_s=round(wait, 6)):
+                            # Prefix-cache lookup + splice: a hit makes
+                            # admission nearly free — the matched prefix's
+                            # prefill compute is skipped entirely.
+                            st.prefill = await self._in_executor(
+                                loop, self._begin_prefill, req)
+                            if self._chunk is None:
+                                # Monolithic prefill: the whole uncached
+                                # tail, admitted inline. Normally ONE
+                                # call; near-context-limit prompts may
+                                # split into a few pow2 sub-chunks (see
+                                # _prefill_step's overshoot guard).
+                                tok0 = None
+                                while tok0 is None:
+                                    tok0 = await self._in_executor(
+                                        loop, self._prefill_step, st, slot)
+                                self._finish_admission(st, slot, tok0)
+                # 4b. Chunked prefill: ONE chunk per iteration TOTAL,
+                # round-robin across prefilling slots, interleaved with
+                # the decode tick below — the decode batch never stalls
+                # for more than a single chunk's device time no matter
+                # how many prompts are admitting at once (concurrent
+                # admissions stretch each other's TTFT instead). Runs
+                # during drain shutdown too (a half-prefilled slot must
+                # finish for run() to exit).
+                if self._chunk is not None:
+                    pending = [i for i, st in enumerate(self._slot_state)
+                               if st is not None and st.prefill is not None]
+                    if pending:
+                        start = self._prefill_rr
+                        i = min(pending,
+                                key=lambda s: (s - start) % self.slots)
+                        self._prefill_rr = (i + 1) % self.slots
+                        st = self._slot_state[i]
+                        with span("prefill_tick", slot=i,
+                                  offset=st.prefill.pos):
+                            tok0 = await self._in_executor(
+                                loop, self._prefill_step, st, i)
+                        if tok0 is not None:
+                            self._finish_admission(st, i, tok0)
                 # 5. Nothing in flight?
                 if self.active_slots == 0:
                     if self._stopping:
@@ -368,26 +510,34 @@ class ServingEngine:
                         if st is not None:
                             self._finish_error(st.request, EngineStopped(
                                 "engine shut down mid-decode"))
+                            self._release_prefill(st)
                             self._slot_state[i] = None
                     break
-                # 6. One decode iteration for the whole batch.
-                with span("decode_tick", active=self.active_slots):
-                    nxt = await self._in_executor(loop, self._decode_sync)
-                if self._arm_after_warmup and self.auditor is not None:
-                    # First decode iteration IS the warmup: the one
-                    # executable exists now, so every later compile is a
-                    # violated invariant.
-                    self._arm_after_warmup = False
-                    self.auditor.arm("serving_decode")
-                t = time.monotonic()
-                with span("stream", active=self.active_slots):
-                    for i, st in enumerate(self._slot_state):
-                        if st is None:
-                            continue
-                        self._push_token(st, int(nxt[i]), t)
-                        if st.remaining == 0:
-                            self._finish_ok(st.request)
-                            self._slot_state[i] = None
+                # 6. One decode iteration for the whole batch — skipped
+                # while EVERY active slot is still mid-prefill (the whole
+                # tick's output would be discarded; the chunk in 4b was
+                # this iteration's useful device work).
+                if any(st is not None and st.prefill is None
+                       for st in self._slot_state):
+                    with span("decode_tick", active=self.active_slots):
+                        nxt = await self._in_executor(loop, self._decode_sync)
+                    if self._arm_after_warmup and self.auditor is not None:
+                        # First decode iteration IS the warmup: the one
+                        # executable exists now, so every later compile is
+                        # a violated invariant.
+                        self._arm_after_warmup = False
+                        self.auditor.arm("serving_decode")
+                    t = time.monotonic()
+                    with span("stream", active=self.active_slots):
+                        for i, st in enumerate(self._slot_state):
+                            if st is None or st.prefill is not None:
+                                # Mid-prefill rows decode garbage until
+                                # their finished cache is spliced in.
+                                continue
+                            self._push_token(st, int(nxt[i]), t)
+                            if st.remaining == 0:
+                                self._finish_ok(st.request)
+                                self._slot_state[i] = None
                 self.metrics.sample(
                     len(self.scheduler), self.active_slots, self.slots)
                 # Yield so the server can read sockets between iterations.
@@ -403,6 +553,7 @@ class ServingEngine:
             for i, st in enumerate(self._slot_state):
                 if st is not None:
                     self._finish_error(st.request, err)
+                    self._release_prefill(st)
                     self._slot_state[i] = None
             for req in self.scheduler.drain():
                 self._finish_error(req, err)
@@ -421,33 +572,116 @@ class ServingEngine:
         ctx = contextvars.copy_context()
         return loop.run_in_executor(None, lambda: ctx.run(fn, *args))
 
-    def _bucket(self, n: int) -> int:
+    def _bucket(self, n: int, cap: int | None = None) -> int:
         """Prefill pad length: next power of two >= n (>= min bucket),
-        capped at the decodable context — bounds prefill compiles at
+        capped at the decodable context (and at ``cap`` — the chunk size,
+        for a ragged final chunk) — bounds prefill compiles at
         log2(context) programs total."""
         b = self._min_bucket
         while b < n:
             b *= 2
-        return min(b, self.limit)
+        return min(b, self.limit if cap is None else min(cap, self.limit))
 
-    def _prefill_admit(self, req: Request, slot: int) -> int:
-        """Blocking prefill + cache splice (device work only — runs in the
-        executor; caller does stream bookkeeping on the loop thread).
-        Returns the request's first token."""
+    def _release_prefill(self, st: _SlotState) -> None:
+        """Drop a slot's pending prefill (cancel/expiry/shutdown paths):
+        unpin its prefix-cache match so the blocks become evictable."""
+        if st.prefill is not None:
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(st.prefill.match)
+            st.prefill = None
+
+    def _finish_admission(self, st: _SlotState, slot: int, tok0: int) -> None:
+        """Loop-thread bookkeeping once a slot's prefill completed: stream
+        the first token (TTFT stamp) and free the slot if one token was
+        all the request wanted."""
+        t = time.monotonic()
+        self._push_token(st, tok0, t, first=True)
+        st.remaining -= 1
+        if st.remaining == 0:
+            self._finish_ok(st.request)
+            self._slot_state[slot] = None
+
+    def _begin_prefill(self, req: Request) -> _PrefillJob:
+        """Start a prompt's prefill (executor thread): allocate the
+        single-row cache and splice in the longest cached prefix — a hit
+        skips that prefix's prefill compute entirely; the uncached tail
+        runs through :meth:`_prefill_step` chunk by chunk."""
+        cache = self._fresh_row_cache()
+        match, matched = None, 0
+        if self.prefix_cache is not None:
+            match = self.prefix_cache.match(req.prompt)
+            matched = match.matched_tokens
+            if matched:
+                with span("prefix_splice", blocks=len(match.ids),
+                          tokens=matched):
+                    cache = self.prefix_cache.splice(cache, match.ids)
+        return _PrefillJob(cache=cache, pos=matched, match=match,
+                           matched_tokens=matched)
+
+    def _prefill_step(self, st: _SlotState, slot: int) -> int | None:
+        """Run ONE prefill chunk for the slot (executor thread; device
+        work only). Returns None while the prompt is still incomplete;
+        on the final chunk, stores the prompt's new blocks into the
+        prefix cache, splices the finished single-row cache into batch
+        row ``slot``, and returns the request's first token."""
+        req, job = st.request, st.prefill
         s0 = len(req.prompt)
-        P = self._bucket(s0)
+        rem = s0 - job.pos
+        c = rem if self._chunk is None else min(self._chunk, rem)
+        if self._chunk is None:
+            P = self._bucket(c)
+        elif c == self._chunk:
+            P = self._chunk  # full chunk: ONE fixed-size program
+        else:
+            P = self._bucket(c, cap=self._chunk)  # ragged final chunk
+        # The pad width must never overshoot the cache: with job.pos + P
+        # > max_seq_len the per-slot KV write would clamp its start
+        # backward (bert.py's OOB discipline) and silently overwrite the
+        # spliced prefix rows. Rather than compiling a bespoke
+        # non-power-of-two width per matched length, shrink to the
+        # largest power of two that fits and let the NEXT call(s) finish
+        # the remainder — the compile set stays pow2-bounded and no
+        # token is prefilled twice. (Monolithic admission loops on this
+        # method until it returns a token, so near-context-limit prompts
+        # just take an extra sub-chunk or two.)
+        room = self._cfg.max_seq_len - job.pos
+        if P > room:
+            P = 1
+            while P * 2 <= room:
+                P *= 2
+            c = min(c, P)  # room >= rem >= 1, so P >= 1 and c >= 1
         padded = np.zeros((1, P), np.int32)
-        padded[0, :s0] = req.prompt
+        padded[0, :c] = req.prompt[job.pos:job.pos + c]
         self._key, sub = jax.random.split(self._key)
         temp = jnp.float32(req.temperature)
-        with span("prefill", bucket=P, prompt_len=s0):
-            pre_cache, tok0 = self._prefill(
-                self._params, jnp.asarray(padded), jnp.int32(s0), temp, sub)
+        t0 = time.monotonic()
+        with span("prefill", bucket=P, offset=job.pos, prompt_len=s0):
+            job.cache, tok = self._prefill(
+                self._params, job.cache, jnp.asarray(padded),
+                jnp.int32(job.pos), jnp.int32(c), temp, sub)
+            tok0 = int(tok)  # blocks: honest device time per chunk
+        job.device_s += time.monotonic() - t0
+        job.chunks_done += 1
+        job.pos += c
+        if job.pos < s0:
+            return None
+        # Prompt complete. Store the complete blocks this prefill
+        # computed (future requests sharing the prefix hit them), then
+        # splice the row into the live batch cache.
+        if self.prefix_cache is not None:
+            with span("prefix_insert", prompt_len=s0):
+                self.prefix_cache.insert(req.prompt, job.cache)
+            self.prefix_cache.release(job.match)
         with span("cache_splice", slot=slot):
             self._cache, self._tokens, self._temps = self._admit_jit(
                 self._cache, self._tokens, self._temps, jnp.int32(slot),
-                pre_cache, tok0, temp)
-        return int(tok0)
+                job.cache, tok, temp)
+        self.metrics.record_prefill(
+            job.device_s, job.chunks_done,
+            job.matched_tokens if self.prefix_cache is not None else None,
+            s0)
+        st.prefill = None
+        return tok0
 
     def _decode_sync(self) -> np.ndarray:
         self._key, sub = jax.random.split(self._key)

@@ -2,9 +2,15 @@
 
 Training benchmarks in this repo measure *throughput* (samples/sec/chip,
 ``tracing.StepTimer``); a serving engine is judged on *latency under
-load*: TTFT (time to first token — dominated by queueing + prefill),
-inter-token latency (decode-step cadence), queue depth, slot occupancy,
-and goodput (tokens/sec actually delivered). :class:`ServingMetrics`
+load*: TTFT (time to first token), inter-token latency (decode-step
+cadence), queue depth, slot occupancy, and goodput (tokens/sec actually
+delivered). TTFT is recorded **split into its two causes** — admission
+wait (``queue_wait``: submit → slot grant, the scheduler's doing) and
+prefill device time (``prefill_device``: the chunks' compute, the
+model's doing; any gap between the two in chunked mode is decode-tick
+interleave) — because the operator response differs: queueing delay
+wants more slots or load shedding, prefill cost wants a prefix cache or
+smaller chunks. :class:`ServingMetrics`
 accumulates those and emits structured records through the same
 :class:`distkeras_tpu.tracing.MetricStream` JSONL sinks the trainers use;
 :meth:`ServingMetrics.summary` follows ``StepTimer.summary``'s key
@@ -76,6 +82,8 @@ class ServingMetrics:
         self.ttft = collections.deque(maxlen=window)
         self.inter_token = collections.deque(maxlen=window)
         self.queue_wait = collections.deque(maxlen=window)
+        self.prefill_device = collections.deque(maxlen=window)
+        self.prefill_chunks = collections.deque(maxlen=window)
         self.request_latency = collections.deque(maxlen=window)
         self._occupancy = collections.deque(maxlen=window)
         self._queue_depth = collections.deque(maxlen=window)
@@ -101,12 +109,29 @@ class ServingMetrics:
                 "serving_inter_token_seconds", help="inter-token latency",
                 buckets=_LATENCY_BUCKETS),
             "queue_wait": reg.histogram(
-                "serving_queue_wait_seconds", help="admission queue wait",
+                "serving_queue_wait_seconds",
+                help="admission wait: submit to slot grant "
+                     "(the queueing half of TTFT)",
                 buckets=_LATENCY_BUCKETS),
+            "prefill_device": reg.histogram(
+                "serving_prefill_device_seconds",
+                help="prefill device time per request, summed over chunks "
+                     "(the compute half of TTFT)",
+                buckets=_LATENCY_BUCKETS),
+            "prefill_chunks": reg.histogram(
+                "serving_prefill_chunks",
+                help="prefill chunks per admission",
+                buckets=(1, 2, 4, 8, 16, 32, 64)),
             "request_latency": reg.histogram(
                 "serving_request_latency_seconds",
                 help="submit-to-done latency", buckets=_LATENCY_BUCKETS),
         }
+        self._c_prefix_hit_tokens = reg.counter(
+            "serving_prefix_hit_tokens_total",
+            help="admitted prompt tokens served from the prefix cache")
+        self._c_prompt_tokens = reg.counter(
+            "serving_prompt_tokens_total",
+            help="admitted prompt tokens total")
         self._g_queue_depth = reg.gauge(
             "serving_queue_depth", help="queued requests")
         self._g_slots_active = reg.gauge(
@@ -133,8 +158,27 @@ class ServingMetrics:
 
     # -- per-request events -------------------------------------------------
     def record_admit(self, queue_wait_s: float) -> None:
+        """Admission wait: submit to slot grant (TTFT's queueing half)."""
         self.queue_wait.append(queue_wait_s)
         self._h["queue_wait"].observe(queue_wait_s)
+
+    def record_prefill(self, device_s: float, chunks: int,
+                       matched_tokens: int | None,
+                       prompt_tokens: int) -> None:
+        """Prefill completed: device seconds summed over its chunks
+        (TTFT's compute half), chunk count, and how much of the prompt
+        the prefix cache served (``matched_tokens`` of
+        ``prompt_tokens``). ``matched_tokens=None`` means no prefix
+        cache is configured — the hit counters stay untouched so
+        summaries don't report a 0.0 hit rate for a cache that does not
+        exist."""
+        self.prefill_device.append(device_s)
+        self._h["prefill_device"].observe(device_s)
+        self.prefill_chunks.append(chunks)
+        self._h["prefill_chunks"].observe(chunks)
+        if matched_tokens is not None:
+            self._c_prefix_hit_tokens.inc(matched_tokens)
+            self._c_prompt_tokens.inc(prompt_tokens)
 
     def record_first_token(self, ttft_s: float) -> None:
         self.ttft.append(ttft_s)
@@ -196,6 +240,7 @@ class ServingMetrics:
             ("ttft", self.ttft),
             ("inter_token", self.inter_token),
             ("queue_wait", self.queue_wait),
+            ("prefill_device", self.prefill_device),
             ("request_latency", self.request_latency),
         ):
             if xs:
@@ -203,6 +248,13 @@ class ServingMetrics:
                 out[f"{name}_p95_s"] = percentile(xs, 95)
                 out[f"{name}_p99_s"] = percentile(xs, 99)
                 out[f"{name}_mean_s"] = sum(xs) / len(xs)
+        if self.prefill_chunks:
+            out["prefill_chunks_mean"] = (
+                sum(self.prefill_chunks) / len(self.prefill_chunks))
+            out["prefill_chunks_max"] = float(max(self.prefill_chunks))
+        if self._c_prompt_tokens.value:
+            out["prefix_hit_rate"] = (
+                self._c_prefix_hit_tokens.value / self._c_prompt_tokens.value)
         if self._occupancy:
             out["slot_occupancy_mean"] = (
                 sum(self._occupancy) / len(self._occupancy)

@@ -1,0 +1,406 @@
+"""Device-resident prefix cache: reuse KV blocks across shared prompt prefixes.
+
+Real serving traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn histories. The PR 1 engine recomputed every
+request's KV cache from scratch; this module lets admission *reuse* the
+computation instead: the KV rows of previously-prefilled prompt prefixes
+live in a fixed pool of **blocks** (``block_tokens`` tokens each), keyed
+by a radix trie over the prompt's token blocks, and a cache hit splices
+the matched blocks straight into the request's prefill cache with
+``dynamic_update_slice`` — the matched prefix's prefill compute is
+skipped entirely.
+
+Why this is safe: in a causal LM the K/V at position ``p`` depend only on
+tokens ``[0, p]``, so two prompts sharing a token prefix share that
+prefix's K/V exactly. A block is only ever stored from a fully-prefilled
+cache and only ever matched by the exact token sequence (trie edges are
+the block's token tuple — Python's tuple hashing IS the token hash, and
+the trie structure makes the chain a radix tree over prefixes), so a hit
+cannot alias a different prompt.
+
+Shape discipline (same stance as the engine's three programs):
+
+- the pool is ONE allocation per KV leaf, ``[capacity, block_tokens, H,
+  D]``, sized up-front from a **byte budget** — no per-request device
+  allocation, no growing shapes;
+- store (an insert's new blocks -> pool rows, ONE batched scatter) and
+  splice (pool rows -> cache prefix) each compile once per power-of-two
+  block-count bucket — ≤ log2(max_seq_len / block_tokens) programs each;
+- eviction is pure host bookkeeping (LRU over unreferenced trie leaves):
+  an evicted slot is simply overwritten by the next store.
+
+Ref-counting pins a matched chain for the duration of an admission (a
+concurrently-admitted request must not see its matched blocks overwritten
+mid-prefill); LRU eviction only considers nodes with no live references
+and no children (evicting a mid-chain node would strand its descendants).
+
+NOT thread-safe: the trie and pool are mutated without locks, relying on
+the owning :class:`~distkeras_tpu.serving.engine.ServingEngine`'s loop
+serializing every match/splice/insert (the loop awaits each executor
+call). Do not drive one cache from two concurrently running engines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import heapq
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["PrefixCache", "PrefixMatch"]
+
+
+def _store_fn(block_tokens, pool, cache, slots, off0):
+    """Copy the ``len(slots)`` consecutive cache blocks starting at token
+    ``off0`` into pool rows ``slots`` — ONE scatter per leaf for a whole
+    insert. An insert's new blocks are always a contiguous suffix of the
+    prompt's block chain (a trie node cannot exist without its parent),
+    so one batched program replaces per-block stores — that matters on
+    backends where donation cannot alias (CPU): each store call would
+    otherwise copy the entire pool. ``slots`` is padded to a power-of-two
+    bucket with out-of-range ids; ``mode="drop"`` discards those updates
+    (and their clamped garbage source blocks) wholesale."""
+    b = slots.shape[0]
+    offs = off0 + jnp.arange(b, dtype=jnp.int32) * block_tokens
+
+    def put(p, c):
+        if p.shape[0] == 0:  # index-leaf placeholder: no pooled storage
+            return p
+        blk = jax.vmap(
+            lambda o: lax.dynamic_slice(
+                c[0], (o,) + (0,) * (c.ndim - 2),
+                (block_tokens,) + c.shape[2:]))(offs)
+        return p.at[slots].set(blk.astype(p.dtype), mode="drop")
+
+    return jax.tree.map(put, pool, cache)
+
+
+def _splice_fn(block_tokens, cache, pool, ids):
+    """Write pool rows ``ids`` as the cache's token prefix
+    ``[0, len(ids) * block_tokens)``. ``ids`` is a concrete-length vector,
+    so one program compiles per (power-of-two-bucketed) match length; the
+    gather + one leading ``dynamic_update_slice`` per leaf is the whole
+    hit path — no attention, no matmuls."""
+
+    def sp(c, p):
+        if c.ndim == 1:  # index leaves: the prefill chunk sets these
+            return c
+        blk = p[ids]  # [n, block_tokens, ...]
+        flat = blk.reshape((1, ids.shape[0] * block_tokens) + blk.shape[2:])
+        return lax.dynamic_update_slice(
+            c, flat.astype(c.dtype), (0,) * c.ndim)
+
+    return jax.tree.map(sp, cache, pool)
+
+
+class _Node:
+    """One trie edge = one cached block. Children are keyed by the next
+    block's token tuple (exact-match radix trie)."""
+
+    __slots__ = ("slot", "refs", "last_used", "parent", "key", "children")
+
+    def __init__(self, slot: int, parent, key):
+        self.slot = slot
+        self.refs = 0
+        self.last_used = 0
+        self.parent = parent
+        self.key = key
+        self.children: dict = {}
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """A pinned match: ``release()`` it (via :meth:`PrefixCache.release`)
+    once the matched blocks have been spliced."""
+
+    nodes: list
+    ids: np.ndarray  # pool slots of the matched chain, int32 [n]
+    matched_tokens: int
+    released: bool = False
+
+
+class PrefixCache:
+    """Block pool + radix trie over prompt prefixes.
+
+    ``template``: the single-row decode cache pytree (concrete arrays or
+    ``jax.eval_shape`` structs) — KV leaves ``[1, L, H, D]`` define the
+    pool geometry; 1-D index leaves get no pooled storage.
+    ``block_tokens``: granularity of sharing — smaller blocks match more
+    of a prefix but cost more trie nodes and splice slots per hit.
+    ``budget_bytes``: hard cap on pool memory; capacity =
+    ``budget_bytes // bytes_per_block`` blocks, allocated up-front.
+    ``registry``: optional :class:`~distkeras_tpu.telemetry.registry.
+    MetricsRegistry` — hit/miss/eviction counters and occupancy gauges
+    for ``metricsz``.
+    """
+
+    def __init__(self, template, *, block_tokens: int = 16,
+                 budget_bytes: int = 64 * 2**20, registry=None):
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        self.block_tokens = int(block_tokens)
+        kv_leaves = [a for a in jax.tree.leaves(template) if a.ndim > 1]
+        if not kv_leaves:
+            raise ValueError("cache template has no KV leaves")
+        L = kv_leaves[0].shape[1]
+        if self.block_tokens > L:
+            raise ValueError(
+                f"block_tokens={block_tokens} exceeds cache length {L}")
+        self.max_blocks = L // self.block_tokens
+        self.bytes_per_block = sum(
+            self.block_tokens * int(np.prod(a.shape[2:])) * a.dtype.itemsize
+            for a in kv_leaves)
+        self.capacity = int(budget_bytes) // self.bytes_per_block
+        if self.capacity < 1:
+            raise ValueError(
+                f"budget_bytes={budget_bytes} holds zero blocks "
+                f"(one block = {self.bytes_per_block} bytes)")
+        self._pool = jax.tree.map(
+            lambda a: (jnp.zeros((0,), jnp.int32) if a.ndim == 1 else
+                       jnp.zeros((self.capacity, self.block_tokens)
+                                 + a.shape[2:], a.dtype)),
+            template)
+        self._store = jax.jit(
+            functools.partial(_store_fn, self.block_tokens),
+            donate_argnums=(0,))
+        self._splice = jax.jit(
+            functools.partial(_splice_fn, self.block_tokens),
+            donate_argnums=(0,))  # the cache being built; the pool persists
+        self._root = _Node(-1, None, None)
+        self._by_slot: dict[int, _Node] = {}
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._clock = itertools.count(1)
+        # Lazy LRU heap of (last_used, slot): every touch pushes a fresh
+        # entry; _alloc pops, discarding entries whose stamp no longer
+        # matches the node (stale) — amortized O(log n) eviction instead
+        # of scanning every cached block per allocation.
+        self._lru: list[tuple[int, int]] = []
+        # Host-side stats (exact, source of truth for stats()).
+        self.lookups = self.hit_requests = 0
+        self.hit_tokens = self.miss_tokens = 0
+        self.inserted_blocks = self.evicted_blocks = 0
+        self._metrics = None
+        if registry is not None:
+            self._metrics = {
+                "hit_tokens": registry.counter(
+                    "prefix_cache_hit_tokens_total",
+                    help="prompt tokens whose prefill was skipped via the "
+                         "prefix cache"),
+                "miss_tokens": registry.counter(
+                    "prefix_cache_miss_tokens_total",
+                    help="prompt tokens prefilled from scratch"),
+                "hit_requests": registry.counter(
+                    "prefix_cache_hit_requests_total",
+                    help="lookups matching at least one block"),
+                "lookups": registry.counter(
+                    "prefix_cache_lookups_total", help="prefix lookups"),
+                "evictions": registry.counter(
+                    "prefix_cache_evicted_blocks_total",
+                    help="blocks evicted (LRU under the byte budget)"),
+                "inserts": registry.counter(
+                    "prefix_cache_inserted_blocks_total",
+                    help="blocks stored into the pool"),
+                "used": registry.gauge(
+                    "prefix_cache_blocks_used", help="pool blocks in use"),
+                "capacity": registry.gauge(
+                    "prefix_cache_blocks_capacity",
+                    help="pool block capacity"),
+                "bytes": registry.gauge(
+                    "prefix_cache_bytes_used", help="pool bytes in use"),
+            }
+            self._metrics["capacity"].set(self.capacity)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def blocks_used(self) -> int:
+        return self.capacity - len(self._free)
+
+    def stats(self) -> dict:
+        total = self.hit_tokens + self.miss_tokens
+        return {
+            "block_tokens": self.block_tokens,
+            "capacity_blocks": self.capacity,
+            "blocks_used": self.blocks_used,
+            "bytes_used": self.blocks_used * self.bytes_per_block,
+            "bytes_per_block": self.bytes_per_block,
+            "lookups": self.lookups,
+            "hit_requests": self.hit_requests,
+            "hit_tokens": self.hit_tokens,
+            "miss_tokens": self.miss_tokens,
+            "hit_rate": (self.hit_tokens / total) if total else 0.0,
+            "inserted_blocks": self.inserted_blocks,
+            "evicted_blocks": self.evicted_blocks,
+        }
+
+    # -- trie walk ----------------------------------------------------------
+    def _blocks(self, tokens, n_blocks: int):
+        bt = self.block_tokens
+        for i in range(n_blocks):
+            yield tuple(int(t) for t in tokens[i * bt:(i + 1) * bt])
+
+    def probe(self, tokens) -> int:
+        """Matched-token count for ``tokens`` WITHOUT pinning or counting
+        — the scheduler's cache-aware admission score."""
+        node, matched = self._root, 0
+        for key in self._blocks(tokens, self._match_cap(tokens)):
+            node = node.children.get(key)
+            if node is None:
+                break
+            matched += self.block_tokens
+        return matched
+
+    def _match_cap(self, tokens) -> int:
+        # Never match the WHOLE prompt: prefill needs >= 1 uncached token
+        # to produce the logits the first sampled token comes from.
+        return max(0, (len(tokens) - 1) // self.block_tokens)
+
+    def match(self, tokens) -> PrefixMatch:
+        """Longest cached block-chain prefix of ``tokens``, pinned
+        (ref-counted) until :meth:`release`."""
+        self.lookups += 1
+        node, chain = self._root, []
+        for key in self._blocks(tokens, self._match_cap(tokens)):
+            nxt = node.children.get(key)
+            if nxt is None:
+                break
+            chain.append(nxt)
+            node = nxt
+        now = next(self._clock)
+        for n in chain:
+            n.refs += 1
+            self._touch(n, now)
+        matched = len(chain) * self.block_tokens
+        self.hit_tokens += matched
+        self.miss_tokens += len(tokens) - matched
+        self.hit_requests += bool(chain)
+        if self._metrics is not None:
+            self._metrics["lookups"].inc()
+            self._metrics["hit_tokens"].inc(matched)
+            self._metrics["miss_tokens"].inc(len(tokens) - matched)
+            if chain:
+                self._metrics["hit_requests"].inc()
+        return PrefixMatch(
+            chain, np.asarray([n.slot for n in chain], np.int32), matched)
+
+    def release(self, match: PrefixMatch | None) -> None:
+        if match is None or match.released:
+            return
+        match.released = True
+        for n in match.nodes:
+            n.refs -= 1
+
+    # -- device ops ---------------------------------------------------------
+    def _pad_ids(self, ids, fill: int) -> np.ndarray:
+        """Pad a pool-row id list to its power-of-two bucket (capped at
+        the per-cache block capacity) so store/splice compile once per
+        bucket. ``fill`` picks the padding semantics: a valid row id
+        (splice: reads garbage the mask hides) or an out-of-range id
+        (store: ``mode=\"drop\"`` discards those writes)."""
+        n = len(ids)
+        b = 1
+        while b < n:
+            b *= 2
+        b = min(b, self.max_blocks)
+        out = np.full((b,), fill, np.int32)
+        out[:n] = ids
+        return out
+
+    def splice(self, cache, ids: np.ndarray):
+        """Return ``cache`` with pool rows ``ids`` written as its token
+        prefix. ``ids`` is padded to a power-of-two bucket so compiles
+        stay bounded; rows written past the true match are garbage the
+        causal mask hides until the tail prefill / decode overwrites
+        them. Donates ``cache``."""
+        return self._splice(cache, self._pool,
+                            jnp.asarray(self._pad_ids(ids, 0)))
+
+    def insert(self, tokens, cache) -> int:
+        """Store every complete block of ``tokens`` not already cached,
+        copying K/V rows out of the fully-prefilled single-row ``cache``
+        in ONE batched device call. Allocation evicts LRU unreferenced
+        leaves; when nothing is evictable the insert stops early (the
+        chain must stay contiguous). Returns the newly stored count."""
+        keys = list(self._blocks(tokens, len(tokens) // self.block_tokens))
+        now = next(self._clock)
+        node, idx = self._root, 0
+        while idx < len(keys):  # walk (and touch) the existing prefix
+            child = node.children.get(keys[idx])
+            if child is None:
+                break
+            self._touch(child, now)
+            node = child
+            idx += 1
+        take: list[int] = []
+        for _ in keys[idx:]:
+            slot = self._alloc(protect=node)
+            if slot is None:
+                break
+            take.append(slot)
+        if not take:
+            return 0
+        n = len(take)
+        self._pool = self._store(
+            self._pool, cache,
+            jnp.asarray(self._pad_ids(take, self.capacity)),
+            jnp.int32(idx * self.block_tokens))
+        for key, slot in zip(keys[idx:idx + n], take):
+            child = _Node(slot, node, key)
+            node.children[key] = child
+            self._by_slot[slot] = child
+            self._touch(child, now)
+            node = child
+        self.inserted_blocks += n
+        if self._metrics is not None:
+            self._metrics["inserts"].inc(n)
+            self._note_occupancy()
+        return n
+
+    # -- eviction -----------------------------------------------------------
+    def _touch(self, node: _Node, now: int) -> None:
+        node.last_used = now
+        heapq.heappush(self._lru, (now, node.slot))
+        if len(self._lru) > 4 * self.capacity:
+            # Stale entries are only consumed by _alloc, which a
+            # hit-dominated workload (no inserts once warm) never runs —
+            # compact to one live entry per node so the heap stays
+            # O(capacity) over a long-running server, amortized O(1) per
+            # touch (one rebuild per >= 3·capacity pushes).
+            self._lru = [(n.last_used, n.slot)
+                         for n in self._by_slot.values()]
+            heapq.heapify(self._lru)
+
+    def _alloc(self, protect: _Node) -> int | None:
+        if self._free:
+            return self._free.pop()
+        victim, skipped = None, []
+        while self._lru:
+            stamp, slot = heapq.heappop(self._lru)
+            n = self._by_slot.get(slot)
+            if n is None or n.last_used != stamp:
+                continue  # stale: slot was evicted or re-touched since
+            if n.refs or n.children or n is protect:
+                # Currently unevictable, but may become a leaf later
+                # with no further touch — keep its entry alive.
+                skipped.append((stamp, slot))
+                continue
+            victim = n
+            break
+        for item in skipped:
+            heapq.heappush(self._lru, item)
+        if victim is None:
+            return None  # everything pinned or mid-chain: skip the insert
+        del victim.parent.children[victim.key]
+        del self._by_slot[victim.slot]
+        self.evicted_blocks += 1
+        if self._metrics is not None:
+            self._metrics["evictions"].inc()
+        return victim.slot
+
+    def _note_occupancy(self) -> None:
+        self._metrics["used"].set(self.blocks_used)
+        self._metrics["bytes"].set(self.blocks_used * self.bytes_per_block)

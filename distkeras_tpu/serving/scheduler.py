@@ -84,6 +84,7 @@ class Request:
         # would kill serving for everyone).
         self.timeout = None if timeout is None else float(timeout)
         # Engine-owned runtime state.
+        self.cache_overtaken = 0  # times a cache hit was served over us
         self.events: asyncio.Queue = asyncio.Queue()
         self.out_tokens: list[int] = []
         self.error: ServingError | None = None
@@ -138,10 +139,24 @@ class Scheduler:
     whose deadline passed while queued.
     """
 
-    def __init__(self, max_depth: int = 64, registry=None):
+    def __init__(self, max_depth: int = 64, registry=None, cache_probe=None,
+                 probe_window: int = 8, max_overtake: int = 4):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = int(max_depth)
+        # Cache-aware admission: an optional ``prompt -> matched-token
+        # count`` scorer (the prefix cache's ``probe``); when set, pop()
+        # may serve a cache-hitting request ahead of colder ones within
+        # the same priority class (bounded by ``probe_window``) — a hit
+        # admits nearly for free, so serving it first raises goodput
+        # without starving anyone outside the window.
+        self.cache_probe = cache_probe
+        self.probe_window = int(probe_window)
+        # Starvation bound: once a request has been overtaken this many
+        # times while at the head of its class, it is served regardless
+        # of cache scores (otherwise steady cache-warm traffic refilling
+        # the window could delay a cold head forever).
+        self.max_overtake = int(max_overtake)
         self._heap: list[tuple[int, int, Request]] = []
         self._seq = itertools.count()
         self._arrival = asyncio.Event()
@@ -151,6 +166,7 @@ class Scheduler:
         # depth gauge, so a scrape sees queue pressure without waiting for
         # the engine's next sample() record.
         self._c_submitted = self._c_shed = self._g_depth = None
+        self._c_cache_preferred = None
         if registry is not None:
             self._c_submitted = registry.counter(
                 "scheduler_submitted_total", help="requests enqueued")
@@ -159,6 +175,10 @@ class Scheduler:
                 help="requests shed from the queue (expired or cancelled)")
             self._g_depth = registry.gauge(
                 "scheduler_queue_depth", help="requests currently queued")
+            self._c_cache_preferred = registry.counter(
+                "scheduler_cache_preferred_total",
+                help="pops that served a prefix-cache hit ahead of an "
+                     "older same-priority request")
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -180,21 +200,66 @@ class Scheduler:
             self._note_depth()
         self._arrival.set()
 
-    def pop(self, now: float | None = None) -> Request | None:
-        """Highest-priority non-expired request, or None if empty."""
-        now = time.monotonic() if now is None else now
+    def _pop_valid(self, now: float):
+        """Pop heap entries until a live one surfaces; dead ones (expired
+        or cancelled while queued) go to the expired backlog so expire()
+        hands them back uniformly. Returns the full heap tuple or None."""
         while self._heap:
-            _, _, req = heapq.heappop(self._heap)
+            item = heapq.heappop(self._heap)
+            req = item[2]
             if req.cancelled or (req.deadline is not None
                                  and now > req.deadline):
-                # Dead while queued: hand back via the expired path so the
-                # caller records/terminates it uniformly.
                 self._expired_backlog.append(req)
                 continue
-            self._note_depth()
-            return req
-        self._note_depth()
+            return item
         return None
+
+    def pop(self, now: float | None = None) -> Request | None:
+        """Highest-priority non-expired request, or None if empty.
+
+        With ``cache_probe`` set, up to ``probe_window`` head requests of
+        the SAME priority class are scored by matched-prefix length and
+        the best hit is served first: FIFO breaks ties, other priority
+        classes are never jumped, the window bounds the probe cost per
+        pop, and ``max_overtake`` bounds how many times any request can
+        be passed over in total — a cold request under sustained
+        cache-warm traffic is served after at most ``max_overtake``
+        extra pops once it reaches its class head.
+        """
+        now = time.monotonic() if now is None else now
+        head = self._pop_valid(now)
+        if head is None:
+            self._note_depth()
+            return None
+        if (self.cache_probe is not None and self._heap
+                and head[2].cache_overtaken < self.max_overtake):
+            cands = [head]
+            while (len(cands) < self.probe_window and self._heap
+                   and self._heap[0][0] == head[0]):
+                nxt = self._pop_valid(now)
+                if nxt is None:
+                    break
+                if nxt[0] != head[0]:
+                    # Skipping expired entries crossed into a lower
+                    # priority class: put it back, keep the window
+                    # class-pure.
+                    heapq.heappush(self._heap, nxt)
+                    break
+                cands.append(nxt)
+            # max() keeps the FIRST maximum — candidates are in pop
+            # (FIFO) order, so equal scores preserve arrival order.
+            best = max(cands, key=lambda it: self.cache_probe(it[2].prompt))
+            for it in cands:
+                if it is not best:
+                    heapq.heappush(self._heap, it)
+            if best is not head:
+                head[2].cache_overtaken += 1
+                if self._c_cache_preferred is not None:
+                    self._c_cache_preferred.inc()
+            self._note_depth()
+            return best[2]
+        self._note_depth()
+        return head[2]
 
     def expire(self, now: float | None = None) -> list[Request]:
         """Remove and return every queued request whose deadline passed or

@@ -174,6 +174,8 @@ class ServingServer:
                 "decode_compile_count": engine.decode_compile_count(),
                 "stopping": engine._stopping,
             }
+            if engine.prefix_cache is not None:
+                health["prefix_cache"] = engine.prefix_cache.stats()
             if engine.auditor is not None:
                 health["recompile_audit"] = engine.auditor.report()
             return {"healthz": health}

@@ -1,12 +1,17 @@
 #!/usr/bin/env python
 """Warn-only bench drift gate over ``bench_history.json``.
 
-``bench.py`` records every measurement into ``bench_history.json`` keyed
-by config (metric/batch/platform/shape/forced), keeping a bounded trail
-of displaced entries under ``prev``. This script compares the latest
-entry of each config (by default only the most recently updated one)
-against its prior same-config entry and WARNS when throughput dropped by
-more than ``--threshold`` (default 10%).
+``bench.py`` records every training measurement into
+``bench_history.json`` keyed by config (metric/batch/platform/shape/
+forced), and ``benchmarks/serving_bench.py --record-history`` records
+serving rows under ``serving/...`` keys (TTFT/ITL percentiles, goodput,
+prefix-cache hit rate) — both keep a bounded trail of displaced entries
+under ``prev``. This script compares the latest entry of each config (by
+default only the most recently updated one) against its prior
+same-config entry and WARNS when it drifted by more than ``--threshold``
+(default 10%) **in the bad direction**: training throughput, goodput and
+hit rate regress by dropping; serving latency metrics (ttft/inter_token/
+prefill_device/queue_wait/latency) regress by RISING.
 
 Warn-only by design: CPU rows in a shared container are noisy, and a
 hard gate on them would train people to delete the history. Exit code is
@@ -36,10 +41,24 @@ def load_history(path: str) -> dict:
     return hist
 
 
+# Serving metrics where a RISE is the regression. Matched against the
+# key's final path segment (serving rows look like
+# ``serving/<model>/slots4/closed/ttft_p99_s``); training throughput
+# rows never end in these names, so they keep higher-is-better.
+_LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
+                    "queue_wait", "latency")
+
+
+def lower_is_better(key: str) -> bool:
+    metric = key.rsplit("/", 1)[-1]
+    return any(metric.startswith(p) for p in _LOWER_IS_BETTER)
+
+
 def check_entry(key: str, entry: dict, threshold: float) -> dict | None:
     """Compare ``entry['value']`` to its most recent prior; returns a
     finding dict (regressed or not), or None when there is no usable
-    prior / value to compare."""
+    prior / value to compare. Direction-aware: latency-shaped serving
+    metrics regress upward, everything else downward."""
     if not isinstance(entry, dict):
         return None
     value = entry.get("value")
@@ -53,6 +72,7 @@ def check_entry(key: str, entry: dict, threshold: float) -> dict | None:
         return None
     prior = prevs[-1]
     ratio = float(value) / float(prior["value"])
+    inverted = lower_is_better(key)
     return {
         "config": key,
         "value": float(value),
@@ -60,7 +80,9 @@ def check_entry(key: str, entry: dict, threshold: float) -> dict | None:
         "prior_when": prior.get("when"),
         "when": entry.get("when"),
         "ratio": round(ratio, 4),
-        "regressed": ratio < 1.0 - threshold,
+        "direction": "lower_is_better" if inverted else "higher_is_better",
+        "regressed": (ratio > 1.0 + threshold if inverted
+                      else ratio < 1.0 - threshold),
     }
 
 
@@ -84,10 +106,17 @@ def main(argv=None) -> int:
 
     keys = list(hist)
     if not args.all:
-        # Most recently updated config only — the row the run just wrote.
+        # Most recently updated config(s) only — the rows the run just
+        # wrote. A serving-bench run records many metrics with one
+        # timestamp, so keep EVERY key sharing the latest `when`, not an
+        # arbitrary tie-break winner.
         dated = [k for k in keys if isinstance(hist[k], dict)
                  and hist[k].get("when")]
-        keys = [max(dated, key=lambda k: hist[k]["when"])] if dated else []
+        if dated:
+            latest = max(hist[k]["when"] for k in dated)
+            keys = [k for k in dated if hist[k]["when"] == latest]
+        else:
+            keys = []
 
     findings = []
     for key in keys:
@@ -98,14 +127,17 @@ def main(argv=None) -> int:
     regressed = [f for f in findings if f["regressed"]]
     for f in findings:
         tag = "REGRESSION" if f["regressed"] else "ok"
+        arrow = " (lower is better)" if f["direction"] == "lower_is_better" \
+            else ""
         print(f"bench-regression [{tag}] {f['config']}: "
-              f"{f['value']:.2f} vs prior {f['prior']:.2f} "
-              f"(x{f['ratio']}, prior from {f['prior_when']})")
+              f"{f['value']:.4g} vs prior {f['prior']:.4g} "
+              f"(x{f['ratio']}{arrow}, prior from {f['prior_when']})")
     if not findings:
         print("bench-regression: no config with a prior same-config entry")
     if regressed:
-        print(f"bench-regression: {len(regressed)} config(s) dropped more "
-              f"than {args.threshold:.0%} vs their prior entry (warn-only"
+        print(f"bench-regression: {len(regressed)} config(s) drifted more "
+              f"than {args.threshold:.0%} the wrong way vs their prior "
+              f"entry (warn-only"
               f"{'' if not args.strict else ', strict'})")
     return 1 if (regressed and args.strict) else 0
 

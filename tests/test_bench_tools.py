@@ -151,6 +151,46 @@ def test_check_bench_regression_warns_and_strict_gates(tmp_path, capsys):
     assert cbr.main(["--history", str(path)]) == 0
 
 
+def test_check_bench_regression_serving_rows_are_direction_aware(
+        tmp_path, capsys):
+    """Serving latency rows regress by RISING; hit rate / goodput (and
+    every training row) keep the lower-value-is-regression rule."""
+    from scripts import check_bench_regression as cbr
+
+    path = tmp_path / "bench_history.json"
+    prev = [{"value": 0.010, "when": "2026-08-01T00:00:00Z"}]
+    path.write_text(json.dumps({
+        # TTFT doubled: that IS the regression even though value > prior.
+        "serving/gpt_tiny/slots4/closed/ttft_p99_s":
+            {"value": 0.020, "when": "2026-08-03T00:00:01Z", "prev": prev},
+        # TTFT halved: an improvement, must NOT warn.
+        "serving/gpt_tiny/slots4/open/ttft_p50_s":
+            {"value": 0.005, "when": "2026-08-03T00:00:02Z", "prev": prev},
+        # Hit rate dropped 40%: higher-is-better, warns.
+        "serving/gpt_tiny/slots4/closed/prefix_hit_rate":
+            {"value": 0.3, "when": "2026-08-03T00:00:03Z",
+             "prev": [{"value": 0.5, "when": "2026-08-01T00:00:00Z"}]},
+        # Training throughput row: unchanged semantics.
+        "a/batch256/cpu":
+            {"value": 100.0, "when": "2026-08-03T00:00:04Z",
+             "prev": [{"value": 100.0, "when": "2026-08-01T00:00:00Z"}]},
+    }))
+    rc = cbr.main(["--history", str(path), "--all"])
+    out = capsys.readouterr().out
+    assert rc == 0  # warn-only
+    assert "[REGRESSION] serving/gpt_tiny/slots4/closed/ttft_p99_s" in out
+    assert "[ok] serving/gpt_tiny/slots4/open/ttft_p50_s" in out
+    assert "[REGRESSION] serving/gpt_tiny/slots4/closed/prefix_hit_rate" \
+        in out
+    assert "[ok] a/batch256/cpu" in out
+    assert cbr.main(["--history", str(path), "--all", "--strict"]) == 1
+    # Direction helper: exact metric-name prefixes, not substrings.
+    assert cbr.lower_is_better("serving/m/slots1/closed/inter_token_p99_s")
+    assert cbr.lower_is_better("serving/m/slots1/open/queue_wait_p50_s")
+    assert not cbr.lower_is_better("serving/m/slots1/open/goodput_tokens_per_sec")
+    assert not cbr.lower_is_better("bert_train_samples_per_sec/batch8/cpu")
+
+
 def test_check_bench_regression_skips_unusable_rows(tmp_path):
     from scripts import check_bench_regression as cbr
 

@@ -1,0 +1,163 @@
+"""Prefix-cache unit behavior (serving/prefix_cache.py), model-free.
+
+The cache is structure-agnostic: any pytree whose KV leaves are
+``[1, L, ...]`` and whose index leaves are 1-D works, so these tests use
+a tiny hand-built template and exact integer-valued K/V — block
+identity, splice placement, ref-counting, and LRU eviction are all
+checkable to the element without a model in sight. Engine-integrated
+behavior (parity, hit-after-evict round trips) lives in test_serving.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving.prefix_cache import PrefixCache
+
+L, H, D, BT = 32, 2, 4, 4  # cache length, heads, head_dim, block tokens
+
+
+def _template():
+    return {
+        "layer_0": {
+            "cached_key": jnp.zeros((1, L, H, D), jnp.float32),
+            "cached_value": jnp.zeros((1, L, H, D), jnp.float32),
+            "cache_index": jnp.zeros((1,), jnp.int32),
+        },
+        "pos_index": jnp.zeros((1,), jnp.int32),
+    }
+
+
+def _filled_cache(base: float):
+    """A 'prefilled' cache whose row t holds value base + t — block
+    content is recognizable after any copy."""
+    t = jnp.arange(L, dtype=jnp.float32).reshape(1, L, 1, 1)
+    return {
+        "layer_0": {
+            "cached_key": jnp.broadcast_to(base + t, (1, L, H, D)),
+            "cached_value": jnp.broadcast_to(base + 100 + t, (1, L, H, D)),
+            "cache_index": jnp.full((1,), L, jnp.int32),
+        },
+        "pos_index": jnp.full((1,), L, jnp.int32),
+    }
+
+
+def _cache(blocks=4, **kw):
+    tpl = _template()
+    probe = PrefixCache(tpl, block_tokens=BT, budget_bytes=1 << 20)
+    return PrefixCache(tpl, block_tokens=BT,
+                       budget_bytes=blocks * probe.bytes_per_block, **kw)
+
+
+def test_capacity_from_byte_budget():
+    pc = _cache(blocks=3)
+    # Two KV leaves of [BT, H, D] float32 per block.
+    assert pc.bytes_per_block == 2 * BT * H * D * 4
+    assert pc.capacity == 3
+    with pytest.raises(ValueError, match="zero blocks"):
+        PrefixCache(_template(), block_tokens=BT, budget_bytes=7)
+    with pytest.raises(ValueError, match="exceeds cache length"):
+        PrefixCache(_template(), block_tokens=L + 1)
+
+
+def test_insert_match_and_whole_prompt_cap():
+    pc = _cache()
+    prompt = list(range(10))  # 2 complete blocks + ragged tail
+    assert pc.insert(prompt, _filled_cache(0.0)) == 2
+    m = pc.match(prompt)
+    assert m.matched_tokens == 2 * BT and len(m.ids) == 2
+    pc.release(m)
+    # A prompt that IS exactly the cached blocks never fully matches:
+    # prefill needs >= 1 uncached token to produce the first logits.
+    m = pc.match(prompt[:8])
+    assert m.matched_tokens == BT
+    pc.release(m)
+    # Diverging after one block matches only the shared block.
+    m = pc.match(prompt[:4] + [99, 98, 97, 96, 95])
+    assert m.matched_tokens == BT
+    pc.release(m)
+    assert pc.probe(prompt) == 2 * BT  # probe agrees, no pinning
+    s = pc.stats()
+    assert s["lookups"] == 3 and s["hit_requests"] == 3
+    assert s["blocks_used"] == 2
+
+
+def test_splice_places_blocks_and_leaves_indices_alone():
+    pc = _cache()
+    src = _filled_cache(1000.0)
+    prompt = list(range(9))
+    pc.insert(prompt, src)
+    m = pc.match(prompt)
+    out = pc.splice(_template(), m.ids)
+    got_k = np.asarray(out["layer_0"]["cached_key"])
+    want_k = np.asarray(src["layer_0"]["cached_key"])
+    matched = m.matched_tokens
+    np.testing.assert_array_equal(got_k[0, :matched], want_k[0, :matched])
+    got_v = np.asarray(out["layer_0"]["cached_value"])
+    want_v = np.asarray(src["layer_0"]["cached_value"])
+    np.testing.assert_array_equal(got_v[0, :matched], want_v[0, :matched])
+    # Index leaves are the prefill chunk's job, not the splice's.
+    assert int(out["layer_0"]["cache_index"][0]) == 0
+    assert int(out["pos_index"][0]) == 0
+    pc.release(m)
+
+
+def test_refcount_blocks_eviction_until_release():
+    pc = _cache(blocks=2)
+    a = [1] * 12  # 3 complete blocks, capacity 2 -> stores 2
+    assert pc.insert(a, _filled_cache(0.0)) == 2
+    m = pc.match(a)  # pins both blocks
+    b = [2] * 12
+    assert pc.insert(b, _filled_cache(50.0)) == 0  # everything pinned
+    assert pc.stats()["evicted_blocks"] == 0
+    pc.release(m)
+    assert pc.insert(b, _filled_cache(50.0)) == 2  # LRU-evicts a's chain
+    assert pc.stats()["evicted_blocks"] == 2
+    assert pc.probe(a) == 0 and pc.probe(b) == 2 * BT
+    assert pc.blocks_used == 2  # never exceeds the budget
+
+
+def test_lru_prefers_least_recently_used_leaf():
+    pc = _cache(blocks=2)
+    a, b = [1] * 5, [2] * 5  # one block each
+    pc.insert(a, _filled_cache(0.0))
+    pc.insert(b, _filled_cache(10.0))
+    pc.release(pc.match([1] * 5))  # touch a: b becomes the LRU leaf
+    pc.insert([3] * 5, _filled_cache(20.0))
+    assert pc.probe([1] * 4 + [0]) == BT  # a survived
+    assert pc.probe([2] * 4 + [0]) == 0  # b evicted
+    assert pc.probe([3] * 4 + [0]) == BT
+
+
+def test_registry_metrics_published():
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    pc = _cache(blocks=2, registry=reg)
+    pc.insert([1] * 9, _filled_cache(0.0))
+    pc.release(pc.match([1] * 9))
+    snap = reg.snapshot()
+    assert snap["prefix_cache_blocks_capacity"]["value"] == 2
+    assert snap["prefix_cache_blocks_used"]["value"] == 2
+    assert snap["prefix_cache_hit_tokens_total"]["value"] == 2 * BT
+    assert snap["prefix_cache_inserted_blocks_total"]["value"] == 2
+    assert snap["prefix_cache_lookups_total"]["value"] == 1
+
+
+def test_store_and_splice_compile_counts_stay_bounded():
+    """Store and splice each compile once per pow2 block-count bucket —
+    the same discipline as the engine's prefill buckets — and an insert
+    is ONE batched store call however many blocks it adds."""
+    pc = _cache(blocks=8)
+    for base, toks in ((0, [1] * 9), (1, [2] * 17), (2, [3] * 29)):
+        pc.insert(toks, _filled_cache(float(base)))
+        pc.release(pc.match(toks))
+    store_probe = getattr(pc._store, "_cache_size", None)
+    if store_probe is not None:
+        assert store_probe() <= 3  # buckets 2, 4, 8 (one per insert size)
+    splice_probe = getattr(pc._splice, "_cache_size", None)
+    m = pc.match([3] * 29)  # 6 complete blocks -> bucket 8
+    pc.splice(_template(), m.ids)
+    pc.release(m)
+    if splice_probe is not None:
+        assert splice_probe() <= 3  # buckets 1, 2, 8 at most so far
