@@ -35,6 +35,17 @@ percentiles, goodput, hit rate) to ``bench_history.json`` under
 ``serving/...`` keys; ``scripts/check_bench_regression.py`` diffs them
 against the prior same-config run (direction-aware: latency up = bad).
 
+``--replicas N`` (N >= 2) swaps the single engine for an **in-process
+cluster**: N engines behind the supervised router
+(:mod:`distkeras_tpu.serving.cluster`), with the load driven through TCP
+clients against the router's front port — latency numbers then include
+the router hop, and the report carries router counters (retries,
+affinity picks, streams lost) plus per-replica restarts.
+``--chaos-kill-at S`` additionally SIGKILL-equivalently kills replica r0
+``S`` seconds into each load phase: the run asserts the cluster contract
+— no zero-streamed request fails (retried on a survivor), the
+supervisor restarts the corpse, and the fleet is whole again at the end.
+
 Run (CPU):
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py \
         --mode both --requests 24 --slots 4 --metrics-out /tmp/serve.jsonl
@@ -42,6 +53,9 @@ Run (CPU):
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mode closed \
         --seq-len 128 --prompt-len 96 --prefix-ratio 0.75 \
         --prefix-cache-mb 16 --requests 24
+    # 2-replica cluster with a mid-run replica kill:
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mode closed \
+        --replicas 2 --chaos-kill-at 2 --requests 24
 """
 
 from __future__ import annotations
@@ -54,24 +68,36 @@ import time
 import numpy as np
 
 
-def _build(args):
+def _model(args):
     from distkeras_tpu.models.bert import gpt_small, gpt_tiny
-    from distkeras_tpu.serving import ServingEngine, ServingMetrics
-    from distkeras_tpu.telemetry import MetricsRegistry
-    from distkeras_tpu.tracing import MetricStream
 
     model = (gpt_tiny(seq_len=args.seq_len, vocab_size=args.vocab)
              if args.model == "gpt_tiny" else gpt_small(seq_len=args.seq_len))
-    variables = model.init(0)
-    registry = MetricsRegistry()
-    stream = (MetricStream.to_jsonl(args.metrics_out, registry=registry)
-              if args.metrics_out else None)
-    engine = ServingEngine(
+    return model, model.init(0)
+
+
+def _make_engine(args, model, variables, metrics=None):
+    from distkeras_tpu.serving import ServingEngine, ServingMetrics
+
+    return ServingEngine(
         model, variables, slots=args.slots, max_queue=args.max_queue,
-        metrics=ServingMetrics(stream, registry=registry),
+        metrics=metrics or ServingMetrics(),
         prefill_chunk=args.prefill_chunk,
         prefix_cache_mb=args.prefix_cache_mb,
         prefix_block_tokens=args.prefix_block)
+
+
+def _build(args):
+    from distkeras_tpu.serving import ServingMetrics
+    from distkeras_tpu.telemetry import MetricsRegistry
+    from distkeras_tpu.tracing import MetricStream
+
+    model, variables = _model(args)
+    registry = MetricsRegistry()
+    stream = (MetricStream.to_jsonl(args.metrics_out, registry=registry)
+              if args.metrics_out else None)
+    engine = _make_engine(args, model, variables,
+                          metrics=ServingMetrics(stream, registry=registry))
     return model, variables, engine, stream
 
 
@@ -158,6 +184,178 @@ def _check_parity(model, variables, results, new_tokens):
     return mismatches
 
 
+async def _cluster_bench(args, report):
+    """Drive the load phases through an in-process router + N replicas.
+
+    End-to-end numbers (client-observed TTFT/latency, through the router
+    hop), router/supervisor counters, and — with ``--chaos-kill-at`` —
+    the cluster contract asserted under a mid-phase replica kill."""
+    import time as _time
+
+    from distkeras_tpu.serving import (
+        LocalReplica, QueueFullError, ServingClient, ServingCluster,
+        ServingMetrics,
+    )
+    from distkeras_tpu.serving.client import ServerError
+    from distkeras_tpu.serving.metrics import percentile
+    from distkeras_tpu.telemetry import MetricsRegistry
+    from distkeras_tpu.tracing import MetricStream
+
+    model, variables = _model(args)
+    registry = MetricsRegistry()
+    streams = []
+
+    def replica(i):
+        def build():
+            metrics = None
+            if args.metrics_out:
+                # One JSONL series per replica (engines cannot share a
+                # stream), suffixed like run.py's cluster mode. A
+                # restarted replica reopens (and restarts) its file.
+                path = f"{args.metrics_out}.r{i}"
+                stream = MetricStream.to_jsonl(path)
+                streams.append((path, stream))
+                metrics = ServingMetrics(stream)
+            return _make_engine(args, model, variables, metrics=metrics)
+
+        return LocalReplica(build)
+
+    cluster = ServingCluster(
+        replica, args.replicas, registry=registry,
+        router_kwargs={"affinity_tokens": args.prefix_block},
+        supervisor_kwargs=dict(health_interval_s=0.1, base_delay_s=0.2))
+    all_results = []
+    async with cluster:
+        port = cluster.port
+        modes = ["closed", "open"] if args.mode == "both" else [args.mode]
+        for phase, mode in enumerate(modes):
+            prompts = _prompts(args, args.requests, phase)
+            results, lost, rejects, dones = [], [], 0, []
+
+            async def one(c, p):
+                nonlocal rejects
+                streamed = []
+                # Client-side clocks: TTFT/latency as the CLIENT sees
+                # them — router hop, pick-wait, and any mid-request
+                # retry included (the replica-reported done-record
+                # timings would hide exactly the penalties the cluster
+                # and chaos modes exist to measure).
+                t_sub = _time.monotonic()
+                t_first = None
+
+                def on_token(tok):
+                    nonlocal t_first
+                    if t_first is None:
+                        t_first = _time.monotonic()
+                    streamed.append(tok)
+
+                try:
+                    done = await c.generate(p, args.new_tokens,
+                                            on_token=on_token)
+                    t_done = _time.monotonic()
+                    results.append((p, done["tokens"]))
+                    dones.append({
+                        "ttft_s": (t_first or t_done) - t_sub,
+                        "latency_s": t_done - t_sub,
+                    })
+                except QueueFullError:
+                    rejects += 1
+                except (ServerError, ConnectionError) as e:
+                    lost.append({"streamed": len(streamed),
+                                 "error": str(e)})
+
+            chaos_task = None
+            if args.chaos_kill_at is not None:
+                async def chaos():
+                    await asyncio.sleep(args.chaos_kill_at)
+                    await cluster.replicas["r0"].handle.kill()
+
+                chaos_task = asyncio.create_task(chaos())
+            t0 = _time.monotonic()
+            if mode == "closed":
+                it = iter(prompts)
+
+                async def client():
+                    async with ServingClient("127.0.0.1", port) as c:
+                        for p in it:
+                            await one(c, p)
+
+                await asyncio.gather(
+                    *(client() for _ in range(args.clients)))
+            else:
+                arr = np.random.default_rng(args.seed + 1)
+                tasks = []
+
+                async def solo(p):
+                    async with ServingClient("127.0.0.1", port) as c:
+                        await one(c, p)
+
+                for p in prompts:
+                    tasks.append(asyncio.create_task(solo(p)))
+                    await asyncio.sleep(
+                        float(arr.exponential(1.0 / args.rate)))
+                await asyncio.gather(*tasks)
+            elapsed = _time.monotonic() - t0
+            if chaos_task is not None:
+                await chaos_task
+            done_tokens = sum(len(t) for _, t in results)
+            sec = {
+                "completed": len(results),
+                "lost_mid_stream": len(lost),
+                "rejected_queue_full": rejects,
+                "wall_s": round(elapsed, 3),
+                "goodput_tokens_per_sec": round(done_tokens / elapsed, 2),
+            }
+            for key, field in (("ttft", "ttft_s"),
+                               ("latency", "latency_s")):
+                xs = [d[field] for d in dones]
+                if xs:
+                    sec[f"{key}_p50_s"] = round(percentile(xs, 50), 6)
+                    sec[f"{key}_p99_s"] = round(percentile(xs, 99), 6)
+            report[mode] = sec
+            all_results.extend(results)
+            # The chaos contract, part 1: idempotent work never fails —
+            # every lost stream had already delivered tokens.
+            zero_streamed_lost = [e for e in lost if e["streamed"] == 0]
+            assert not zero_streamed_lost, (
+                f"{len(zero_streamed_lost)} zero-streamed requests failed "
+                f"instead of being retried: {zero_streamed_lost}")
+        if args.chaos_kill_at is not None:
+            # Part 2: the supervisor restores the fleet and the corpse
+            # rejoined routing.
+            deadline = _time.monotonic() + 120
+            while cluster.supervisor.ready_count < args.replicas:
+                assert _time.monotonic() < deadline, "restart never happened"
+                await asyncio.sleep(0.05)
+            assert sum(r.restarts
+                       for r in cluster.replicas.values()) >= 1
+        report["cluster"] = {
+            "replicas": args.replicas,
+            "chaos_kill_at": args.chaos_kill_at,
+            "restarts": {rid: info.restarts
+                         for rid, info in cluster.replicas.items()},
+            "router": {
+                k: v.get("value")
+                for k, v in registry.snapshot().items()
+                if k.startswith(("router_", "cluster_"))
+            },
+        }
+        # Every live replica still holds the one-executable invariant.
+        compiles = {
+            rid: info.handle.engine.decode_compile_count()
+            for rid, info in cluster.replicas.items()
+            if info.handle.engine is not None
+        }
+        report["cluster"]["decode_compile_count"] = compiles
+        assert all(c in (1, -1, 0) for c in compiles.values()), compiles
+    for _, stream in streams:
+        stream.close()
+    if args.metrics_out:
+        report["cluster"]["metrics_out"] = sorted(
+            {path for path, _ in streams})
+    return model, variables, all_results
+
+
 # Headline metrics worth a drift gate, per mode section of the report.
 _HISTORY_METRICS = (
     "ttft_p50_s", "ttft_p99_s", "inter_token_p50_s", "inter_token_p99_s",
@@ -234,6 +432,14 @@ def main():
                     help="engine prefix-cache byte budget (MB); 0 = off")
     ap.add_argument("--prefix-block", type=int, default=16,
                     help="prefix-cache block granularity (tokens)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help=">= 2: drive an in-process cluster (N engines "
+                         "behind the supervised router) over TCP instead "
+                         "of one engine directly")
+    ap.add_argument("--chaos-kill-at", type=float, default=None,
+                    help="cluster mode: hard-kill replica r0 this many "
+                         "seconds into each load phase and assert the "
+                         "retry/restart contract")
     ap.add_argument("--record-history", action="store_true",
                     help="append serving/* rows to bench_history.json for "
                          "scripts/check_bench_regression.py")
@@ -252,7 +458,6 @@ def main():
         from distkeras_tpu.telemetry import enable_tracing
 
         tracer = enable_tracing()
-    model, variables, engine, stream = _build(args)
     report = {"config": {
         "model": args.model, "slots": args.slots, "requests": args.requests,
         "new_tokens": args.new_tokens, "mode": args.mode,
@@ -261,7 +466,36 @@ def main():
         "prefill_chunk": args.prefill_chunk,
         "prefix_cache_mb": args.prefix_cache_mb,
         "prefix_block": args.prefix_block,
+        "replicas": args.replicas,
     }}
+
+    if args.replicas >= 2:
+        # Cluster path: same workload, driven over TCP through the
+        # router. History rows are not recorded (client-observed numbers
+        # are not comparable to the engine-direct series) — say so
+        # instead of silently dropping the flag.
+        if args.record_history:
+            report["record_history_skipped"] = (
+                "cluster-mode numbers are client-observed (router hop, "
+                "retries) and not comparable to the engine-direct "
+                "serving/* history series; no rows recorded")
+        try:
+            model, variables, all_results = asyncio.run(
+                _cluster_bench(args, report))
+            if not args.skip_parity:
+                mism = _check_parity(model, variables, all_results,
+                                     args.new_tokens)
+                report["parity_mismatches"] = mism
+                assert mism == 0, \
+                    f"{mism} routed streams diverged from generate()"
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        print(json.dumps(report, indent=1))
+        return
+
+    model, variables, engine, stream = _build(args)
 
     async def run_mode(mode, phase):
         task = asyncio.create_task(engine.run())

@@ -5,6 +5,16 @@ Orbax-backed step-level save/restore of the full training position: params,
 optimizer state, RNG, step counter, and — for async protocols — the PS center
 and update counter, so a DynSGD run resumes with correct staleness
 accounting.
+
+Also home to the **serving weight file** helpers
+(:func:`save_weights_file` / :func:`load_weights_file`): the pickle-free
+serialized-pytree format ``Model.save_weights`` writes and ``run.py
+serve --weights`` / the cluster's rolling ``reload`` verb read. Saves
+are ATOMIC (tmp + ``os.replace``) — the reload contract is that a
+replica reading the path mid-publish sees either the old file or the
+new one, never a torn write. These helpers need only numpy/jax, so a
+serving host without orbax installed can still hot-reload weights (the
+orbax import is gated; only :class:`CheckpointManager` requires it).
 """
 
 from __future__ import annotations
@@ -14,9 +24,51 @@ from typing import Any
 
 import jax
 import numpy as np
-import orbax.checkpoint as ocp
 
-__all__ = ["CheckpointManager"]
+try:
+    import orbax.checkpoint as ocp
+except ImportError:  # pragma: no cover - present in the dev container
+    ocp = None
+
+__all__ = ["CheckpointManager", "save_weights_file", "load_weights_file"]
+
+
+def save_weights_file(path: str, variables: Any) -> str:
+    """Write ``variables`` (any pytree of arrays — typically the model's
+    ``{"params": ...}`` dict) to ``path`` in the serialized-pytree format,
+    atomically: the bytes land in a same-directory temp file first and
+    ``os.replace`` publishes them, so a concurrent reader (a replica
+    executing ``reload``) can never observe a half-written file. Returns
+    ``path``."""
+    from distkeras_tpu.utils.pytree import pytree_to_host, serialize_pytree
+
+    data = serialize_pytree(pytree_to_host(variables))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # A failed publish (disk full, mid-write kill) must not litter
+        # the weights directory with orphaned temp files.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load_weights_file(path: str, like: Any | None = None) -> Any:
+    """Read a :func:`save_weights_file` / ``Model.save_weights`` file.
+    With ``like``, leaves unflatten into that exact structure; without,
+    a nested dict tree is rebuilt from the recorded key paths."""
+    from distkeras_tpu.utils.pytree import deserialize_pytree
+
+    with open(path, "rb") as f:
+        return deserialize_pytree(f.read(), like=like)
 
 
 class CheckpointManager:
@@ -27,6 +79,11 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        if ocp is None:
+            raise ImportError(
+                "orbax-checkpoint is required for CheckpointManager "
+                "(the flat-file save_weights_file/load_weights_file "
+                "helpers work without it)")
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(

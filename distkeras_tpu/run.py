@@ -13,7 +13,11 @@ Example config:
 
 Online serving (``python -m distkeras_tpu.run serve --model gpt_tiny
 --port 8500``) starts the continuous-batching TCP server
-(:mod:`distkeras_tpu.serving`) over a causal LM from the zoo.
+(:mod:`distkeras_tpu.serving`) over a causal LM from the zoo;
+``serve --replicas N`` (or the ``cluster`` subcommand) starts N replica
+processes behind a supervised router with automatic restarts and
+zero-downtime rolling weight reloads
+(:mod:`distkeras_tpu.serving.cluster`).
 """
 
 from __future__ import annotations
@@ -62,10 +66,12 @@ def load_data(path: str, features_col: str, label_col: str):
     )
 
 
-def serve_main(argv=None) -> int:
+def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     """``serve`` subcommand: continuous-batching TCP server over a causal
-    LM from the zoo (random-init demo weights unless --weights given)."""
-    ap = argparse.ArgumentParser(prog="distkeras_tpu.run serve")
+    LM from the zoo (random-init demo weights unless --weights given).
+    ``--replicas N`` (or the ``cluster`` subcommand) instead starts N
+    replica processes behind a supervised router on ``--port``."""
+    ap = argparse.ArgumentParser(prog=f"distkeras_tpu.run {prog}")
     ap.add_argument("--model", default="gpt_tiny",
                     help="causal LM from the zoo (gpt_tiny/gpt_small)")
     ap.add_argument("--model-args", default="{}",
@@ -93,6 +99,23 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--prefix-block", type=int, default=16,
                     help="prefix-cache block granularity in tokens")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=default_replicas,
+                    help="> 1: start this many replica processes behind a "
+                         "supervised router on --port (least-outstanding "
+                         "routing with prefix-cache affinity, automatic "
+                         "restarts, rolling weight reloads)")
+    ap.add_argument("--affinity-slack", type=int, default=4,
+                    help="cluster mode: max outstanding-request imbalance "
+                         "the prefix-affinity pin may create before plain "
+                         "least-outstanding routing wins")
+    ap.add_argument("--replica-env", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="cluster mode, repeatable: extra env var for each "
+                         "replica child; '{i}' expands to the replica "
+                         "index — the device-partitioning hook (e.g. "
+                         "CUDA_VISIBLE_DEVICES={i} so N replicas on one "
+                         "accelerator host each claim one chip instead of "
+                         "all of them)")
     ap.add_argument("--metrics-out", default=None,
                     help="JSONL per-iteration serving metrics")
     ap.add_argument("--trace-out", default=None,
@@ -105,6 +128,8 @@ def serve_main(argv=None) -> int:
                          "decode step ever recompiles after its first "
                          "iteration")
     args = ap.parse_args(argv)
+    if args.replicas > 1:
+        return cluster_main(args)
 
     import asyncio
 
@@ -120,10 +145,9 @@ def serve_main(argv=None) -> int:
     model = load_model(args.model, json.loads(args.model_args))
     variables = model.init(args.seed)
     if args.weights:
-        from distkeras_tpu.utils.pytree import deserialize_pytree
+        from distkeras_tpu.checkpoint import load_weights_file
 
-        variables = deserialize_pytree(
-            open(args.weights, "rb").read(), like=variables)
+        variables = load_weights_file(args.weights, like=variables)
     # One registry behind everything this process publishes — serving
     # metrics, the scheduler, the stream's last-value gauges, the auditor
     # — so a metricsz scrape shows the whole picture.
@@ -186,11 +210,111 @@ def serve_main(argv=None) -> int:
     return 0
 
 
+def cluster_main(args) -> int:
+    """Multi-replica serving: N child processes (each a full ``serve``
+    on an ephemeral port) behind a supervised router on ``--port``.
+    Replica death -> capped-backoff restart; ``{"cmd": "reload",
+    "weights": path}`` on the router rolls new weights with zero
+    downtime. See docs/operations.md for the runbook."""
+    import asyncio
+    import signal
+
+    from distkeras_tpu.serving.cluster import ProcessReplica, ServingCluster
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    def replica_args(i: int) -> list[str]:
+        extra = [
+            "--model", args.model, "--model-args", args.model_args,
+            "--slots", str(args.slots),
+            "--max-queue", str(args.max_queue),
+            "--seed", str(args.seed),
+            "--prefix-cache-mb", str(args.prefix_cache_mb),
+            "--prefix-block", str(args.prefix_block),
+        ]
+        if args.weights:
+            extra += ["--weights", args.weights]
+        if args.top_k is not None:
+            extra += ["--top-k", str(args.top_k)]
+        if args.prefill_chunk is not None:
+            extra += ["--prefill-chunk", str(args.prefill_chunk)]
+        if args.audit_recompiles:
+            extra += ["--audit-recompiles", args.audit_recompiles]
+        if args.metrics_out:
+            extra += ["--metrics-out", f"{args.metrics_out}.r{i}"]
+        if args.trace_out:
+            extra += ["--trace-out", f"{args.trace_out}.r{i}"]
+        return extra
+
+    def replica_env(i: int) -> dict[str, str]:
+        env = {}
+        for item in args.replica_env:
+            key, sep, val = item.partition("=")
+            if not sep:
+                raise SystemExit(f"--replica-env needs KEY=VAL, got {item!r}")
+            env[key] = val.replace("{i}", str(i))
+        return env
+
+    from distkeras_tpu.telemetry import enable_tracing
+
+    # Parent-side spans cover the router hop (route / rolling_reload);
+    # each replica writes its own engine timeline to {trace_out}.r{i}.
+    tracer = enable_tracing() if args.trace_out else None
+    registry = MetricsRegistry()
+    cluster = ServingCluster(
+        lambda i: ProcessReplica(replica_args(i), host=args.host,
+                                 env=replica_env(i)),
+        args.replicas, host=args.host, port=args.port, registry=registry,
+        router_kwargs={
+            "affinity_tokens": args.prefix_block,
+            "affinity_slack": args.affinity_slack,
+        })
+
+    async def go():
+        await cluster.start()
+        print(json.dumps({
+            "cluster": args.model, "host": args.host, "port": cluster.port,
+            "replicas": {rid: {"host": info.host, "port": info.port}
+                         for rid, info in cluster.replicas.items()},
+            "slots": args.slots, "prefix_cache_mb": args.prefix_cache_mb,
+        }), flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+        try:
+            await stop.wait()
+        finally:
+            # Even when the wait is cancelled (KeyboardInterrupt on
+            # platforms without signal handlers), the replica children
+            # must be reaped — they are real processes, not tasks.
+            await cluster.stop()
+        print(json.dumps({
+            "restarts": {rid: info.restarts
+                         for rid, info in cluster.replicas.items()},
+            "router": registry.snapshot(),
+        }), flush=True)
+
+    try:
+        asyncio.run(go())
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if tracer is not None:
+            tracer.export_chrome_trace(args.trace_out)
+            print(json.dumps({"trace_out": args.trace_out}), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "serve":
         return serve_main(argv[1:])
+    if argv and argv[0] == "cluster":
+        return serve_main(argv[1:], prog="cluster", default_replicas=2)
     ap = argparse.ArgumentParser(prog="distkeras_tpu.run")
     ap.add_argument("--config", required=True, help="TrainerConfig JSON file")
     ap.add_argument("--data", required=True, help=".npz (features/label) or CSV")

@@ -21,7 +21,12 @@ request path:
 - :class:`ServingServer` / :class:`ServingClient` — asyncio TCP front end
   with newline-delimited-JSON streaming token output;
 - :class:`ServingMetrics` — TTFT / inter-token latency / occupancy
-  percentiles through :class:`distkeras_tpu.tracing.MetricStream`.
+  percentiles through :class:`distkeras_tpu.tracing.MetricStream`;
+- :mod:`distkeras_tpu.serving.cluster` — multi-replica serving:
+  :class:`ServingCluster` (= :class:`Router` front port +
+  :class:`ReplicaSupervisor` restarts) with prefix-cache-affine routing,
+  zero-streamed retry on replica death, and zero-downtime rolling weight
+  reloads.
 """
 
 from distkeras_tpu.serving.scheduler import (
@@ -38,9 +43,21 @@ from distkeras_tpu.serving.prefix_cache import PrefixCache
 from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.server import ServingServer
 from distkeras_tpu.serving.client import ServingClient
+from distkeras_tpu.serving.cluster import (
+    LocalReplica,
+    ProcessReplica,
+    ReplicaSupervisor,
+    Router,
+    ServingCluster,
+)
 
 __all__ = [
     "ServingEngine",
+    "ServingCluster",
+    "Router",
+    "ReplicaSupervisor",
+    "LocalReplica",
+    "ProcessReplica",
     "PrefixCache",
     "Scheduler",
     "Request",

@@ -36,17 +36,38 @@ def _raise_for(rec: dict) -> None:
 
 class ServingClient:
     """One TCP connection; requests run sequentially per connection (open
-    several clients for concurrency — the server batches across them)."""
+    several clients for concurrency — the server batches across them).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8500):
+    Idempotent control verbs (``metricsz``/``healthz``) transparently
+    reconnect with capped exponential backoff when the connection drops —
+    same shape as ``parallel/ha.py § RetryingClient`` — so a monitoring
+    loop survives a server restart (or a replica bounce behind a router)
+    instead of surfacing a raw ``ConnectionResetError``. ``max_retries``
+    bounds the attempts, ``base_delay_s``/``max_delay_s`` the backoff;
+    ``max_retries=0`` disables retry (health probes that must fail fast).
+    Generation streams are NOT retried here: a reconnect would resubmit
+    work whose first attempt may still be decoding — the cluster router
+    owns that retry, where idempotence is provable.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8500, *,
+                 max_retries: int = 3, base_delay_s: float = 0.1,
+                 max_delay_s: float = 2.0):
         self.host = host
         self.port = port
+        self.max_retries = int(max_retries)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
 
     async def connect(self) -> "ServingClient":
+        # Generous line limit: a cluster router's aggregate metricsz
+        # (every replica's registry snapshot on ONE line) outgrows
+        # StreamReader's 64 KB default well before it stops being a
+        # perfectly healthy reply.
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port)
+            self.host, self.port, limit=2**24)
         return self
 
     async def aclose(self) -> None:
@@ -113,7 +134,7 @@ class ServingClient:
                 on_token(tok)
         return self.last_done
 
-    async def _control(self, spec: dict) -> dict:
+    async def _control_once(self, spec: dict) -> dict:
         if self._writer is None:
             await self.connect()
         self._writer.write((json.dumps(spec) + "\n").encode())
@@ -126,17 +147,57 @@ class ServingClient:
             _raise_for(rec)
         return rec
 
+    async def _control(self, spec: dict, *, retry: bool = False) -> dict:
+        """One control round trip. With ``retry`` (idempotent verbs only)
+        a dropped/refused connection is retried over a FRESH connection
+        with capped exponential backoff; server-side typed errors
+        (:class:`ServingError`) always propagate immediately — only the
+        transport is retried, never a server that answered."""
+        if not retry or self.max_retries <= 0:
+            return await self._control_once(spec)
+        delay = self.base_delay_s
+        last: BaseException | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                return await self._control_once(spec)
+            except (OSError, ValueError) as e:
+                # OSError covers ConnectionResetError/BrokenPipeError/
+                # ConnectionRefusedError; ValueError covers the
+                # JSONDecodeError of a reply truncated by a mid-write
+                # server death. Either way the dead connection is
+                # dropped so the next attempt dials fresh.
+                last = e
+                await self.aclose()
+                if attempt < self.max_retries:
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, self.max_delay_s)
+        raise ConnectionError(
+            f"control verb {spec.get('cmd')!r} failed after "
+            f"{self.max_retries + 1} attempts") from last
+
     async def metricsz(self, format: str | None = None):
         """Scrape the server's live metrics registry: a nested dict by
-        default, the Prometheus text page with ``format="prometheus"``."""
+        default, the Prometheus text page with ``format="prometheus"``.
+        Reconnects with backoff on a dropped connection (idempotent)."""
         spec = {"cmd": "metricsz"}
         if format is not None:
             spec["format"] = format
-        return (await self._control(spec))["metricsz"]
+        return (await self._control(spec, retry=True))["metricsz"]
 
     async def healthz(self) -> dict:
-        """Engine liveness snapshot (slots, queue depth, compile count)."""
-        return (await self._control({"cmd": "healthz"}))["healthz"]
+        """Engine liveness snapshot (slots, queue depth, compile count).
+        Reconnects with backoff on a dropped connection (idempotent)."""
+        return (await self._control({"cmd": "healthz"},
+                                    retry=True))["healthz"]
+
+    async def reload(self, weights: str, timeout: float = 60.0) -> dict:
+        """Hot-swap weights: a rolling reload when pointed at a cluster
+        router, a single-engine swap when pointed at one server. NOT
+        transport-retried (a retry could double-trigger a long rolling
+        drain); callers handle ``ConnectionError`` themselves."""
+        return (await self._control(
+            {"cmd": "reload", "weights": weights,
+             "timeout": timeout}))["reload"]
 
     def generate_sync(self, prompt: Sequence[int], max_new_tokens: int,
                       **kw) -> dict:

@@ -1,0 +1,262 @@
+"""Replica handles and the shared replica table.
+
+The cluster layer treats one serving replica — a full
+:class:`~distkeras_tpu.serving.server.ServingServer` over its own
+:class:`~distkeras_tpu.serving.engine.ServingEngine` — as an opaque
+process-like unit behind :class:`ReplicaHandle`: start it, learn its
+``(host, port)``, poll whether it is alive, kill it hard, or terminate it
+gracefully. Two implementations:
+
+- :class:`ProcessReplica` — a real child process running ``python -m
+  distkeras_tpu.run serve --port 0 ...``; the replica's JSON banner line
+  (printed by ``serve_main``) carries the ephemeral port back. This is
+  the deployment shape: a SIGKILL'd replica drops its TCP connections
+  exactly like a crashed host.
+- :class:`LocalReplica` — an in-process replica (engine + server on the
+  current event loop). One process, N engines: each still compiles its
+  own decode step, so the cluster invariants (compile-count==1 per
+  replica, router retry, rolling reload) are exercised without paying a
+  jax import per replica — this is what the tests and the CPU bench use.
+  ``kill()`` emulates a crash: the engine task is cancelled mid-flight
+  and the listener closed, so in-flight streams terminate with engine
+  failure and the handle reports dead.
+
+:class:`ReplicaInfo` is one row of the table the supervisor and router
+SHARE: the supervisor owns ``status`` transitions and ``host``/``port``
+rebinds across restarts; the router owns the ``outstanding`` request
+count (incremented at dispatch, decremented at the terminal line) that
+both least-outstanding routing and the rolling reload's drain wait read.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import sys
+
+__all__ = [
+    "STARTING",
+    "READY",
+    "DRAINING",
+    "DEAD",
+    "ReplicaInfo",
+    "ReplicaHandle",
+    "LocalReplica",
+    "ProcessReplica",
+    "probe_healthz",
+    "send_control",
+]
+
+# Replica lifecycle states (ReplicaInfo.status). STARTING: launched, not
+# yet answering healthz. READY: routable. DRAINING: healthy but removed
+# from routing (rolling reload); outstanding requests run to completion.
+# DEAD: crashed/wedged; a restart task owns it until READY again.
+STARTING = "starting"
+READY = "ready"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    """One replica's row in the shared cluster table."""
+
+    rid: str
+    index: int
+    handle: "ReplicaHandle"
+    host: str = ""
+    port: int = 0
+    status: str = STARTING
+    outstanding: int = 0  # router-maintained in-flight request count
+    restarts: int = 0
+    consecutive_failures: int = 0
+    consecutive_restarts: int = 0  # backoff exponent; reset on stable READY
+    ready_since: float | None = None
+    last_health: dict = dataclasses.field(default_factory=dict)
+
+    def public(self) -> dict:
+        """The JSON-safe view the router's aggregate healthz exposes."""
+        return {
+            "status": self.status,
+            "host": self.host,
+            "port": self.port,
+            "outstanding": self.outstanding,
+            "restarts": self.restarts,
+            "consecutive_failures": self.consecutive_failures,
+        }
+
+
+async def send_control(host: str, port: int, spec: dict,
+                       timeout: float = 5.0) -> dict:
+    """One control verb over a fresh bounded connection: connect, one
+    line out, one line back. Raises ``OSError``/``asyncio.TimeoutError``
+    on an unreachable, dead, or wedged peer."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port, limit=2**24), timeout)
+    try:
+        writer.write((json.dumps(spec) + "\n").encode())
+        await writer.drain()
+        line = await asyncio.wait_for(reader.readline(), timeout)
+        if not line:
+            raise ConnectionError("replica closed the connection")
+        return json.loads(line)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+
+
+async def probe_healthz(host: str, port: int, timeout: float = 2.0) -> dict:
+    """One-shot ``{"cmd": "healthz"}`` over a fresh connection.
+
+    Raises ``OSError``/``asyncio.TimeoutError`` on an unreachable, dead,
+    or WEDGED replica — a connect that succeeds but a reply that never
+    comes counts as unhealthy (the supervisor restarts on it), which is
+    what catches a live process whose event loop has stalled.
+    """
+    rec = await send_control(host, port, {"cmd": "healthz"}, timeout)
+    if "healthz" not in rec:
+        raise ConnectionError(f"malformed healthz reply: {rec!r}")
+    return rec["healthz"]
+
+
+class ReplicaHandle:
+    """Lifecycle interface the supervisor drives. Subclass contract:
+    ``start`` returns the replica's ``(host, port)`` once it is
+    *listening* (healthz readiness is the supervisor's job); ``alive``
+    must be a cheap sync poll; ``kill`` is abrupt (crash semantics),
+    ``terminate`` is graceful (drain in-flight, then exit)."""
+
+    async def start(self) -> tuple[str, int]:
+        raise NotImplementedError
+
+    @property
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    async def kill(self) -> None:
+        raise NotImplementedError
+
+    async def terminate(self) -> None:
+        raise NotImplementedError
+
+
+class LocalReplica(ReplicaHandle):
+    """In-process replica: ``engine_factory()`` builds a fresh
+    :class:`ServingEngine` (a restart must not inherit the crashed
+    engine's state), served on an ephemeral port of ``host``."""
+
+    def __init__(self, engine_factory, host: str = "127.0.0.1"):
+        self.engine_factory = engine_factory
+        self.host = host
+        self.engine = None
+        self.server = None
+        self._killed = False
+
+    async def start(self) -> tuple[str, int]:
+        from distkeras_tpu.serving.server import ServingServer
+
+        self.engine = self.engine_factory()
+        self.server = ServingServer(self.engine, host=self.host, port=0)
+        await self.server.start()
+        return self.host, self.server.port
+
+    @property
+    def alive(self) -> bool:
+        if self._killed or self.server is None:
+            return False
+        task = self.server._engine_task
+        return task is not None and not task.done()
+
+    async def kill(self) -> None:
+        """Crash semantics: cancel the engine task mid-flight (in-flight
+        requests error out, exactly as a device failure would) and close
+        the listener. Existing handler connections flush their terminal
+        error lines — the router treats those the same as a dropped
+        connection (retryable iff zero tokens streamed)."""
+        self._killed = True
+        if self.server is None:
+            return
+        if self.server._server is not None:
+            self.server._server.close()
+        task = self.server._engine_task
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def terminate(self) -> None:
+        if self._killed or self.server is None:
+            return
+        self._killed = True
+        await self.server.stop(drain=True)
+
+
+class ProcessReplica(ReplicaHandle):
+    """Child-process replica: ``python -m distkeras_tpu.run serve --port 0
+    <extra_args>``. The serve banner (first stdout line, JSON with the
+    bound port) is the readiness handshake; stderr is inherited so
+    replica logs land in the supervisor's stream."""
+
+    def __init__(self, extra_args: list[str], host: str = "127.0.0.1",
+                 start_timeout_s: float = 120.0,
+                 env: dict[str, str] | None = None):
+        self.extra_args = list(extra_args)
+        self.host = host
+        self.start_timeout_s = float(start_timeout_s)
+        # Extra environment merged over the parent's — the device-
+        # partitioning hook: N replicas on one accelerator host must not
+        # all claim every chip (e.g. CUDA_VISIBLE_DEVICES / TPU chip
+        # pinning per replica index; see run.py --replica-env).
+        self.env = dict(env) if env else None
+        self.proc: asyncio.subprocess.Process | None = None
+
+    async def start(self) -> tuple[str, int]:
+        import os
+
+        child_env = None
+        if self.env:
+            child_env = {**os.environ, **self.env}
+        self.proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "distkeras_tpu.run", "serve",
+            "--host", self.host, "--port", "0", *self.extra_args,
+            stdout=asyncio.subprocess.PIPE, env=child_env)
+        try:
+            line = await asyncio.wait_for(
+                self.proc.stdout.readline(), self.start_timeout_s)
+            banner = json.loads(line)
+            return banner.get("host", self.host), int(banner["port"])
+        except Exception:
+            # A replica that dies (or prints garbage) before its banner
+            # must not leak a half-started child.
+            await self.kill()
+            raise
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.returncode is None
+
+    async def kill(self) -> None:
+        if self.proc is not None and self.proc.returncode is None:
+            try:
+                self.proc.kill()  # SIGKILL: the chaos-test crash
+            except ProcessLookupError:
+                pass
+            await self.proc.wait()
+
+    async def terminate(self, grace_s: float = 30.0) -> None:
+        if self.proc is None or self.proc.returncode is not None:
+            return
+        try:
+            self.proc.terminate()  # SIGTERM: serve_main drains and exits
+        except ProcessLookupError:
+            return
+        try:
+            await asyncio.wait_for(self.proc.wait(), grace_s)
+        except asyncio.TimeoutError:
+            await self.kill()

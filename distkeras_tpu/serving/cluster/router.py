@@ -1,0 +1,612 @@
+"""Asyncio router: one front port over N serving replicas.
+
+Speaks the SAME newline-delimited-JSON protocol as a single
+:class:`~distkeras_tpu.serving.server.ServingServer`, so every existing
+client (``ServingClient``, ``nc``, the bench) points at a cluster by
+changing nothing but the port. Per generation request the router:
+
+1. **picks a replica**: least-outstanding-requests, biased by
+   **prefix-cache affinity** — the first ``affinity_tokens`` prompt
+   tokens hash to a *prompt family*, and rendezvous hashing pins each
+   family to a stable READY replica so PR 3's radix-trie prefix cache
+   keeps hitting (the same system prompt always lands where its KV
+   blocks live). The pin yields to plain least-outstanding when the
+   preferred replica is more than ``affinity_slack`` requests busier
+   than the least-loaded one — affinity is a tiebreak, not a hotspot
+   generator;
+2. **relays the stream** token-line by token-line;
+3. **retries idempotent work**: if the backend dies (connection drop, or
+   a replica-side failure/shutdown error) while the request has streamed
+   ZERO tokens, the request is re-dispatched to a surviving replica —
+   the client never notices. Once tokens have streamed the request is
+   not idempotent (the client has partial output) and the stream ends
+   with a typed ``replica_lost`` error. Backend loss is also reported to
+   the supervisor so the restart starts now, not at the next health
+   tick.
+
+Control verbs aggregate across the fleet: ``healthz`` returns the
+replica table plus each live replica's own healthz; ``metricsz`` returns
+the router's registry plus each replica's snapshot keyed by replica id
+(``format="prometheus"`` returns the ROUTER's page — per-replica pages
+need per-replica scrape targets, which the table's host/port provides).
+
+``{"cmd": "reload", "weights": path}`` performs the **zero-downtime
+rolling reload**: one replica at a time is marked DRAINING (the router
+stops sending it new work), its outstanding count is drained to zero,
+the replica-side ``reload`` verb swaps params from the checkpoint path
+(flushing its prefix cache and rewarming one decode tick), and the
+replica is readmitted — the cluster never serves fewer than N-1
+replicas and no client stream is ever cut.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import zlib
+
+from distkeras_tpu.serving.cluster.replicas import (
+    DRAINING,
+    READY,
+    ReplicaInfo,
+)
+from distkeras_tpu.serving.cluster.supervisor import ReplicaSupervisor
+from distkeras_tpu.telemetry import span
+
+__all__ = ["Router", "ServingCluster"]
+
+# Backend error codes that are safe to retry on another replica while
+# zero tokens have streamed: the work provably never produced output.
+# "stopped"/"error" are replica-side failures, "queue_full" is one
+# replica's backpressure (another may have room), "busy" is a replica
+# mid-reload. "timeout" (the request's own deadline) and "bad_request"
+# (deterministic) are NOT retried.
+_RETRYABLE_CODES = frozenset({"stopped", "error", "queue_full", "busy"})
+
+
+class _BackendLost(Exception):
+    """The backend connection died mid-request (EOF or reset)."""
+
+
+class _ClientGone(Exception):
+    """The CLIENT connection died mid-relay. Deliberately not an OSError
+    subclass: _relay's backend-failure handler must never swallow it — a
+    walked-away client is not a replica failure and must not feed the
+    supervisor's death detection or burn a retry."""
+
+
+class Router:
+    """Front-port router over a :class:`ReplicaSupervisor`'s table.
+
+    ``affinity_tokens``: prompt-family prefix length for cache affinity —
+    match it to the backend engines' ``prefix_block_tokens`` (a family
+    shorter than one cache block can't pin what the trie shares).
+    ``affinity_slack``: max outstanding-request imbalance the pin may
+    create before least-outstanding wins.
+    ``max_retries``: re-dispatch budget for zero-streamed requests.
+    ``pick_wait_s``: how long a dispatch waits for ANY replica to be
+    READY (rolling restarts) before failing with ``unavailable``.
+    """
+
+    def __init__(
+        self,
+        supervisor: ReplicaSupervisor,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        affinity_tokens: int = 16,
+        affinity_slack: int = 4,
+        max_retries: int = 2,
+        pick_wait_s: float = 10.0,
+        pool_size: int = 8,
+        connect_timeout_s: float = 5.0,
+        registry=None,
+    ):
+        self.supervisor = supervisor
+        self.host = host
+        self._requested_port = port
+        self.affinity_tokens = int(affinity_tokens)
+        self.affinity_slack = int(affinity_slack)
+        self.max_retries = int(max_retries)
+        self.pick_wait_s = float(pick_wait_s)
+        self.pool_size = int(pool_size)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._server: asyncio.AbstractServer | None = None
+        # Idle backend connections, keyed by (rid, port): a restarted
+        # replica binds a fresh port, so its stale pool is simply never
+        # hit again.
+        self._pools: dict[tuple[str, int], list] = {}
+        self._reload_lock = asyncio.Lock()
+        self.registry = registry
+        self._c_requests = self._c_retries = self._c_affinity = None
+        self._c_affinity_spill = self._c_lost = self._c_unavailable = None
+        self._c_reloads = None
+        if registry is not None:
+            self._c_requests = registry.counter(
+                "router_requests_total", help="generation requests routed")
+            self._c_retries = registry.counter(
+                "router_retries_total",
+                help="zero-streamed requests re-dispatched after a backend "
+                     "failure")
+            self._c_affinity = registry.counter(
+                "router_affinity_picks_total",
+                help="dispatches that followed the prompt-family pin")
+            self._c_affinity_spill = registry.counter(
+                "router_affinity_spills_total",
+                help="dispatches where load imbalance overrode the pin")
+            self._c_lost = registry.counter(
+                "router_streams_lost_total",
+                help="streams terminated with replica_lost (tokens already "
+                     "streamed when the backend died)")
+            self._c_unavailable = registry.counter(
+                "router_unavailable_total",
+                help="requests failed with no READY replica")
+            self._c_reloads = registry.counter(
+                "router_rolling_reloads_total",
+                help="rolling weight reloads completed")
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("router not started")
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self._requested_port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                pass
+        for pool in self._pools.values():
+            for _, writer in pool:
+                writer.close()
+        self._pools.clear()
+
+    # -- replica choice -----------------------------------------------------
+    def _family(self, prompt) -> int:
+        try:
+            head = ",".join(
+                str(int(t)) for t in prompt[:self.affinity_tokens])
+        except (TypeError, ValueError):
+            # Un-hashable junk (a string prompt, nested lists): no
+            # affinity — the replica will reject it with a typed
+            # bad_request, which is the reply the client should see.
+            return 0
+        return zlib.crc32(head.encode())
+
+    def _pick(self, prompt, exclude: set[str]) -> ReplicaInfo | None:
+        ready = [r for r in self.supervisor.replicas.values()
+                 if r.status == READY and r.rid not in exclude]
+        if not ready:
+            return None
+        if len(ready) == 1:
+            return ready[0]
+        fam = self._family(prompt)
+        # Rendezvous (highest-random-weight) hash: each family ranks every
+        # replica; the top-ranked READY one wins. Replica death/drain only
+        # remaps the families that were pinned to it — every other family
+        # keeps its warm cache.
+        preferred = max(
+            ready, key=lambda r: zlib.crc32(f"{fam}:{r.rid}".encode()))
+        least = min(ready, key=lambda r: r.outstanding)
+        if preferred.outstanding - least.outstanding > self.affinity_slack:
+            if self._c_affinity_spill is not None:
+                self._c_affinity_spill.inc()
+            return least
+        if self._c_affinity is not None:
+            self._c_affinity.inc()
+        return preferred
+
+    async def _pick_wait(self, prompt, exclude: set[str]):
+        """Pick a replica, waiting up to ``pick_wait_s`` for one to be
+        READY (covers the restart window after a crash and the brief
+        all-draining edge of a 1-replica reload)."""
+        deadline = time.monotonic() + self.pick_wait_s
+        while True:
+            info = self._pick(prompt, exclude)
+            if info is not None:
+                return info
+            if exclude:
+                # Every non-excluded replica is down; retrying on an
+                # excluded-but-recovered one beats failing the request.
+                exclude.clear()
+                continue
+            if time.monotonic() > deadline:
+                return None
+            await asyncio.sleep(0.02)
+
+    # -- backend connections ------------------------------------------------
+    async def _acquire(self, info: ReplicaInfo):
+        # A restarted replica binds a fresh port: drop the old port's
+        # pooled sockets now, or a crash-looping replica accretes one
+        # dead pool per restart for the router's lifetime.
+        for key in [k for k in self._pools
+                    if k[0] == info.rid and k[1] != info.port]:
+            for _, writer in self._pools.pop(key):
+                writer.close()
+        pool = self._pools.get((info.rid, info.port))
+        while pool:
+            reader, writer = pool.pop()
+            if not writer.is_closing():
+                return reader, writer
+            writer.close()
+        try:
+            # Bounded connect (the OS default is minutes — a SYN-dropping
+            # host must not stall dispatch, fleet aggregation, or a
+            # rolling reload holding its lock) and a generous line limit:
+            # an aggregate-bound metricsz snapshot is one long JSON line,
+            # far past StreamReader's 64 KB default.
+            return await asyncio.wait_for(
+                asyncio.open_connection(info.host, info.port, limit=2**24),
+                self.connect_timeout_s)
+        except asyncio.TimeoutError as e:
+            raise OSError(
+                f"connect to {info.rid} ({info.host}:{info.port}) timed "
+                f"out after {self.connect_timeout_s}s") from e
+
+    def _release(self, info: ReplicaInfo, conn, healthy: bool) -> None:
+        reader, writer = conn
+        if not healthy or writer.is_closing():
+            writer.close()
+            return
+        pool = self._pools.setdefault((info.rid, info.port), [])
+        if len(pool) < self.pool_size:
+            pool.append(conn)
+        else:
+            writer.close()
+
+    async def _backend_control(self, info: ReplicaInfo, spec: dict,
+                               timeout: float = 5.0) -> dict:
+        """One control verb against one replica over a pooled connection."""
+        conn = await self._acquire(info)
+        reader, writer = conn
+        try:
+            writer.write((json.dumps(spec) + "\n").encode())
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), timeout)
+            if not line:
+                raise _BackendLost(f"{info.rid} closed the connection")
+            rec = json.loads(line)
+        except BaseException:
+            self._release(info, conn, healthy=False)
+            raise
+        self._release(info, conn, healthy=True)
+        return rec
+
+    # -- request path -------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    spec = json.loads(line)
+                    if not isinstance(spec, dict):
+                        raise ValueError("request must be a JSON object")
+                except ValueError as e:
+                    await self._send(writer,
+                                     {"error": str(e), "code": "bad_request"})
+                    continue
+                if "cmd" in spec:
+                    await self._send(writer, await self._control(spec))
+                else:
+                    await self._dispatch(spec, writer)
+        except (ConnectionResetError, BrokenPipeError, _ClientGone):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _dispatch(self, spec: dict,
+                        client: asyncio.StreamWriter) -> None:
+        """Route one generation request, retrying while idempotent."""
+        prompt = spec.get("prompt") or []
+        if self._c_requests is not None:
+            self._c_requests.inc()
+        attempts = 0
+        exclude: set[str] = set()
+        while True:
+            info = await self._pick_wait(prompt, exclude)
+            if info is None:
+                if self._c_unavailable is not None:
+                    self._c_unavailable.inc()
+                await self._send_client(client, {
+                    "error": "no serving replica available",
+                    "code": "unavailable"})
+                return
+            outcome, streamed, rec = await self._relay(info, spec, client)
+            if outcome == "terminal":
+                return
+            # Backend failed. Retry only while provably idempotent.
+            retryable = (streamed == 0 and attempts < self.max_retries)
+            if outcome == "lost":
+                self.supervisor.note_failure(info.rid)
+            if retryable:
+                attempts += 1
+                exclude.add(info.rid)
+                if self._c_retries is not None:
+                    self._c_retries.inc()
+                continue
+            if outcome == "reject":
+                # Retry budget spent on typed replica-side rejects (e.g.
+                # every replica at queue_full): forward the LAST replica's
+                # own error — it is the truthful backpressure signal, not
+                # a lost stream.
+                await self._send_client(client, rec)
+                return
+            if self._c_lost is not None:
+                self._c_lost.inc()
+            await self._send_client(client, {
+                "error": f"replica {info.rid} lost after {streamed} "
+                         f"streamed tokens",
+                "code": "replica_lost"})
+            return
+
+    async def _relay(self, info: ReplicaInfo, spec: dict,
+                     client: asyncio.StreamWriter):
+        """Stream one attempt through ``info``. Returns ``(outcome,
+        streamed, rec)`` where outcome is ``"terminal"`` (a final line
+        reached the client — done, or a non-retryable/late error),
+        ``"lost"`` (connection-level backend failure), or ``"reject"``
+        (typed replica-side error with zero tokens streamed — replica
+        answered, caller may retry elsewhere; ``rec`` carries its error
+        line). A client-side write failure cancels the backend work by
+        closing the backend connection."""
+        streamed = 0
+        info.outstanding += 1
+        try:
+            try:
+                conn = await self._acquire(info)
+            except OSError:
+                return "lost", streamed, None
+            reader, writer = conn
+            healthy = False
+            try:
+                with span("route", replica=info.rid,
+                          outstanding=info.outstanding):
+                    writer.write((json.dumps(spec) + "\n").encode())
+                    await writer.drain()
+                    while True:
+                        line = await reader.readline()
+                        if not line:
+                            return "lost", streamed, None
+                        rec = json.loads(line)
+                        if "token" in rec:
+                            streamed += 1
+                            await self._send_client(client, rec)
+                            continue
+                        if rec.get("done"):
+                            healthy = True
+                            await self._send_client(client, rec)
+                            return "terminal", streamed, rec
+                        # Terminal error line from the replica.
+                        code = rec.get("code")
+                        if streamed == 0 and code in _RETRYABLE_CODES:
+                            healthy = True
+                            return "reject", streamed, rec
+                        healthy = True
+                        await self._send_client(client, rec)
+                        return "terminal", streamed, rec
+            except (OSError, ConnectionResetError, BrokenPipeError,
+                    ValueError):
+                # Backend-side failure only: _ClientGone is not an
+                # OSError and propagates — closing the (unpooled, if
+                # mid-stream) backend connection cancels the request
+                # server-side instead of decoding for nobody.
+                return "lost", streamed, None
+            finally:
+                self._release(info, conn, healthy=healthy)
+        finally:
+            info.outstanding -= 1
+
+    async def _fetch_verb(self, info: ReplicaInfo, cmd: str):
+        """One replica's own control-verb payload for the aggregate
+        pages, or ``{"unreachable": ...}``; None for replicas not in a
+        routable state."""
+        if info.status not in (READY, DRAINING):
+            return None
+        try:
+            rep = await self._backend_control(info, {"cmd": cmd})
+            return rep.get(cmd, rep)
+        except (OSError, ValueError, asyncio.TimeoutError,
+                _BackendLost) as e:
+            return {"unreachable": str(e)}
+
+    # -- control verbs ------------------------------------------------------
+    async def _control(self, spec: dict) -> dict:
+        cmd = spec.get("cmd")
+        if cmd == "healthz":
+            infos = list(self.supervisor.replicas.items())
+            # Concurrent fan-out: fleet healthz latency is the SLOWEST
+            # replica's probe, not the sum (one wedged replica must not
+            # stall the whole page for timeout x N).
+            fetched = await asyncio.gather(*(
+                self._fetch_verb(info, "healthz") for _, info in infos))
+            replicas = {}
+            for (rid, info), sub in zip(infos, fetched):
+                entry = info.public()
+                if sub is not None:
+                    entry["healthz"] = sub
+                replicas[rid] = entry
+            return {"healthz": {
+                "router": {
+                    "replicas_total": len(self.supervisor.replicas),
+                    "replicas_ready": self.supervisor.ready_count,
+                    "outstanding_total": sum(
+                        r.outstanding
+                        for r in self.supervisor.replicas.values()),
+                },
+                "replicas": replicas,
+            }}
+        if cmd == "metricsz":
+            if spec.get("format") == "prometheus":
+                from distkeras_tpu.telemetry import prometheus_text
+
+                if self.registry is None:
+                    return {"error": "router has no metrics registry",
+                            "code": "bad_request"}
+                return {"metricsz": prometheus_text(self.registry)}
+            infos = list(self.supervisor.replicas.items())
+            fetched = await asyncio.gather(*(
+                self._fetch_verb(info, "metricsz") for _, info in infos))
+            replicas = {rid: sub for (rid, _), sub in zip(infos, fetched)
+                        if sub is not None}
+            out = {"replicas": replicas}
+            if self.registry is not None:
+                out["router"] = self.registry.snapshot()
+            return {"metricsz": out}
+        if cmd == "reload":
+            return await self.rolling_reload(spec)
+        return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
+
+    # -- rolling reload -----------------------------------------------------
+    async def rolling_reload(self, spec: dict) -> dict:
+        """Drain -> swap -> readmit, one replica at a time.
+
+        At most one replica is ever out of routing, so a cluster of N
+        serves on >= N-1 replicas throughout; in-flight streams on the
+        draining replica run to completion before its swap (the replica
+        table's ``outstanding`` count gates it), so no client sees a cut
+        stream. Serialized: a concurrent reload waits its turn.
+        """
+        path = spec.get("weights")
+        if not path:
+            return {"error": "reload requires a 'weights' path",
+                    "code": "bad_request"}
+        try:
+            drain_timeout = float(spec.get("drain_timeout", 60.0))
+            swap_timeout = float(spec.get("timeout", 120.0))
+        except (TypeError, ValueError) as e:
+            # Wire input must fail typed, not kill the handler loop —
+            # same stance as ServingServer's bad_request paths.
+            return {"error": f"bad reload timeout: {e}",
+                    "code": "bad_request"}
+        reloaded: list[str] = []
+        failed: dict[str, str] = {}
+        async with self._reload_lock:
+            with span("rolling_reload", weights=path):
+                for rid, info in list(self.supervisor.replicas.items()):
+                    if info.status != READY:
+                        failed[rid] = f"skipped: status={info.status}"
+                        continue
+                    info.status = DRAINING
+                    try:
+                        with span("reload_replica", replica=rid):
+                            deadline = time.monotonic() + drain_timeout
+                            while info.outstanding > 0:
+                                if time.monotonic() > deadline:
+                                    raise TimeoutError(
+                                        f"drain timed out with "
+                                        f"{info.outstanding} outstanding")
+                                await asyncio.sleep(0.01)
+                            rep = await self._backend_control(
+                                info,
+                                {"cmd": "reload", "weights": path,
+                                 "timeout": swap_timeout},
+                                timeout=swap_timeout + 10.0)
+                            if "error" in rep:
+                                raise RuntimeError(rep["error"])
+                        reloaded.append(rid)
+                        # From the first successful swap on, this is the
+                        # fleet's current version: any replica that
+                        # (re)starts later — including one that was DEAD
+                        # or failed during THIS roll — is brought to it
+                        # before rejoining routing.
+                        self.supervisor.current_weights = path
+                    except (OSError, ValueError, RuntimeError,
+                            TimeoutError, asyncio.TimeoutError,
+                            _BackendLost) as e:
+                        # The replica keeps its OLD weights but is still
+                        # healthy — readmit it rather than shrink the
+                        # fleet (a dead one is the supervisor's problem).
+                        failed[rid] = str(e)
+                    finally:
+                        if info.status == DRAINING:
+                            info.status = READY
+        if not failed and self._c_reloads is not None:
+            self._c_reloads.inc()
+        return {"reload": {"weights": path, "reloaded": reloaded,
+                           "failed": failed, "ok": not failed}}
+
+    @staticmethod
+    async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:
+        writer.write((json.dumps(obj) + "\n").encode())
+        await writer.drain()
+
+    @classmethod
+    async def _send_client(cls, writer: asyncio.StreamWriter,
+                           obj: dict) -> None:
+        """Send to the CLIENT; a dead client raises :class:`_ClientGone`
+        so relay/dispatch never mistake it for a replica failure."""
+        try:
+            await cls._send(writer, obj)
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            raise _ClientGone() from e
+
+
+class ServingCluster:
+    """Supervisor + router wired together: the one-call cluster.
+
+    ``factory``: ``index -> ReplicaHandle`` (see :mod:`.replicas`).
+    Extra keyword groups pass through: ``supervisor_kwargs`` to
+    :class:`ReplicaSupervisor`, ``router_kwargs`` to :class:`Router`;
+    a shared ``registry`` feeds both (and the router's ``metricsz``).
+    """
+
+    def __init__(self, factory, n: int, *, host: str = "127.0.0.1",
+                 port: int = 0, registry=None,
+                 supervisor_kwargs: dict | None = None,
+                 router_kwargs: dict | None = None):
+        self.supervisor = ReplicaSupervisor(
+            factory, n, registry=registry, **(supervisor_kwargs or {}))
+        self.router = Router(self.supervisor, host=host, port=port,
+                             registry=registry, **(router_kwargs or {}))
+        self._health_task: asyncio.Task | None = None
+
+    @property
+    def port(self) -> int:
+        return self.router.port
+
+    @property
+    def replicas(self) -> dict[str, ReplicaInfo]:
+        return self.supervisor.replicas
+
+    async def start(self) -> None:
+        await self.supervisor.start()
+        self._health_task = asyncio.get_running_loop().create_task(
+            self.supervisor.run(), name="replica-health")
+        try:
+            await self.router.start()
+        except BaseException:
+            # A front-port bind failure (EADDRINUSE) must not orphan the
+            # already-started replica processes or the health task.
+            await self.stop()
+            raise
+
+    async def stop(self) -> None:
+        await self.router.stop()
+        await self.supervisor.stop()
+        if self._health_task is not None:
+            try:
+                await asyncio.wait_for(self._health_task, 10.0)
+            except asyncio.TimeoutError:
+                self._health_task.cancel()
+
+    async def __aenter__(self) -> "ServingCluster":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()

@@ -1,0 +1,291 @@
+"""ReplicaSupervisor: keep N serving replicas alive.
+
+The dist-keras lesson transplanted to serving (DeepSpark / SparkNet make
+the same point for training): the win is a thin, fault-aware coordination
+layer over otherwise-independent workers. The supervisor owns the
+*lifecycle* column of the shared :class:`ReplicaInfo` table:
+
+- spawn N replicas from a ``factory(index) -> ReplicaHandle`` and wait
+  until each answers ``healthz`` (STARTING -> READY);
+- a periodic health loop probes every live replica over the existing
+  ``healthz`` verb — a dead process, a refused connection, or a reply
+  that never arrives (wedged event loop) all count as failures, and
+  ``fail_after`` consecutive failures mark the replica DEAD;
+- a DEAD replica is killed (idempotent) and restarted with **capped
+  exponential backoff** (the same shape as ``parallel/ha.py §
+  RetryingClient``): ``base_delay * 2^k`` capped at ``max_delay``, where
+  ``k`` counts restarts not yet vindicated by a stable READY period —
+  a crash-looping replica never hot-loops the host, a one-off crash
+  restarts almost immediately;
+- the router feeds observations back through :meth:`note_failure`
+  (a dispatch that found the backend gone), so detection latency is one
+  failed request, not one health interval.
+
+The supervisor never routes; the router never restarts. Both read and
+write the one table.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable
+
+from distkeras_tpu.serving.cluster.replicas import (
+    DEAD,
+    DRAINING,
+    READY,
+    STARTING,
+    ReplicaHandle,
+    ReplicaInfo,
+    probe_healthz,
+    send_control,
+)
+
+__all__ = ["ReplicaSupervisor"]
+
+
+class ReplicaSupervisor:
+    """Spawn, health-check, and restart a fleet of serving replicas.
+
+    ``factory``: ``index -> ReplicaHandle`` — called once per replica at
+    :meth:`start` and again for every restart (a restarted replica gets a
+    FRESH handle/engine; crashed state is never reused).
+    ``health_interval_s`` / ``health_timeout_s``: probe cadence and
+    per-probe deadline. ``fail_after``: consecutive failed probes before
+    a live-looking replica is declared dead (a handle whose process has
+    exited is declared dead immediately).
+    ``base_delay_s`` / ``max_delay_s``: restart backoff bounds.
+    ``stable_after_s``: a replica READY this long has its backoff
+    exponent reset (the crash was transient, not a loop).
+    """
+
+    def __init__(
+        self,
+        factory: Callable[[int], ReplicaHandle],
+        n: int,
+        *,
+        health_interval_s: float = 0.5,
+        health_timeout_s: float = 5.0,
+        fail_after: int = 2,
+        base_delay_s: float = 0.2,
+        max_delay_s: float = 30.0,
+        stable_after_s: float = 5.0,
+        registry=None,
+    ):
+        if n < 1:
+            raise ValueError(f"need at least 1 replica, got {n}")
+        self._factory = factory
+        self.health_interval_s = float(health_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.fail_after = int(fail_after)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.stable_after_s = float(stable_after_s)
+        self.replicas: dict[str, ReplicaInfo] = {
+            f"r{i}": ReplicaInfo(rid=f"r{i}", index=i, handle=factory(i))
+            for i in range(n)
+        }
+        self._stopping = asyncio.Event()
+        self._restart_tasks: set[asyncio.Task] = set()
+        # The fleet's CURRENT weights path, recorded by the router's
+        # rolling reload: a replica (re)started after a reload must
+        # rejoin on these weights, not the factory's boot weights —
+        # otherwise one crash silently creates a mixed-version fleet.
+        self.current_weights: str | None = None
+        self._c_restarts = self._c_health_failures = None
+        self._g_ready = None
+        if registry is not None:
+            self._c_restarts = registry.counter(
+                "cluster_replica_restarts_total",
+                help="replica restarts performed by the supervisor")
+            self._c_health_failures = registry.counter(
+                "cluster_health_check_failures_total",
+                help="failed replica health probes")
+            self._g_ready = registry.gauge(
+                "cluster_replicas_ready", help="replicas in READY state")
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def ready_count(self) -> int:
+        return sum(1 for r in self.replicas.values() if r.status == READY)
+
+    def _note_ready(self) -> None:
+        if self._g_ready is not None:
+            self._g_ready.set(self.ready_count)
+
+    def table(self) -> dict[str, dict]:
+        """JSON-safe snapshot of the replica table (aggregate healthz)."""
+        return {rid: info.public() for rid, info in self.replicas.items()}
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> None:
+        """Start every replica concurrently and wait until all READY.
+        If ANY replica fails to come up, every already-started one is
+        killed before the error propagates — a failed cluster start
+        leaves no orphaned replica processes behind."""
+        results = await asyncio.gather(
+            *(self._start_replica(info) for info in self.replicas.values()),
+            return_exceptions=True)
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if errors:
+            await asyncio.gather(
+                *(info.handle.kill() for info in self.replicas.values()),
+                return_exceptions=True)
+            raise errors[0]
+
+    async def _start_replica(self, info: ReplicaInfo) -> None:
+        info.status = STARTING
+        info.host, info.port = await info.handle.start()
+        await self._await_ready(info)
+
+    async def _await_ready(self, info: ReplicaInfo,
+                           timeout_s: float = 120.0) -> None:
+        """Probe until the replica answers healthz — then, if the fleet
+        has rolled to newer weights than the factory boots with, apply
+        them BEFORE the replica becomes routable — and mark READY."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                info.last_health = await probe_healthz(
+                    info.host, info.port, self.health_timeout_s)
+                break
+            except (OSError, asyncio.TimeoutError, ValueError):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica {info.rid} never became healthy on "
+                        f"{info.host}:{info.port}")
+                await asyncio.sleep(0.05)
+        if self.current_weights is not None:
+            # No traffic yet (still STARTING), so the swap runs at the
+            # engine's first idle iteration — immediately. A failure here
+            # fails the whole start: the restart path retries with
+            # backoff rather than admit a stale-weights replica.
+            rep = await send_control(
+                info.host, info.port,
+                {"cmd": "reload", "weights": self.current_weights,
+                 "timeout": 60.0},
+                timeout=120.0)
+            if "error" in rep:
+                raise RuntimeError(
+                    f"replica {info.rid} failed to load the fleet's "
+                    f"current weights {self.current_weights!r}: "
+                    f"{rep['error']}")
+        info.status = READY
+        info.ready_since = time.monotonic()
+        info.consecutive_failures = 0
+        self._note_ready()
+
+    async def run(self) -> None:
+        """Health loop: probe, detect, restart — until :meth:`stop`.
+        Probes run CONCURRENTLY per pass: one wedged replica costs its
+        own ``health_timeout_s``, never a serial stall that delays
+        detecting the next replica's death."""
+        while not self._stopping.is_set():
+            # DEAD and STARTING replicas are owned by their restart/start
+            # path (which probes readiness itself) — the health loop
+            # declaring one dead mid-restart would spawn a SECOND
+            # restart task for the same replica.
+            await asyncio.gather(*(
+                self._probe_once(info)
+                for info in list(self.replicas.values())
+                if info.status in (READY, DRAINING)))
+            try:
+                await asyncio.wait_for(
+                    self._stopping.wait(), self.health_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _probe_once(self, info: ReplicaInfo) -> None:
+        if self._stopping.is_set():
+            return
+        if not info.handle.alive:
+            self._on_dead(info, "process exited")
+            return
+        try:
+            info.last_health = await probe_healthz(
+                info.host, info.port, self.health_timeout_s)
+        except (OSError, asyncio.TimeoutError, ValueError):
+            info.consecutive_failures += 1
+            if self._c_health_failures is not None:
+                self._c_health_failures.inc()
+            if info.consecutive_failures >= self.fail_after:
+                self._on_dead(
+                    info, f"{info.consecutive_failures} failed probes")
+            return
+        info.consecutive_failures = 0
+        # A replica stable this long has outlived crash-loop suspicion:
+        # reset its backoff exponent.
+        if (info.consecutive_restarts and info.ready_since is not None
+                and time.monotonic() - info.ready_since
+                > self.stable_after_s):
+            info.consecutive_restarts = 0
+
+    def note_failure(self, rid: str) -> None:
+        """Router feedback: a dispatch found this replica's backend gone.
+        A handle whose process has exited is marked dead immediately (no
+        waiting out ``fail_after`` probe intervals); a still-alive handle
+        just accrues one failure (transient resets stay survivable)."""
+        info = self.replicas.get(rid)
+        if info is None or info.status in (DEAD, STARTING):
+            return  # the restart/start path already owns this replica
+        if not info.handle.alive:
+            self._on_dead(info, "router observed backend loss")
+        else:
+            info.consecutive_failures += 1
+            if info.consecutive_failures >= self.fail_after:
+                self._on_dead(info, "router-observed failures")
+
+    def _on_dead(self, info: ReplicaInfo, why: str) -> None:
+        if info.status == DEAD or self._stopping.is_set():
+            return
+        info.status = DEAD
+        info.ready_since = None
+        self._note_ready()
+        task = asyncio.get_running_loop().create_task(
+            self._restart(info), name=f"restart-{info.rid}")
+        self._restart_tasks.add(task)
+        task.add_done_callback(self._restart_tasks.discard)
+
+    async def _restart(self, info: ReplicaInfo) -> None:
+        """Kill the corpse, then bring up a fresh handle with capped
+        exponential backoff until READY (or the supervisor stops)."""
+        await info.handle.kill()
+        while not self._stopping.is_set():
+            delay = min(
+                self.base_delay_s * (2 ** info.consecutive_restarts),
+                self.max_delay_s)
+            info.consecutive_restarts += 1
+            try:
+                await asyncio.wait_for(self._stopping.wait(), delay)
+                return  # stopped during backoff
+            except asyncio.TimeoutError:
+                pass
+            info.handle = self._factory(info.index)
+            try:
+                info.status = STARTING
+                info.host, info.port = await info.handle.start()
+                await self._await_ready(info)
+            except Exception:
+                await info.handle.kill()
+                info.status = DEAD
+                continue
+            info.restarts += 1
+            if self._c_restarts is not None:
+                self._c_restarts.inc()
+            return
+
+    async def stop(self) -> None:
+        """Stop the health loop and gracefully terminate every replica."""
+        self._stopping.set()
+        for task in list(self._restart_tasks):
+            task.cancel()
+        if self._restart_tasks:
+            await asyncio.gather(*self._restart_tasks,
+                                 return_exceptions=True)
+        await asyncio.gather(
+            *(info.handle.terminate() for info in self.replicas.values()),
+            return_exceptions=True)
+        for info in self.replicas.values():
+            info.status = DEAD
+        self._note_ready()

@@ -306,6 +306,10 @@ class ServingEngine:
         self._running = False
         self._stopping = False
         self._draining = True
+        # Pending parameter swap: (params, done-event, result dict) set by
+        # request_param_swap(), consumed by the run loop at the first
+        # iteration with no slot in flight.
+        self._pending_swap: tuple | None = None
 
     # -- introspection ------------------------------------------------------
     def decode_compile_count(self) -> int:
@@ -380,6 +384,84 @@ class ServingEngine:
         self._draining = drain
         self.scheduler.kick()
 
+    def request_param_swap(self, variables):
+        """Queue an in-place parameter swap (the replica half of the
+        cluster's zero-downtime weight reload).
+
+        ``variables`` is either a full variables dict (``{"params": ...}``,
+        the ``save_weights`` / ``checkpoint.save_weights_file`` layout) or
+        a bare params pytree. Leaf shapes and dtypes must match the
+        serving model exactly — a mismatched tree raises ``ValueError``
+        HERE rather than retracing (or silently corrupting) the compiled
+        decode step later.
+
+        The swap itself runs inside the engine loop at the first
+        iteration with **no slot in flight** (the loop serializes all
+        device work, so there is no race against a decode or prefill in
+        the executor): params are device_put, the prefix cache is flushed
+        (its pooled K/V was computed under the OLD weights), and one
+        decode tick rewarms the step — under an armed auditor that tick
+        PROVES the swap did not retrace. Returns ``(event, result)``:
+        await the event, then check ``result`` for ``"error"``. Under
+        continuous direct load the engine may never go idle — the cluster
+        router drains the replica first, which is what guarantees the
+        swap runs; a standalone server relies on a quiet moment.
+        """
+        if self._pending_swap is not None:
+            # Overwriting would strand the first caller's event forever
+            # (a silent false "busy" after its full timeout) and drop one
+            # weights file without a trace.
+            raise RuntimeError("a parameter swap is already pending")
+        tree = variables
+        if isinstance(tree, dict) and "params" in tree:
+            tree = tree["params"]
+        new_leaves, _ = jax.tree.flatten(tree)
+        cur_leaves, cur_def = jax.tree.flatten(self._params)
+        if len(new_leaves) != len(cur_leaves):
+            raise ValueError(
+                f"reload weights have {len(new_leaves)} leaves; serving "
+                f"model has {len(cur_leaves)}")
+        for i, (a, b) in enumerate(zip(new_leaves, cur_leaves)):
+            a = np.asarray(a) if np.isscalar(a) else a
+            if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+                raise ValueError(
+                    f"reload weight leaf {i} is {a.dtype}{tuple(a.shape)}; "
+                    f"serving model expects {b.dtype}{tuple(b.shape)}")
+        # Re-hang the new leaves on the CURRENT treedef: dict vs FrozenDict
+        # (or attr-ordering) differences between a weights file and the
+        # live tree must not matter as long as the leaves line up.
+        params = jax.tree.unflatten(cur_def, new_leaves)
+        event: asyncio.Event = asyncio.Event()
+        result: dict = {}
+        self._pending_swap = (params, event, result)
+        self.scheduler.kick()  # wake an idle run loop now
+        return event, result
+
+    def cancel_param_swap(self, event: asyncio.Event) -> bool:
+        """Withdraw a pending swap (reload-verb timeout path). True if it
+        was still pending; False if the loop already consumed it."""
+        if self._pending_swap is not None and self._pending_swap[1] is event:
+            self._pending_swap = None
+            return True
+        return False
+
+    def _swap_sync(self, params) -> None:
+        """Executor-thread half of the swap: transfer, flush, rewarm."""
+        params = jax.device_put(params)
+        jax.block_until_ready(params)
+        self._params = params
+        if self.prefix_cache is not None:
+            # Pooled K/V is a pure function of (weights, tokens): stale
+            # weights make every cached block wrong, so the whole pool is
+            # invalidated in one stroke.
+            self.prefix_cache.flush()
+        # Rewarm: one decode tick over the (all-free) batch. Garbage
+        # output, real proof — the compiled decode step runs against the
+        # new params, so an armed auditor raises here if the swap somehow
+        # changed an aval, and the first real request pays no first-touch
+        # latency.
+        self._decode_sync()
+
     def reopen(self) -> None:
         """Re-arm admission after a drain shutdown. The compiled programs
         and slot caches persist, so a bench can run several load phases on
@@ -431,6 +513,30 @@ class ServingEngine:
                     for req in self.scheduler.drain():
                         self._finish_error(
                             req, EngineStopped("engine shut down while queued"))
+                # 3b. Pending parameter swap: runs only when NO slot is
+                # in flight (in-flight requests finish under the weights
+                # they started with; the cluster router guarantees this
+                # by draining the replica first). Before admission, so a
+                # queued request never splices old-weight prefix blocks.
+                if self._pending_swap is not None and self.active_slots == 0:
+                    params, ev, res = self._pending_swap
+                    self._pending_swap = None
+                    with span("param_swap"):
+                        try:
+                            await self._in_executor(
+                                loop, self._swap_sync, params)
+                            res["ok"] = True
+                        except Exception as e:
+                            res["error"] = e
+                        finally:
+                            if not res:
+                                # BaseException (task cancelled mid-
+                                # swap): resolve the waiter before it
+                                # propagates, or the reload verb hangs
+                                # its full timeout.
+                                res["error"] = ServingError(
+                                    "engine died mid-swap")
+                            ev.set()
                 # 4. Admission: prefill queued requests into free slots.
                 # Device work runs in the executor; stream/metrics
                 # bookkeeping stays on the loop thread (asyncio queues and
@@ -557,6 +663,14 @@ class ServingEngine:
                     self._slot_state[i] = None
             for req in self.scheduler.drain():
                 self._finish_error(req, err)
+            # A pending param swap must resolve too, or the reload verb
+            # blocks its full timeout and reports "busy" for an engine
+            # that is in fact dead.
+            if self._pending_swap is not None:
+                _, ev, res = self._pending_swap
+                self._pending_swap = None
+                res["error"] = err
+                ev.set()
             self._stopping = True
             raise
         finally:

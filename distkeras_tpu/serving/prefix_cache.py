@@ -184,6 +184,7 @@ class PrefixCache:
         self.lookups = self.hit_requests = 0
         self.hit_tokens = self.miss_tokens = 0
         self.inserted_blocks = self.evicted_blocks = 0
+        self.flushes = 0
         self._metrics = None
         if registry is not None:
             self._metrics = {
@@ -235,7 +236,26 @@ class PrefixCache:
             "hit_rate": (self.hit_tokens / total) if total else 0.0,
             "inserted_blocks": self.inserted_blocks,
             "evicted_blocks": self.evicted_blocks,
+            "flushes": self.flushes,
         }
+
+    def flush(self) -> None:
+        """Invalidate every cached block at once (weight reload: pooled
+        K/V is a function of the weights, so a param swap makes all of it
+        wrong). Host bookkeeping only — the device pools stay allocated
+        and their rows are simply free to overwrite; cumulative hit/miss
+        counters keep counting across the flush. Must be called with no
+        admission in flight (no pinned matches) — the engine's swap path
+        guarantees that by running with zero active slots; any match
+        object still held afterwards releases onto orphaned nodes,
+        harmlessly."""
+        self._root = _Node(-1, None, None)
+        self._by_slot.clear()
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._lru = []
+        self.flushes += 1
+        if self._metrics is not None:
+            self._note_occupancy()
 
     # -- trie walk ----------------------------------------------------------
     def _blocks(self, tokens, n_blocks: int):

@@ -114,7 +114,7 @@ class ServingServer:
                 try:
                     spec = json.loads(line)
                     if isinstance(spec, dict) and "cmd" in spec:
-                        await self._send(writer, self._control(spec))
+                        await self._send(writer, await self._control(spec))
                         continue
                     req = self.engine.submit(
                         spec["prompt"], spec["max_new_tokens"],
@@ -155,9 +155,11 @@ class ServingServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
-    def _control(self, spec: dict) -> dict:
+    async def _control(self, spec: dict) -> dict:
         """Handle a control verb; returns the single reply object."""
         cmd = spec.get("cmd")
+        if cmd == "reload":
+            return await self._reload(spec)
         if cmd == "metricsz":
             registry = self.engine.metrics.registry
             if spec.get("format") == "prometheus":
@@ -180,6 +182,58 @@ class ServingServer:
                 health["recompile_audit"] = engine.auditor.report()
             return {"healthz": health}
         return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
+
+    async def _reload(self, spec: dict) -> dict:
+        """``{"cmd": "reload", "weights": path}``: hot-swap the engine's
+        parameters from a serialized-pytree weights file (the replica
+        half of the cluster's rolling reload — see
+        :meth:`ServingEngine.request_param_swap`).
+
+        The swap runs inside the engine loop once no slot is in flight;
+        ``timeout`` (default 60 s) bounds how long this verb waits for
+        that quiet moment before answering ``code="busy"`` — a replica
+        behind a draining router reaches it almost immediately, a
+        standalone server under continuous load may not."""
+        path = spec.get("weights")
+        if not path:
+            return {"error": "reload requires a 'weights' path",
+                    "code": "bad_request"}
+        try:
+            timeout = float(spec.get("timeout", 60.0))
+        except (TypeError, ValueError):
+            return {"error": f"bad timeout {spec.get('timeout')!r}",
+                    "code": "bad_request"}
+        loop = asyncio.get_running_loop()
+        try:
+            from distkeras_tpu.checkpoint import load_weights_file
+
+            variables = await loop.run_in_executor(
+                None, load_weights_file, path)
+            event, result = self.engine.request_param_swap(variables)
+        except RuntimeError as e:
+            # Another reload's swap is still pending.
+            return {"error": str(e), "code": "busy"}
+        except Exception as e:
+            # Anything here is bad INPUT (missing path, torn/garbage
+            # file, mismatched tree) — a typed reply to this one client,
+            # never a dead handler loop.
+            return {"error": f"reload failed: {e!r}", "code": "bad_request"}
+        try:
+            await asyncio.wait_for(event.wait(), timeout)
+        except asyncio.TimeoutError:
+            if self.engine.cancel_param_swap(event):
+                return {"error": f"replica busy: swap did not run within "
+                                 f"{timeout}s", "code": "busy"}
+            # Withdrawal lost the race: the engine loop already took the
+            # swap, so it WILL resolve — report its true outcome rather
+            # than a "busy" that leaves the operator believing the old
+            # weights are still live. (The engine sets the event even on
+            # death mid-swap, so this wait is bounded.)
+            await event.wait()
+        if "error" in result:
+            return {"error": f"reload failed: {result['error']!r}",
+                    "code": "error"}
+        return {"reload": {"weights": path, "ok": True}}
 
     @staticmethod
     async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:

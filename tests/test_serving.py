@@ -628,6 +628,110 @@ def test_tcp_server_metricsz_and_healthz_verbs(lm, rng):
     assert len(toks) == 2
 
 
+def test_server_stop_drain_completes_inflight_rejects_new(lm, rng):
+    """THE contract the cluster's rolling reload stands on: during
+    ``server.stop(drain=True)`` a mid-stream request runs to completion
+    (its tokens keep flowing and match generate()) while new admissions
+    on already-open connections are rejected with the typed ``stopped``
+    error."""
+    model, variables = lm
+    p = _prompt(rng, 4)
+
+    async def go():
+        engine = ServingEngine(model, variables, slots=1)
+        server = ServingServer(engine, port=0)
+        await server.start()
+        streamer = ServingClient("127.0.0.1", server.port)
+        late = ServingClient("127.0.0.1", server.port)
+        await streamer.connect()
+        await late.connect()  # connected BEFORE the listener closes
+        stream = streamer.stream(p, 12)
+        first = await stream.__anext__()  # admitted, mid-stream
+        stop_task = asyncio.create_task(server.stop(drain=True))
+        await asyncio.sleep(0)  # let stop() close admission
+        # A new request over the still-open connection is shed with the
+        # typed error, not a hang and not a dropped connection.
+        with pytest.raises(EngineStopped):
+            await late.generate(_prompt(rng, 3), 2)
+        # The in-flight stream drains to its full length.
+        toks = [first] + [t async for t in stream]
+        await streamer.aclose()
+        await late.aclose()
+        await stop_task
+        return toks
+
+    toks = asyncio.run(go())
+    assert toks == _want(lm, p, 12)
+
+
+def test_client_control_verbs_reconnect_with_backoff(lm, rng):
+    """metricsz/healthz survive a server bounce: the client drops its
+    dead connection and redials with capped backoff (RetryingClient's
+    pattern) instead of surfacing a raw ConnectionResetError; the budget
+    exhausts into a typed ConnectionError when nobody is listening."""
+    model, variables = lm
+
+    async def go():
+        engine = ServingEngine(model, variables, slots=1)
+        server = ServingServer(engine, port=0)
+        await server.start()
+        port = server.port
+        client = ServingClient("127.0.0.1", port, base_delay_s=0.01)
+        h1 = await client.healthz()  # pins a live connection
+        await server.stop(drain=True)
+        # Same-port restart — a replica bounce as a monitor would see it.
+        server2 = ServingServer(
+            ServingEngine(model, variables, slots=1), port=port)
+        await server2.start()
+        h2 = await client.healthz()  # stale conn -> reconnect -> answer
+        await server2.stop(drain=True)
+        # stop() only closes the LISTENER; drop our live connection so
+        # the next verb must redial a port nobody listens on.
+        await client.aclose()
+        with pytest.raises(ConnectionError, match="healthz"):
+            await client.healthz()  # budget exhausts into a typed error
+        return h1, h2
+
+    h1, h2 = asyncio.run(go())
+    assert h1["slots"] == 1 and h2["slots"] == 1
+
+
+def test_server_reload_verb_swaps_weights(lm, rng, tmp_path):
+    """The replica-side half of the rolling reload: the ``reload`` verb
+    hot-swaps params from a weights file on a live server — outputs
+    before match the old weights, after match the new, and bad input
+    fails typed without disturbing serving."""
+    from distkeras_tpu.checkpoint import save_weights_file
+    from distkeras_tpu.serving.client import ServerError
+
+    model, variables = lm
+    new_vars = model.init(7)
+    path = str(tmp_path / "w.bin")
+    save_weights_file(path, new_vars)
+    p = _prompt(rng, 5)
+
+    async def go():
+        engine = ServingEngine(model, variables, slots=2)
+        server = ServingServer(engine, port=0)
+        await server.start()
+        async with ServingClient("127.0.0.1", server.port) as c:
+            before = (await c.generate(p, 4))["tokens"]
+            rep = await c.reload(path)
+            after = (await c.generate(p, 4))["tokens"]
+            with pytest.raises(ServerError):
+                await c.reload(str(tmp_path / "missing.bin"))
+            still = (await c.generate(p, 4))["tokens"]
+        await server.stop(drain=True)
+        return before, rep, after, still
+
+    before, rep, after, still = asyncio.run(go())
+    assert rep["ok"]
+    assert before == _want(lm, p, 4)
+    want_new = generate(model, new_vars, np.asarray([p], np.int32), 4,
+                        greedy=True)[0].tolist()
+    assert after == still == want_new
+
+
 def test_tcp_server_rejects_bad_and_overflow_requests(lm, rng):
     model, variables = lm
 
