@@ -76,7 +76,7 @@ def _model(args):
     return model, model.init(0)
 
 
-def _make_engine(args, model, variables, metrics=None):
+def _make_engine(args, model, variables, metrics=None, trace_store=None):
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
     return ServingEngine(
@@ -84,20 +84,24 @@ def _make_engine(args, model, variables, metrics=None):
         metrics=metrics or ServingMetrics(),
         prefill_chunk=args.prefill_chunk,
         prefix_cache_mb=args.prefix_cache_mb,
-        prefix_block_tokens=args.prefix_block)
+        prefix_block_tokens=args.prefix_block,
+        trace_store=trace_store,
+        slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
 
 
 def _build(args):
     from distkeras_tpu.serving import ServingMetrics
-    from distkeras_tpu.telemetry import MetricsRegistry
+    from distkeras_tpu.telemetry import MetricsRegistry, TraceStore
     from distkeras_tpu.tracing import MetricStream
 
     model, variables = _model(args)
     registry = MetricsRegistry()
     stream = (MetricStream.to_jsonl(args.metrics_out, registry=registry)
               if args.metrics_out else None)
+    trace_store = TraceStore(4096) if args.request_trace_out else None
     engine = _make_engine(args, model, variables,
-                          metrics=ServingMetrics(stream, registry=registry))
+                          metrics=ServingMetrics(stream, registry=registry),
+                          trace_store=trace_store)
     return model, variables, engine, stream
 
 
@@ -447,6 +451,16 @@ def main():
     ap.add_argument("--trace-out", default=None,
                     help="enable spans; export the run as Chrome-trace "
                          "JSON (loads in Perfetto) at this path")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="arm the request-latency SLO: the report carries "
+                         "serving_slo_violations_total so a load sweep "
+                         "shows where the latency budget breaks")
+    ap.add_argument("--request-trace-out", default=None,
+                    help="record per-request timelines and export them as "
+                         "Chrome-trace JSON, ONE LANE PER REQUEST — the "
+                         "per-request view (queue wait -> prefill chunks "
+                         "-> decode) --trace-out's per-thread lanes "
+                         "cannot show (single-engine mode)")
     ap.add_argument("--skip-parity", action="store_true",
                     help="skip the generate() cross-check (pure load run)")
     args = ap.parse_args()
@@ -524,6 +538,10 @@ def main():
             # per-mode percentiles must cover THIS load shape only, and
             # tokens_per_sec must divide by this phase's clock.
             engine.metrics = ServingMetrics(stream)
+            if engine.slo_s is not None:
+                # Re-arm the SLO gauge on the replacement registry, or
+                # the phase summary would hide the violation counter.
+                engine.metrics.set_slo(engine.slo_s)
             results, rejects, elapsed = await run_mode(mode, phase)
             all_results.extend(results)
             done_tokens = sum(len(t) for _, t in results)
@@ -537,7 +555,7 @@ def main():
                    for k, v in summary.items()
                    if k.startswith(("ttft", "inter_token", "queue", "slot",
                                     "tokens_per_sec", "requests",
-                                    "prefill", "prefix"))},
+                                    "prefill", "prefix", "slo"))},
             }
             engine.reopen()
         return all_results
@@ -563,6 +581,10 @@ def main():
         # when the admit/prefill/decode timeline is worth reading.
         if tracer is not None:
             report["trace_out"] = tracer.export_chrome_trace(args.trace_out)
+        if engine.trace_store is not None and args.request_trace_out:
+            report["request_trace_out"] = (
+                engine.trace_store.export_chrome_trace(
+                    args.request_trace_out))
         if stream is not None:
             stream.close()
     if args.record_history:

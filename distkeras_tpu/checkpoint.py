@@ -109,7 +109,12 @@ class CheckpointManager:
             items["ps"] = ocp.args.StandardSave(
                 {
                     "center": jax.device_get(ps_center),
-                    "num_updates": np.int64(ps_num_updates or 0),
+                    # 0-d ndarray, not np.int64: orbax >= 0.7's standard
+                    # handler rejects bare numpy SCALARS ("Unsupported
+                    # type") while ndarrays round-trip fine, and int()
+                    # on the restored value works for both layouts.
+                    "num_updates": np.asarray(ps_num_updates or 0,
+                                              np.int64),
                 }
             )
         if meta:

@@ -421,10 +421,16 @@ def bert_base_mlm(seq_len: int = 128, vocab_size: int = 30522) -> Model:
     return _make(BertConfig(vocab_size=vocab_size), seq_len, "bert_base_mlm")
 
 
-def bert_tiny_mlm(seq_len: int = 64, vocab_size: int = 1024) -> Model:
+def bert_tiny_mlm(seq_len: int = 64, vocab_size: int = 1024,
+                  dropout_rate: float = 0.1) -> Model:
+    """``dropout_rate=0.0`` gives a fully deterministic forward — what
+    cross-layout parity checks need: dropout masks are the one train-time
+    computation whose random bits legitimately differ between sharded and
+    unsharded lowerings under the legacy (non-partitionable) threefry."""
     cfg = BertConfig(
         vocab_size=vocab_size, hidden_size=128, num_layers=2, num_heads=4,
         mlp_dim=512, max_seq_len=max(seq_len, 64),
+        dropout_rate=dropout_rate,
     )
     return _make(cfg, seq_len, "bert_tiny_mlm")
 

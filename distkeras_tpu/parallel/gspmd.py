@@ -101,6 +101,20 @@ def sharded_train_state(
     rng = init_rng
     boxed_init = getattr(model, "boxed_init", None)
 
+    # The init COMPUTATION runs without output-sharding constraints, and
+    # the result is then device_put into the target layout. Jitting the
+    # init with TP/FSDP out_shardings lets GSPMD propagate the sharding
+    # INTO the (legacy, non-partitionable) threefry ops, which CHANGES
+    # the generated values — a tp-sharded kernel would initialize
+    # differently from the same seed's unsharded init, silently breaking
+    # the contract above (observed on jax 0.4.37: bert-TP kernels off by
+    # ~0.27 absolute). jax_threefry_partitionable=True would make the
+    # sharded lowering value-invariant but changes the stream relative
+    # to today's eager inits, breaking every same-seed baseline — so the
+    # fix is to keep the random bits unsharded and reshard the DATA. The
+    # cost is one full materialization at init time before the
+    # device_put redistributes; at the scale where even that overflows a
+    # device, flip the global partitionable flag instead and re-seed.
     if boxed_init is not None:
         abstract = jax.eval_shape(boxed_init, rng)
         var_shardings = infer_variable_shardings(mesh, abstract)
@@ -110,13 +124,13 @@ def sharded_train_state(
 
             return nn.meta.unbox(boxed_init(r))
 
-        variables = jax.jit(init_fn, out_shardings=var_shardings)(rng)
+        variables = jax.device_put(jax.jit(init_fn)(rng), var_shardings)
     elif "fsdp" in mesh.axis_names and mesh.shape["fsdp"] > 1:
         abstract = jax.eval_shape(model.init, rng)
         var_shardings = jax.tree.map(
             lambda a: fsdp_sharding_for(mesh, a.shape, a.dtype), abstract
         )
-        variables = jax.jit(model.init, out_shardings=var_shardings)(rng)
+        variables = jax.device_put(jax.jit(model.init)(rng), var_shardings)
     else:
         # Un-annotated model: replicate everything (pure DP).
         replicated = NamedSharding(mesh, P())

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -127,6 +128,38 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "exit); 'arm' additionally fails loudly if the "
                          "decode step ever recompiles after its first "
                          "iteration")
+    ap.add_argument("--request-trace", type=int, default=None, metavar="N",
+                    help="> 0: keep the last N per-request timeline "
+                         "records queryable via the tracez verb / "
+                         "`run.py debugz --trace ID`. Default: off for "
+                         "serve, 512 for cluster mode; 0 disables "
+                         "explicitly")
+    ap.add_argument("--request-trace-out", default=None,
+                    help="write the request-timeline store as Chrome-"
+                         "trace JSON (one lane per request) on shutdown; "
+                         "implies --request-trace")
+    ap.add_argument("--flight-recorder", type=int, default=None,
+                    metavar="N",
+                    help="> 0: arm the flight recorder with an N-event "
+                         "black box of recent engine state + request "
+                         "timelines. Default: off for serve (unless "
+                         "--flight-dump is given), 256 for cluster "
+                         "mode; 0 disables explicitly")
+    ap.add_argument("--flight-dump", default=None,
+                    help="where the flight recorder dumps on crash/exit "
+                         "(the replica's 'last words' file the cluster "
+                         "supervisor collects); implies --flight-recorder")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="request-latency SLO in ms: slower requests bump "
+                         "serving_slo_violations_total and pin their full "
+                         "timeline as a flight-recorder slow exemplar")
+    ap.add_argument("--flight-dir", default=None,
+                    help="cluster mode: directory for per-replica flight-"
+                         "recorder dumps (default: a fresh temp dir, "
+                         "printed in the banner); each replica child gets "
+                         "--flight-dump <dir>/flight-r<i>.json and the "
+                         "supervisor collects a dead replica's file into "
+                         "its restart log")
     args = ap.parse_args(argv)
     if args.replicas > 1:
         return cluster_main(args)
@@ -158,6 +191,22 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         registry=registry)
     auditor = (RecompileAuditor(registry=registry)
                if args.audit_recompiles else None)
+    from distkeras_tpu.telemetry import FlightRecorder, TraceStore
+
+    # None = unset (flag defaults apply); an EXPLICIT 0 always disables.
+    trace_cap = args.request_trace
+    if trace_cap is None and args.request_trace_out:
+        trace_cap = 512
+    trace_store = TraceStore(trace_cap) if trace_cap else None
+    recorder_cap = args.flight_recorder
+    if recorder_cap is None and args.flight_dump:
+        recorder_cap = 256
+    recorder = None
+    if recorder_cap:
+        recorder = FlightRecorder(
+            capacity=recorder_cap,
+            dump_path=args.flight_dump,
+            source=f"serve:{args.model}:pid{os.getpid()}")
     engine = ServingEngine(
         model, variables, slots=args.slots, max_queue=args.max_queue,
         top_k=args.top_k, metrics=metrics, seed=args.seed,
@@ -165,7 +214,9 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         arm_auditor_after_warmup=args.audit_recompiles == "arm",
         prefill_chunk=args.prefill_chunk,
         prefix_cache_mb=args.prefix_cache_mb,
-        prefix_block_tokens=args.prefix_block)
+        prefix_block_tokens=args.prefix_block,
+        trace_store=trace_store, flight_recorder=recorder,
+        slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
     server = ServingServer(engine, host=args.host, port=args.port)
 
     async def go():
@@ -207,6 +258,18 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         if tracer is not None:
             tracer.export_chrome_trace(args.trace_out)
             print(json.dumps({"trace_out": args.trace_out}), flush=True)
+        # Graceful-exit black box: the crash path already dumped inside
+        # the engine loop; this covers SIGTERM drains so the file exists
+        # either way.
+        if recorder is not None and recorder.dump_path:
+            try:
+                recorder.dump()
+            except OSError:
+                pass
+        if trace_store is not None and args.request_trace_out:
+            trace_store.export_chrome_trace(args.request_trace_out)
+            print(json.dumps(
+                {"request_trace_out": args.request_trace_out}), flush=True)
     return 0
 
 
@@ -218,9 +281,22 @@ def cluster_main(args) -> int:
     downtime. See docs/operations.md for the runbook."""
     import asyncio
     import signal
+    import tempfile
 
     from distkeras_tpu.serving.cluster import ProcessReplica, ServingCluster
     from distkeras_tpu.telemetry import MetricsRegistry
+
+    # Observability defaults are ON in cluster mode: per-request tracing
+    # and flight recording cost per-REQUEST bookkeeping only (the
+    # per-token path is untouched), and a fleet without them cannot
+    # answer "where did this request go" — the reason the cluster
+    # subcommand exists is operating at that scale.
+    flight_dir = args.flight_dir or tempfile.mkdtemp(
+        prefix="distkeras-flight-")
+    os.makedirs(flight_dir, exist_ok=True)
+
+    def flight_dump(i: int) -> str:
+        return os.path.join(flight_dir, f"flight-r{i}.json")
 
     def replica_args(i: int) -> list[str]:
         extra = [
@@ -230,6 +306,11 @@ def cluster_main(args) -> int:
             "--seed", str(args.seed),
             "--prefix-cache-mb", str(args.prefix_cache_mb),
             "--prefix-block", str(args.prefix_block),
+            "--request-trace",
+            str(512 if args.request_trace is None else args.request_trace),
+            "--flight-recorder",
+            str(256 if args.flight_recorder is None else args.flight_recorder),
+            "--flight-dump", flight_dump(i),
         ]
         if args.weights:
             extra += ["--weights", args.weights]
@@ -239,6 +320,8 @@ def cluster_main(args) -> int:
             extra += ["--prefill-chunk", str(args.prefill_chunk)]
         if args.audit_recompiles:
             extra += ["--audit-recompiles", args.audit_recompiles]
+        if args.slo_ms is not None:
+            extra += ["--slo-ms", str(args.slo_ms)]
         if args.metrics_out:
             extra += ["--metrics-out", f"{args.metrics_out}.r{i}"]
         if args.trace_out:
@@ -262,11 +345,14 @@ def cluster_main(args) -> int:
     registry = MetricsRegistry()
     cluster = ServingCluster(
         lambda i: ProcessReplica(replica_args(i), host=args.host,
-                                 env=replica_env(i)),
+                                 env=replica_env(i),
+                                 last_words_path=flight_dump(i)),
         args.replicas, host=args.host, port=args.port, registry=registry,
         router_kwargs={
             "affinity_tokens": args.prefix_block,
             "affinity_slack": args.affinity_slack,
+            "trace_capacity":
+                512 if args.request_trace is None else args.request_trace,
         })
 
     async def go():
@@ -276,6 +362,7 @@ def cluster_main(args) -> int:
             "replicas": {rid: {"host": info.host, "port": info.port}
                          for rid, info in cluster.replicas.items()},
             "slots": args.slots, "prefix_cache_mb": args.prefix_cache_mb,
+            "flight_dir": flight_dir,
         }), flush=True)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -294,6 +381,7 @@ def cluster_main(args) -> int:
         print(json.dumps({
             "restarts": {rid: info.restarts
                          for rid, info in cluster.replicas.items()},
+            "restart_log": cluster.supervisor.restart_log_entries(),
             "router": registry.snapshot(),
         }), flush=True)
 
@@ -308,6 +396,57 @@ def cluster_main(args) -> int:
     return 0
 
 
+def debugz_main(argv=None) -> int:
+    """``debugz`` subcommand: fetch and pretty-print a live server's (or
+    router's) introspection page — slot table, queue ages, prefix-cache
+    occupancy, replica table with restart log — or, with ``--trace ID``,
+    the merged cross-process timeline of one request. ``--json`` prints
+    the raw payload for scripts."""
+    import asyncio
+
+    ap = argparse.ArgumentParser(prog="distkeras_tpu.run debugz")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8500,
+                    help="a serving server's port, or a cluster router's "
+                         "front port (fleet-aggregated page)")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="fetch ONE request's (merged) timeline instead "
+                         "of the debugz page")
+    ap.add_argument("--recent", type=int, default=None, metavar="N",
+                    help="list the N most recent request timelines")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON payload instead of the pretty page")
+    args = ap.parse_args(argv)
+
+    from distkeras_tpu.serving import ServingClient, ServingError
+    from distkeras_tpu.serving.debugz import format_debugz, format_tracez
+
+    async def go():
+        async with ServingClient(args.host, args.port,
+                                 max_retries=0) as client:
+            if args.trace is not None:
+                return "tracez", await client.tracez(args.trace)
+            if args.recent is not None:
+                return "tracez", await client.tracez(n=args.recent)
+            return "debugz", await client.debugz()
+
+    try:
+        kind, payload = asyncio.run(go())
+    except (OSError, ConnectionError) as e:
+        print(f"debugz: cannot reach {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 1
+    except ServingError as e:
+        print(f"debugz: server refused: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(payload, indent=1))
+    else:
+        print(format_tracez(payload) if kind == "tracez"
+              else format_debugz(payload))
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -315,6 +454,8 @@ def main(argv=None) -> int:
         return serve_main(argv[1:])
     if argv and argv[0] == "cluster":
         return serve_main(argv[1:], prog="cluster", default_replicas=2)
+    if argv and argv[0] == "debugz":
+        return debugz_main(argv[1:])
     ap = argparse.ArgumentParser(prog="distkeras_tpu.run")
     ap.add_argument("--config", required=True, help="TrainerConfig JSON file")
     ap.add_argument("--data", required=True, help=".npz (features/label) or CSV")

@@ -13,6 +13,8 @@ from distkeras_tpu.serving.scheduler import (
     RequestTimeout,
     ServingError,
 )
+from distkeras_tpu.telemetry.request_trace import (new_trace_id,
+                                                   sanitize_trace_id)
 
 __all__ = ["ServingClient", "ServerError"]
 
@@ -31,7 +33,13 @@ class ServerError(ServingError):
 
 def _raise_for(rec: dict) -> None:
     cls = _CODE_TO_ERROR.get(rec.get("code"), ServerError)
-    raise cls(rec.get("error", "server error"))
+    err = cls(rec.get("error", "server error"))
+    # The wire code and trace id ride on the exception: a caller logging
+    # a replica_lost failure can hand the id straight to `run.py debugz`
+    # / the tracez verb without having kept the request spec around.
+    err.code = rec.get("code", cls.code)
+    err.trace_id = rec.get("trace_id")
+    raise err
 
 
 class ServingClient:
@@ -58,6 +66,10 @@ class ServingClient:
         self.max_retries = int(max_retries)
         self.base_delay_s = float(base_delay_s)
         self.max_delay_s = float(max_delay_s)
+        # Trace id of the most recent stream() (error handlers and
+        # monitoring wrappers read it unconditionally — it must exist
+        # before the first request too).
+        self.last_trace_id: str | None = None
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
 
@@ -93,17 +105,28 @@ class ServingClient:
         temperature: float = 0.0,
         priority: int = 0,
         timeout: float | None = None,
+        trace_id: str | None = None,
     ) -> AsyncIterator[int]:
         """Yield token ids as the server streams them; raises the typed
-        :class:`ServingError` subclass matching the server's error code."""
+        :class:`ServingError` subclass matching the server's error code.
+
+        ``trace_id`` is the request's distributed-trace identity: pass
+        your own to correlate with caller-side logs, or let the client
+        mint one (kept on :attr:`last_trace_id`). The same id tags every
+        hop's spans and timeline records, rides back on the ``done`` /
+        error line, and keys the ``tracez`` verb's merged trace."""
         if self._writer is None:
             await self.connect()
+        # Sanitize here too so last_trace_id matches the id the server
+        # actually records (Request/router sanitize on their side).
+        self.last_trace_id = sanitize_trace_id(trace_id) or new_trace_id()
         spec = {
             "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature),
             "priority": int(priority),
             "timeout": timeout,
+            "trace_id": self.last_trace_id,
         }
         self._writer.write((json.dumps(spec) + "\n").encode())
         await self._writer.drain()
@@ -189,6 +212,22 @@ class ServingClient:
         Reconnects with backoff on a dropped connection (idempotent)."""
         return (await self._control({"cmd": "healthz"},
                                     retry=True))["healthz"]
+
+    async def debugz(self) -> dict:
+        """Live introspection page: slot table, queue ages, prefix-cache
+        trie occupancy (fleet-aggregated when pointed at a router).
+        Reconnects with backoff on a dropped connection (idempotent)."""
+        return (await self._control({"cmd": "debugz"},
+                                    retry=True))["debugz"]
+
+    async def tracez(self, trace_id: str | None = None, n: int = 20):
+        """One request's timeline by trace id (a MERGED cross-process
+        trace when pointed at a router), or the most recent ``n`` records
+        with no id. Reconnects with backoff (idempotent)."""
+        spec: dict = {"cmd": "tracez", "n": int(n)}
+        if trace_id is not None:
+            spec["trace_id"] = str(trace_id)
+        return (await self._control(spec, retry=True))["tracez"]
 
     async def reload(self, weights: str, timeout: float = 60.0) -> dict:
         """Hot-swap weights: a rolling reload when pointed at a cluster

@@ -128,7 +128,13 @@ class ReplicaHandle:
     ``start`` returns the replica's ``(host, port)`` once it is
     *listening* (healthz readiness is the supervisor's job); ``alive``
     must be a cheap sync poll; ``kill`` is abrupt (crash semantics),
-    ``terminate`` is graceful (drain in-flight, then exit)."""
+    ``terminate`` is graceful (drain in-flight, then exit).
+
+    ``last_words_path`` (optional attribute/property): where this
+    replica's flight recorder dumps on crash — the supervisor collects
+    the file into its restart log when the replica dies."""
+
+    last_words_path: str | None = None
 
     async def start(self) -> tuple[str, int]:
         raise NotImplementedError
@@ -163,6 +169,14 @@ class LocalReplica(ReplicaHandle):
         self.server = ServingServer(self.engine, host=self.host, port=0)
         await self.server.start()
         return self.host, self.server.port
+
+    @property
+    def last_words_path(self) -> str | None:
+        """The in-process engine's flight-recorder dump path (crash
+        semantics here cancel the engine task, whose failure path writes
+        the dump before kill() returns — so the supervisor finds it)."""
+        recorder = getattr(self.engine, "flight_recorder", None)
+        return recorder.dump_path if recorder is not None else None
 
     @property
     def alive(self) -> bool:
@@ -205,10 +219,15 @@ class ProcessReplica(ReplicaHandle):
 
     def __init__(self, extra_args: list[str], host: str = "127.0.0.1",
                  start_timeout_s: float = 120.0,
-                 env: dict[str, str] | None = None):
+                 env: dict[str, str] | None = None,
+                 last_words_path: str | None = None):
         self.extra_args = list(extra_args)
         self.host = host
         self.start_timeout_s = float(start_timeout_s)
+        # Where this child's `serve --flight-dump` writes on crash; the
+        # supervisor reads it into the restart log. A SIGKILL'd child
+        # cannot write one — the supervisor records that, too.
+        self.last_words_path = last_words_path
         # Extra environment merged over the parent's — the device-
         # partitioning hook: N replicas on one accelerator host must not
         # all claim every chip (e.g. CUDA_VISIBLE_DEVICES / TPU chip

@@ -53,6 +53,13 @@ from distkeras_tpu.serving.cluster.replicas import (
 )
 from distkeras_tpu.serving.cluster.supervisor import ReplicaSupervisor
 from distkeras_tpu.telemetry import span
+from distkeras_tpu.telemetry.request_trace import (
+    TimelineRecord,
+    TraceStore,
+    merge_trace,
+    new_trace_id,
+    sanitize_trace_id,
+)
 
 __all__ = ["Router", "ServingCluster"]
 
@@ -87,6 +94,11 @@ class Router:
     ``max_retries``: re-dispatch budget for zero-streamed requests.
     ``pick_wait_s``: how long a dispatch waits for ANY replica to be
     READY (rolling restarts) before failing with ``unavailable``.
+    ``trace_capacity``: bound of the router's per-request timeline store
+    (dispatch/retry/terminal events per routed request, merged with the
+    replicas' engine records by the ``tracez`` verb); 0 disables routing
+    timelines. Default ON: the cost is a handful of per-REQUEST event
+    appends — the per-token relay path records nothing.
     """
 
     def __init__(
@@ -102,6 +114,7 @@ class Router:
         pool_size: int = 8,
         connect_timeout_s: float = 5.0,
         registry=None,
+        trace_capacity: int = 512,
     ):
         self.supervisor = supervisor
         self.host = host
@@ -112,6 +125,8 @@ class Router:
         self.pick_wait_s = float(pick_wait_s)
         self.pool_size = int(pool_size)
         self.connect_timeout_s = float(connect_timeout_s)
+        self.trace_store = (TraceStore(trace_capacity)
+                            if trace_capacity else None)
         self._server: asyncio.AbstractServer | None = None
         # Idle backend connections, keyed by (rid, port): a restarted
         # replica binds a fresh port, so its stale pool is simply never
@@ -311,48 +326,96 @@ class Router:
 
     async def _dispatch(self, spec: dict,
                         client: asyncio.StreamWriter) -> None:
-        """Route one generation request, retrying while idempotent."""
+        """Route one generation request, retrying while idempotent.
+
+        Trace context: the client's ``trace_id`` (or a router-minted one
+        for bare clients) is forced into the forwarded spec, so the
+        replica's engine tags its timeline with the same id; the router
+        records its OWN timeline — every dispatch, retry, reject, and
+        the terminal outcome — under that id, which is what lets the
+        ``tracez`` verb show a retried request's two replica hops as one
+        trace."""
         prompt = spec.get("prompt") or []
+        trace_id = sanitize_trace_id(spec.get("trace_id")) or new_trace_id()
+        spec["trace_id"] = trace_id
+        trace = None
+        if self.trace_store is not None:
+            trace = TimelineRecord(trace_id, "router", "router")
+            trace.event("request", prompt_tokens=len(prompt)
+                        if isinstance(prompt, (list, tuple)) else None)
         if self._c_requests is not None:
             self._c_requests.inc()
         attempts = 0
+        hops: list[str] = []
         exclude: set[str] = set()
-        while True:
-            info = await self._pick_wait(prompt, exclude)
-            if info is None:
-                if self._c_unavailable is not None:
-                    self._c_unavailable.inc()
+        try:
+            while True:
+                info = await self._pick_wait(prompt, exclude)
+                if info is None:
+                    if self._c_unavailable is not None:
+                        self._c_unavailable.inc()
+                    if trace is not None:
+                        trace.event("unavailable")
+                        trace.data["status"] = "unavailable"
+                    await self._send_client(client, {
+                        "error": "no serving replica available",
+                        "code": "unavailable", "trace_id": trace_id})
+                    return
+                hops.append(info.rid)
+                if trace is not None:
+                    trace.event("dispatch", replica=info.rid,
+                                attempt=attempts,
+                                outstanding=info.outstanding)
+                outcome, streamed, rec = await self._relay(
+                    info, spec, client)
+                if outcome == "terminal":
+                    if trace is not None:
+                        trace.event("terminal", replica=info.rid,
+                                    streamed=streamed)
+                        trace.data["status"] = (
+                            "ok" if rec and rec.get("done")
+                            else (rec or {}).get("code", "error"))
+                    return
+                # Backend failed. Retry only while provably idempotent.
+                retryable = (streamed == 0 and attempts < self.max_retries)
+                if outcome == "lost":
+                    self.supervisor.note_failure(info.rid)
+                if trace is not None:
+                    trace.event("backend_lost" if outcome == "lost"
+                                else "replica_reject",
+                                replica=info.rid, streamed=streamed,
+                                code=(rec or {}).get("code"))
+                if retryable:
+                    attempts += 1
+                    exclude.add(info.rid)
+                    if self._c_retries is not None:
+                        self._c_retries.inc()
+                    if trace is not None:
+                        trace.event("retry", attempt=attempts)
+                    continue
+                if outcome == "reject":
+                    # Retry budget spent on typed replica-side rejects
+                    # (e.g. every replica at queue_full): forward the
+                    # LAST replica's own error — it is the truthful
+                    # backpressure signal, not a lost stream.
+                    if trace is not None:
+                        trace.data["status"] = rec.get("code", "error")
+                    await self._send_client(client, rec)
+                    return
+                if self._c_lost is not None:
+                    self._c_lost.inc()
+                if trace is not None:
+                    trace.data["status"] = "replica_lost"
                 await self._send_client(client, {
-                    "error": "no serving replica available",
-                    "code": "unavailable"})
+                    "error": f"replica {info.rid} lost after {streamed} "
+                             f"streamed tokens",
+                    "code": "replica_lost", "trace_id": trace_id})
                 return
-            outcome, streamed, rec = await self._relay(info, spec, client)
-            if outcome == "terminal":
-                return
-            # Backend failed. Retry only while provably idempotent.
-            retryable = (streamed == 0 and attempts < self.max_retries)
-            if outcome == "lost":
-                self.supervisor.note_failure(info.rid)
-            if retryable:
-                attempts += 1
-                exclude.add(info.rid)
-                if self._c_retries is not None:
-                    self._c_retries.inc()
-                continue
-            if outcome == "reject":
-                # Retry budget spent on typed replica-side rejects (e.g.
-                # every replica at queue_full): forward the LAST replica's
-                # own error — it is the truthful backpressure signal, not
-                # a lost stream.
-                await self._send_client(client, rec)
-                return
-            if self._c_lost is not None:
-                self._c_lost.inc()
-            await self._send_client(client, {
-                "error": f"replica {info.rid} lost after {streamed} "
-                         f"streamed tokens",
-                "code": "replica_lost"})
-            return
+        finally:
+            if trace is not None:
+                trace.data["hops"] = hops
+                trace.data["retries"] = attempts
+                self.trace_store.put(trace)
 
     async def _relay(self, info: ReplicaInfo, spec: dict,
                      client: asyncio.StreamWriter):
@@ -375,6 +438,7 @@ class Router:
             healthy = False
             try:
                 with span("route", replica=info.rid,
+                          trace_id=spec.get("trace_id"),
                           outstanding=info.outstanding):
                     writer.write((json.dumps(spec) + "\n").encode())
                     await writer.drain()
@@ -411,14 +475,16 @@ class Router:
         finally:
             info.outstanding -= 1
 
-    async def _fetch_verb(self, info: ReplicaInfo, cmd: str):
+    async def _fetch_verb(self, info: ReplicaInfo, cmd: str,
+                          extra: dict | None = None):
         """One replica's own control-verb payload for the aggregate
         pages, or ``{"unreachable": ...}``; None for replicas not in a
         routable state."""
         if info.status not in (READY, DRAINING):
             return None
         try:
-            rep = await self._backend_control(info, {"cmd": cmd})
+            rep = await self._backend_control(
+                info, {"cmd": cmd, **(extra or {})})
             return rep.get(cmd, rep)
         except (OSError, ValueError, asyncio.TimeoutError,
                 _BackendLost) as e:
@@ -467,9 +533,71 @@ class Router:
             if self.registry is not None:
                 out["router"] = self.registry.snapshot()
             return {"metricsz": out}
+        if cmd == "debugz":
+            infos = list(self.supervisor.replicas.items())
+            fetched = await asyncio.gather(*(
+                self._fetch_verb(info, "debugz") for _, info in infos))
+            replicas = {}
+            for (rid, info), sub in zip(infos, fetched):
+                entry = info.public()
+                # Backoff state: how suspicious the supervisor currently
+                # is of this replica (exponent feeding the restart delay).
+                entry["consecutive_restarts"] = info.consecutive_restarts
+                if sub is not None:
+                    entry["debugz"] = sub
+                replicas[rid] = entry
+            out = {
+                "router": {
+                    "replicas_total": len(self.supervisor.replicas),
+                    "replicas_ready": self.supervisor.ready_count,
+                    "outstanding_total": sum(
+                        r.outstanding
+                        for r in self.supervisor.replicas.values()),
+                    "pooled_connections": sum(
+                        len(p) for p in self._pools.values()),
+                },
+                "replicas": replicas,
+                "restart_log": self.supervisor.restart_log_entries(),
+            }
+            if self.trace_store is not None:
+                out["router"]["trace_store"] = self.trace_store.stats()
+            return {"debugz": out}
+        if cmd == "tracez":
+            return await self._tracez(spec)
         if cmd == "reload":
             return await self.rolling_reload(spec)
         return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
+
+    async def _tracez(self, spec: dict) -> dict:
+        """Cross-process trace assembly: the router's own routing record
+        for ``trace_id`` merged with every live replica's engine
+        record(s) for it — ONE trace spanning client-visible hops. A hop
+        served by a replica that has since died is still visible through
+        the router's dispatch events (and its engine timeline survives
+        in that replica's flight-recorder dump)."""
+        if self.trace_store is None:
+            return {"error": "request tracing is not enabled on this "
+                             "router", "code": "bad_request"}
+        tid = spec.get("trace_id")
+        if not tid:
+            try:
+                n = int(spec.get("n", 20))
+            except (TypeError, ValueError):
+                return {"error": f"bad n {spec.get('n')!r}",
+                        "code": "bad_request"}
+            return {"tracez": {"recent": self.trace_store.recent(n),
+                               **self.trace_store.stats()}}
+        tid = str(tid)
+        infos = list(self.supervisor.replicas.items())
+        fetched = await asyncio.gather(*(
+            self._fetch_verb(info, "tracez", {"trace_id": tid})
+            for _, info in infos))
+        records: list[dict] = list(self.trace_store.get_all(tid))
+        for (_, info), sub in zip(infos, fetched):
+            if isinstance(sub, dict):
+                records.extend(h for h in sub.get("hops", [])
+                               if isinstance(h, dict))
+        return {"tracez": merge_trace(tid, records)}
 
     # -- rolling reload -----------------------------------------------------
     async def rolling_reload(self, spec: dict) -> dict:

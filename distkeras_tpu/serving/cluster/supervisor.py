@@ -28,6 +28,9 @@ write the one table.
 from __future__ import annotations
 
 import asyncio
+import collections
+import json
+import os
 import time
 from typing import Callable
 
@@ -88,6 +91,11 @@ class ReplicaSupervisor:
         }
         self._stopping = asyncio.Event()
         self._restart_tasks: set[asyncio.Task] = set()
+        # Bounded death/restart log: one entry per replica death, with a
+        # reference to (and summary of) the dead replica's flight-
+        # recorder "last words" dump when its handle exposes one — the
+        # post-mortem trail `debugz` serves and operators grep first.
+        self.restart_log: collections.deque = collections.deque(maxlen=64)
         # The fleet's CURRENT weights path, recorded by the router's
         # rolling reload: a replica (re)started after a reload must
         # rejoin on these weights, not the factory's boot weights —
@@ -117,6 +125,40 @@ class ReplicaSupervisor:
     def table(self) -> dict[str, dict]:
         """JSON-safe snapshot of the replica table (aggregate healthz)."""
         return {rid: info.public() for rid, info in self.replicas.items()}
+
+    def restart_log_entries(self) -> list[dict]:
+        return list(self.restart_log)
+
+    def _collect_last_words(self, info: ReplicaInfo, entry: dict) -> None:
+        """Attach the dead replica's flight-recorder dump to its restart
+        log entry: the path, plus a small summary (event/timeline counts
+        and the final recorded events) so the log is useful even before
+        anyone opens the file. Missing file (SIGKILL'd process replicas
+        can't write last words) or a torn read is recorded as such, never
+        raised — this runs on the death path."""
+        path = getattr(info.handle, "last_words_path", None)
+        if not path:
+            return
+        entry["flight_recorder"] = path
+        try:
+            if not os.path.exists(path):
+                entry["last_words"] = "no dump found (hard kill?)"
+                return
+            with open(path) as f:
+                dump = json.load(f)
+            entry["last_words"] = {
+                "source": dump.get("source"),
+                "dumped_at": dump.get("dumped_at"),
+                "events": len(dump.get("events", [])),
+                "timelines": len(dump.get("timelines", [])),
+                "slow_exemplars": len(dump.get("slow_exemplars", [])),
+                "final_events": [
+                    {"kind": e.get("kind"), "ts": e.get("ts")}
+                    for e in dump.get("events", [])[-3:]
+                ],
+            }
+        except (OSError, ValueError) as e:
+            entry["last_words"] = f"unreadable dump: {e}"
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
@@ -242,6 +284,10 @@ class ReplicaSupervisor:
         info.status = DEAD
         info.ready_since = None
         self._note_ready()
+        entry = {"t": time.time(), "rid": info.rid, "why": why,
+                 "prior_restarts": info.restarts}
+        self._collect_last_words(info, entry)
+        self.restart_log.append(entry)
         task = asyncio.get_running_loop().create_task(
             self._restart(info), name=f"restart-{info.rid}")
         self._restart_tasks.add(task)
@@ -273,6 +319,10 @@ class ReplicaSupervisor:
             info.restarts += 1
             if self._c_restarts is not None:
                 self._c_restarts.inc()
+            self.restart_log.append({
+                "t": time.time(), "rid": info.rid, "restarted": True,
+                "restarts": info.restarts,
+                "host": info.host, "port": info.port})
             return
 
     async def stop(self) -> None:

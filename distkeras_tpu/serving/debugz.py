@@ -1,0 +1,203 @@
+"""Render ``debugz`` / ``tracez`` payloads for humans.
+
+The wire verbs return JSON (scripts and dashboards want that); this
+module is the terminal half — ``python -m distkeras_tpu.run debugz``
+fetches a page from a server or router and prints it through
+:func:`format_debugz` / :func:`format_tracez`. Pure formatting, no I/O:
+testable on captured payloads, reusable by anything that already has the
+dict.
+
+Output discipline: fixed-width tables for the enumerable parts (slots,
+queue, replicas), one indented line per scalar elsewhere, and ages in
+seconds with millisecond precision — the operator is diagnosing a live
+incident, so the page must scan top-down: fleet -> replica -> slot ->
+request.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["format_debugz", "format_tracez"]
+
+
+def _table(rows: list[dict], columns: list[tuple[str, str]]) -> list[str]:
+    """Fixed-width text table: ``columns`` is (header, row-key) pairs;
+    missing values render as '-'."""
+    cells = [[str(r.get(key, "-")) if r.get(key) is not None else "-"
+              for _, key in columns] for r in rows]
+    widths = [max(len(h), *(len(c[i]) for c in cells)) if cells else len(h)
+              for i, (h, _) in enumerate(columns)]
+    out = ["  ".join(h.ljust(w) for (h, _), w in zip(columns, widths))]
+    for row in cells:
+        out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return out
+
+
+def _engine_section(dz: dict, indent: str = "") -> list[str]:
+    """One engine's debugz payload (a standalone server's page, or one
+    replica's sub-page in a fleet aggregate)."""
+    lines: list[str] = []
+    q = dz.get("queue", {})
+    lines.append(f"{indent}active_slots={dz.get('active_slots')} "
+                 f"queue_depth={q.get('depth')}/{q.get('max_depth')} "
+                 f"oldest_queued={q.get('oldest_age_s', 0):.3f}s "
+                 f"decode_compiles={dz.get('decode_compile_count')}"
+                 + (" STOPPING" if dz.get("stopping") else "")
+                 + (" SWAP-PENDING" if dz.get("pending_swap") else ""))
+    if dz.get("slo_s") is not None:
+        lines.append(f"{indent}slo={dz['slo_s']}s")
+    slots = dz.get("slots", [])
+    if slots:
+        lines.append(f"{indent}slots:")
+        for ln in _table(slots, [("slot", "slot"), ("state", "state"),
+                                 ("trace_id", "trace_id"),
+                                 ("depth", "depth"), ("age_s", "age_s"),
+                                 ("remaining", "remaining")]):
+            lines.append(f"{indent}  {ln}")
+    queued = q.get("queued", [])
+    if queued:
+        lines.append(f"{indent}queued (service order):")
+        for ln in _table(queued, [("trace_id", "trace_id"),
+                                  ("prio", "priority"), ("age_s", "age_s"),
+                                  ("prompt", "prompt_tokens"),
+                                  ("deadline_in", "deadline_in_s")]):
+            lines.append(f"{indent}  {ln}")
+    pc = dz.get("prefix_cache")
+    if pc:
+        lines.append(
+            f"{indent}prefix_cache: {pc.get('blocks_used')}/"
+            f"{pc.get('capacity_blocks')} blocks "
+            f"({pc.get('families')} families)")
+        fams = pc.get("top_families", [])
+        if fams:
+            for ln in _table(fams, [("family_head", "family_head"),
+                                    ("blocks", "blocks"),
+                                    ("tokens", "tokens"),
+                                    ("pins", "pinned_refs"),
+                                    ("depth", "max_chain_depth")]):
+                lines.append(f"{indent}  {ln}")
+    fr = dz.get("flight_recorder")
+    if fr:
+        lines.append(
+            f"{indent}flight_recorder: {fr.get('events_recorded')} events, "
+            f"{fr.get('timelines_recorded')} timelines, "
+            f"{fr.get('slow_exemplars')} slow exemplars"
+            + (f" -> {fr['dump_path']}" if fr.get("dump_path") else ""))
+    ts = dz.get("trace_store")
+    if ts:
+        lines.append(f"{indent}trace_store: {ts.get('records')}/"
+                     f"{ts.get('capacity')} records "
+                     f"({ts.get('evicted')} evicted)")
+    return lines
+
+
+def format_debugz(payload: dict) -> str:
+    """Pretty-print a debugz payload — either the fleet shape the router
+    returns (``router``/``replicas``/``restart_log``) or a single
+    engine's shape (``slots``/``queue``/...)."""
+    lines: list[str] = []
+    if "replicas" in payload and "router" in payload:
+        r = payload["router"]
+        lines.append(
+            f"router: {r.get('replicas_ready')}/{r.get('replicas_total')} "
+            f"ready, {r.get('outstanding_total')} outstanding, "
+            f"{r.get('pooled_connections', 0)} pooled conns")
+        for rid in sorted(payload["replicas"]):
+            info = payload["replicas"][rid]
+            lines.append(
+                f"replica {rid}: {info.get('status')} "
+                f"{info.get('host')}:{info.get('port')} "
+                f"outstanding={info.get('outstanding')} "
+                f"restarts={info.get('restarts')} "
+                f"fails={info.get('consecutive_failures')} "
+                f"backoff_exp={info.get('consecutive_restarts')}")
+            sub = info.get("debugz")
+            if isinstance(sub, dict) and "unreachable" in sub:
+                lines.append(f"  UNREACHABLE: {sub['unreachable']}")
+            elif isinstance(sub, dict):
+                lines.extend(_engine_section(sub, indent="  "))
+        log = payload.get("restart_log", [])
+        if log:
+            lines.append("restart log (most recent last):")
+            for e in log:
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(e.get("t", 0)))
+                if e.get("restarted"):
+                    lines.append(f"  {when} {e.get('rid')}: restarted "
+                                 f"(#{e.get('restarts')}) on "
+                                 f"{e.get('host')}:{e.get('port')}")
+                else:
+                    ln = f"  {when} {e.get('rid')}: DIED — {e.get('why')}"
+                    if e.get("flight_recorder"):
+                        ln += f"; last words: {e['flight_recorder']}"
+                    lines.append(ln)
+                    lw = e.get("last_words")
+                    if isinstance(lw, dict):
+                        lines.append(
+                            f"      dump: {lw.get('events')} events, "
+                            f"{lw.get('timelines')} timelines, "
+                            f"{lw.get('slow_exemplars')} slow")
+                    elif isinstance(lw, str):
+                        lines.append(f"      dump: {lw}")
+    else:
+        lines.extend(_engine_section(payload))
+    return "\n".join(lines)
+
+
+def _fmt_event(ts: float, source: str, name: str, attrs) -> str:
+    when = time.strftime("%H:%M:%S", time.localtime(ts))
+    # Truncate, don't round: rounding renders fraction .9995+ as "1000".
+    ms = f"{int((ts % 1) * 1000):03d}"
+    line = f"  {when}.{ms} {source:<16} {name}"
+    if attrs:
+        kv = " ".join(f"{k}={v}" for k, v in attrs.items() if v is not None)
+        if kv:
+            line += f"  ({kv})"
+    return line
+
+
+def format_tracez(payload: dict) -> str:
+    """Pretty-print a tracez payload: a merged cross-process trace
+    (router + engine hops), a single store's hop list, or a recent-
+    records listing."""
+    lines: list[str] = []
+    if "recent" in payload:
+        lines.append(f"{payload.get('records', len(payload['recent']))} "
+                     f"recorded; most recent:")
+        for rec in payload["recent"]:
+            d = rec.get("data", {})
+            lines.append(
+                f"  {rec.get('trace_id')}  {rec.get('role')}:"
+                f"{rec.get('source')}  status={d.get('status', '?')} "
+                f"latency={d.get('latency_s', '-')}s "
+                f"tokens={d.get('tokens_out', '-')}")
+        return "\n".join(lines)
+    tid = payload.get("trace_id")
+    lines.append(f"trace {tid}")
+    router = payload.get("router")
+    if router:
+        d = router.get("data", {})
+        lines.append(f"router: status={d.get('status')} "
+                     f"retries={d.get('retries', 0)} "
+                     f"hops={d.get('hops', [])}")
+    hops = payload.get("engine_hops") or payload.get("hops") or []
+    for hop in hops:
+        if not isinstance(hop, dict):
+            continue
+        d = hop.get("data", {})
+        lines.append(
+            f"engine hop {hop.get('source')}: status={d.get('status')} "
+            f"queue_wait={d.get('queue_wait_s', '-')}s "
+            f"prefill={d.get('prefill_device_s', '-')}s"
+            f"/{d.get('prefill_chunks', '-')}ch "
+            f"cache_hit={d.get('cache_hit_tokens', '-')}tok "
+            f"ttft={d.get('ttft_s', '-')}s "
+            f"latency={d.get('latency_s', '-')}s "
+            f"tokens={d.get('tokens_out', '-')}")
+    events = payload.get("events")
+    if events:
+        lines.append("events:")
+        for ts, source, name, attrs in events:
+            lines.append(_fmt_event(ts, source, name, attrs))
+    return "\n".join(lines)

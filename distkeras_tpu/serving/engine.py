@@ -43,6 +43,7 @@ import asyncio
 import contextvars
 import dataclasses
 import functools
+import os
 import time
 
 import jax
@@ -60,7 +61,13 @@ from distkeras_tpu.inference.generate import (
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
 from distkeras_tpu.serving.prefix_cache import PrefixCache
-from distkeras_tpu.telemetry import RecompileAuditor, span
+from distkeras_tpu.telemetry import (
+    FlightRecorder,
+    RecompileAuditor,
+    TimelineRecord,
+    TraceStore,
+    span,
+)
 from distkeras_tpu.serving.scheduler import (
     EngineStopped,
     Request,
@@ -186,6 +193,16 @@ class ServingEngine:
     control, test fixtures); the cache is NOT thread-safe — it must be
     driven by a single engine's loop at a time.
 
+    Observability (all default-off; see :mod:`distkeras_tpu.telemetry`):
+    ``trace_store`` keeps per-request timeline records queryable by
+    trace_id (the ``tracez`` verb); ``flight_recorder`` keeps a bounded
+    black box of recent timelines + engine state transitions, dumped as
+    last words if the run loop dies; ``slo_s`` arms the latency SLO —
+    a request finishing slower bumps ``serving_slo_violations_total``
+    and (with a recorder) pins its full timeline as a slow exemplar.
+    With all three off, per-request timelines are never built and the
+    per-token path does no tracing work at all.
+
     Drive it with :meth:`submit` + :meth:`run` (asyncio); blocking device
     work (prefill, decode step) runs in the default executor so the event
     loop keeps accepting connections mid-decode.
@@ -208,6 +225,9 @@ class ServingEngine:
         prefix_cache_mb: float = 0.0,
         prefix_block_tokens: int = 16,
         prefix_cache: PrefixCache | None = None,
+        trace_store: TraceStore | None = None,
+        flight_recorder: FlightRecorder | None = None,
+        slo_s: float | None = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -303,6 +323,23 @@ class ServingEngine:
             self._decode_step = auditor.wrap(
                 self._decode_step, "serving_decode")
 
+        # Request tracing + flight recording. Timelines are built only
+        # when at least one sink exists — with both off the per-request
+        # cost is a None attribute and the per-token cost is zero.
+        self.trace_store = trace_store
+        self.flight_recorder = flight_recorder
+        # Hop identity stamped into timeline records (a LocalReplica
+        # factory overwrites it with the replica id — several engines
+        # share one pid there).
+        self.trace_source = (flight_recorder.source
+                             if flight_recorder is not None
+                             else f"pid:{os.getpid()}")
+        self.slo_s = None if slo_s is None else float(slo_s)
+        self._trace_requests = (trace_store is not None
+                                or flight_recorder is not None)
+        if self.slo_s is not None:
+            self.metrics.set_slo(self.slo_s)
+
         self._running = False
         self._stopping = False
         self._draining = True
@@ -339,6 +376,56 @@ class ServingEngine:
     def free_slots(self) -> int:
         return self.slots - self.active_slots
 
+    def debugz(self) -> dict:
+        """Live state snapshot for the ``debugz`` control verb: the slot
+        table (per-slot phase, trace_id, sequence depth, age), the
+        scheduler queue with per-request ages, prefix-cache trie
+        occupancy, and flight-recorder/SLO status — the "what is the
+        engine doing RIGHT NOW" page metricsz's aggregates can't answer.
+        JSON-safe; reads live structures without locking (the asyncio
+        control handler and the engine loop interleave at await points,
+        and a slightly torn read of a diagnostic page is harmless)."""
+        now = time.monotonic()
+        slots = []
+        for i, st in enumerate(self._slot_state):
+            if st is None:
+                slots.append({"slot": i, "state": "free"})
+                continue
+            req = st.request
+            entry = {
+                "slot": i,
+                "state": "prefill" if st.prefill is not None else "decode",
+                "trace_id": req.trace_id,
+                "depth": len(req.prompt) + len(req.out_tokens),
+                "remaining": st.remaining,
+                "age_s": (round(now - req.t_submit, 6)
+                          if req.t_submit is not None else None),
+            }
+            if st.prefill is not None:
+                entry["prefill"] = {
+                    "pos": st.prefill.pos,
+                    "prompt_tokens": len(req.prompt),
+                    "chunks_done": st.prefill.chunks_done,
+                }
+            slots.append(entry)
+        out = {
+            "slots": slots,
+            "active_slots": self.active_slots,
+            "queue": self.scheduler.debugz(now),
+            "stopping": self._stopping,
+            "pending_swap": self._pending_swap is not None,
+            "decode_compile_count": self.decode_compile_count(),
+        }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.debugz()
+        if self.flight_recorder is not None:
+            out["flight_recorder"] = self.flight_recorder.stats()
+        if self.trace_store is not None:
+            out["trace_store"] = self.trace_store.stats()
+        if self.slo_s is not None:
+            out["slo_s"] = self.slo_s
+        return out
+
     # -- submission ---------------------------------------------------------
     def submit(
         self,
@@ -348,6 +435,7 @@ class ServingEngine:
         temperature: float = 0.0,
         priority: int = 0,
         timeout: float | None = None,
+        trace_id: str | None = None,
     ) -> Request:
         """Validate and enqueue a request; returns the streaming handle.
 
@@ -367,8 +455,14 @@ class ServingEngine:
                        max_new_tokens)
         req = Request(
             prompt_arr.tolist(), max_new_tokens, temperature=temperature,
-            priority=priority, timeout=timeout,
+            priority=priority, timeout=timeout, trace_id=trace_id,
         )
+        if self._trace_requests:
+            req.trace = TimelineRecord(req.trace_id, "engine",
+                                       self.trace_source)
+            req.trace.event("submit", prompt_tokens=len(req.prompt),
+                            max_new_tokens=req.max_new_tokens,
+                            priority=req.priority)
         try:
             self.scheduler.submit(req)
         except ServingError:
@@ -382,6 +476,8 @@ class ServingEngine:
         before :meth:`run` returns; ``drain=False`` errors them out."""
         self._stopping = True
         self._draining = drain
+        if self.flight_recorder is not None:
+            self.flight_recorder.record_event("shutdown", drain=drain)
         self.scheduler.kick()
 
     def request_param_swap(self, variables):
@@ -477,6 +573,9 @@ class ServingEngine:
         if self._running:
             raise RuntimeError("engine.run() is already active")
         self._running = True
+        if self.flight_recorder is not None:
+            self.flight_recorder.record_event("engine_start",
+                                              slots=self.slots)
         loop = asyncio.get_running_loop()
         try:
             while True:
@@ -521,6 +620,8 @@ class ServingEngine:
                 if self._pending_swap is not None and self.active_slots == 0:
                     params, ev, res = self._pending_swap
                     self._pending_swap = None
+                    if self.flight_recorder is not None:
+                        self.flight_recorder.record_event("param_swap")
                     with span("param_swap"):
                         try:
                             await self._in_executor(
@@ -560,10 +661,22 @@ class ServingEngine:
                         # queueing delay from prefill cost.
                         wait = time.monotonic() - req.t_submit
                         self.metrics.record_admit(wait)
+                        if req.trace is not None:
+                            # Rendered as a slice ENDING here: the queue
+                            # wait lane segment between submit and admit.
+                            req.trace.event("admit", slot=slot,
+                                            dur_s=round(wait, 9))
+                            req.trace.data["queue_wait_s"] = round(wait, 9)
+                            req.trace.data["admit_iteration"] = (
+                                self.metrics.iterations)
+                        if self.flight_recorder is not None:
+                            self.flight_recorder.record_event(
+                                "admit", trace_id=req.trace_id, slot=slot)
                         st = _SlotState(req, req.max_new_tokens,
                                         time.monotonic())
                         self._slot_state[slot] = st
                         with span("admit", slot=slot,
+                                  trace_id=req.trace_id,
                                   prompt_len=len(req.prompt),
                                   queue_wait_s=round(wait, 6)):
                             # Prefix-cache lookup + splice: a hit makes
@@ -672,6 +785,11 @@ class ServingEngine:
                 res["error"] = err
                 ev.set()
             self._stopping = True
+            # Last words: the black box hits disk BEFORE the exception
+            # propagates — a chaos-killed (task-cancelled) or device-
+            # failed replica leaves its final state for the supervisor.
+            if self.flight_recorder is not None:
+                self.flight_recorder.crash_dump(error=repr(e))
             raise
         finally:
             self._running = False
@@ -729,6 +847,9 @@ class ServingEngine:
                 with span("prefix_splice", blocks=len(match.ids),
                           tokens=matched):
                     cache = self.prefix_cache.splice(cache, match.ids)
+        if req.trace is not None and matched:
+            req.trace.event("prefix_splice", tokens=matched,
+                            blocks=len(match.ids))
         return _PrefillJob(cache=cache, pos=matched, match=match,
                            matched_tokens=matched)
 
@@ -774,8 +895,12 @@ class ServingEngine:
                 self._params, job.cache, jnp.asarray(padded),
                 jnp.int32(job.pos), jnp.int32(c), temp, sub)
             tok0 = int(tok)  # blocks: honest device time per chunk
-        job.device_s += time.monotonic() - t0
+        chunk_s = time.monotonic() - t0
+        job.device_s += chunk_s
         job.chunks_done += 1
+        if req.trace is not None:
+            req.trace.event("prefill_chunk", offset=job.pos, tokens=c,
+                            bucket=P, dur_s=round(chunk_s, 9))
         job.pos += c
         if job.pos < s0:
             return None
@@ -794,6 +919,11 @@ class ServingEngine:
             job.device_s, job.chunks_done,
             job.matched_tokens if self.prefix_cache is not None else None,
             s0)
+        if req.trace is not None:
+            req.trace.data.update(
+                prefill_device_s=round(job.device_s, 9),
+                prefill_chunks=job.chunks_done,
+                cache_hit_tokens=job.matched_tokens)
         st.prefill = None
         return tok0
 
@@ -808,9 +938,14 @@ class ServingEngine:
         req = st.request
         if first:
             req.t_first_token = t
-            self.metrics.record_first_token(t - req.t_submit)
+            self.metrics.record_first_token(t - req.t_submit,
+                                            trace_id=req.trace_id)
+            if req.trace is not None:
+                req.trace.event("first_token",
+                                ttft_s=round(t - req.t_submit, 9))
         else:
-            self.metrics.record_inter_token(t - st.last_token_t)
+            self.metrics.record_inter_token(t - st.last_token_t,
+                                            trace_id=req.trace_id)
             st.remaining -= 1
         st.last_token_t = t
         req.out_tokens.append(tok)
@@ -819,6 +954,7 @@ class ServingEngine:
     def _finish_ok(self, req: Request) -> None:
         req.t_done = time.monotonic()
         self.metrics.record_finish(req.t_done - req.t_submit)
+        self._finalize_trace(req, "ok")
         req.events.put_nowait(("done", {
             "tokens": len(req.out_tokens),
             "ttft_s": req.ttft,
@@ -829,5 +965,48 @@ class ServingEngine:
     def _finish_error(self, req: Request, err: ServingError) -> None:
         req.error = err
         req.t_done = time.monotonic()
+        self._finalize_trace(req, err.code, message=str(err))
         req.events.put_nowait(("error", err))
         req.done.set()
+
+    def _finalize_trace(self, req: Request, status: str,
+                        message: str | None = None) -> None:
+        """Terminal bookkeeping for one request: SLO verdict (counter
+        even with tracing off) and timeline finalization into the trace
+        store / flight recorder. Cheap no-op when nothing is armed."""
+        latency = (req.t_done - req.t_submit
+                   if req.t_done is not None and req.t_submit is not None
+                   else None)
+        slow = (self.slo_s is not None and latency is not None
+                and latency > self.slo_s)
+        if slow:
+            self.metrics.record_slo_violation()
+        rec = req.trace
+        if rec is None:
+            return
+        req.trace = None  # finalize exactly once
+        if status == "ok":
+            rec.event("done", tokens=len(req.out_tokens))
+        else:
+            rec.event("error", code=status,
+                      message=(message or "")[:200] or None)
+        d = rec.data
+        d["status"] = status
+        d["tokens_out"] = len(req.out_tokens)
+        d["prompt_tokens"] = len(req.prompt)
+        if latency is not None:
+            d["latency_s"] = round(latency, 9)
+        if req.ttft is not None:
+            d["ttft_s"] = round(req.ttft, 9)
+        if "admit_iteration" in d:
+            # Decode ticks this request lived through (its share of the
+            # batch's iterations between admission and completion).
+            d["decode_iterations"] = (self.metrics.iterations
+                                      - d.pop("admit_iteration"))
+        if slow:
+            d["slo_violation"] = True
+        recd = rec.to_dict()
+        if self.trace_store is not None:
+            self.trace_store.put(recd)
+        if self.flight_recorder is not None:
+            self.flight_recorder.record_timeline(recd, slow=slow)

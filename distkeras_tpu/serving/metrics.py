@@ -126,6 +126,13 @@ class ServingMetrics:
                 "serving_request_latency_seconds",
                 help="submit-to-done latency", buckets=_LATENCY_BUCKETS),
         }
+        self._c_slo_violations = reg.counter(
+            "serving_slo_violations_total",
+            help="requests that finished slower than the configured "
+                 "latency SLO")
+        self._g_slo = reg.gauge(
+            "serving_slo_seconds",
+            help="configured request-latency SLO (0 = no SLO armed)")
         self._c_prefix_hit_tokens = reg.counter(
             "serving_prefix_hit_tokens_total",
             help="admitted prompt tokens served from the prefix cache")
@@ -180,14 +187,19 @@ class ServingMetrics:
             self._c_prefix_hit_tokens.inc(matched_tokens)
             self._c_prompt_tokens.inc(prompt_tokens)
 
-    def record_first_token(self, ttft_s: float) -> None:
+    def record_first_token(self, ttft_s: float,
+                           trace_id: str | None = None) -> None:
+        """``trace_id`` becomes the histogram's per-bucket worst-sample
+        exemplar: a TTFT p99 spike on the scrape page names the request
+        whose flight-recorder timeline explains it."""
         self.ttft.append(ttft_s)
-        self._h["ttft"].observe(ttft_s)
+        self._h["ttft"].observe(ttft_s, exemplar=trace_id)
         self._c_tokens.inc()
 
-    def record_inter_token(self, gap_s: float) -> None:
+    def record_inter_token(self, gap_s: float,
+                           trace_id: str | None = None) -> None:
         self.inter_token.append(gap_s)
-        self._h["inter_token"].observe(gap_s)
+        self._h["inter_token"].observe(gap_s, exemplar=trace_id)
         self._c_tokens.inc()
 
     def record_finish(self, latency_s: float) -> None:
@@ -200,6 +212,22 @@ class ServingMetrics:
 
     def record_expire(self) -> None:
         self._c_expired.inc()
+
+    def set_slo(self, slo_s: float) -> None:
+        self._g_slo.set(slo_s)
+
+    def record_slo_violation(self) -> None:
+        self._c_slo_violations.inc()
+
+    @property
+    def slo_violations(self) -> int:
+        return int(self._c_slo_violations.value)
+
+    @property
+    def iterations(self) -> int:
+        """Decode-loop iterations sampled so far (per-request timeline
+        records diff this around a request's lifetime)."""
+        return self._iterations
 
     # -- per-iteration sampling --------------------------------------------
     def sample(self, queue_depth: int, slots_active: int, slots_total: int) -> None:
@@ -236,6 +264,8 @@ class ServingMetrics:
             "elapsed_s": elapsed,
             "decode_iterations": float(self._iterations),
         }
+        if self._g_slo.value:
+            out["slo_violations"] = float(self.slo_violations)
         for name, xs in (
             ("ttft", self.ttft),
             ("inter_token", self.inter_token),

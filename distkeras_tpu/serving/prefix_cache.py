@@ -239,6 +239,43 @@ class PrefixCache:
             "flushes": self.flushes,
         }
 
+    def debugz(self, top: int = 16) -> dict:
+        """Trie occupancy grouped by **prefix family** — the root's
+        children, i.e. the distinct first blocks (system prompts,
+        templates). Per family: subtree block/token counts, live pins,
+        and chain depth, sorted by blocks so the page leads with the
+        biggest resident; ``top`` bounds the list (the full family count
+        is still reported). The occupancy view ``stats()`` can't give:
+        WHICH prompts own the pool, not just how full it is."""
+        fams = []
+        for key, child in self._root.children.items():
+            blocks = refs = depth = 0
+            stack = [(child, 1)]
+            while stack:
+                n, d = stack.pop()
+                blocks += 1
+                refs += n.refs
+                depth = max(depth, d)
+                stack.extend((c, d + 1) for c in n.children.values())
+            fams.append({
+                # First 8 tokens of the family's first block: enough to
+                # recognize a system prompt, bounded output regardless
+                # of block size.
+                "family_head": list(key[:8]),
+                "blocks": blocks,
+                "tokens": blocks * self.block_tokens,
+                "pinned_refs": refs,
+                "max_chain_depth": depth,
+            })
+        fams.sort(key=lambda f: (-f["blocks"], f["family_head"]))
+        return {
+            "blocks_used": self.blocks_used,
+            "capacity_blocks": self.capacity,
+            "block_tokens": self.block_tokens,
+            "families": len(fams),
+            "top_families": fams[:int(top)],
+        }
+
     def flush(self) -> None:
         """Invalidate every cached block at once (weight reload: pooled
         K/V is a function of the weights, so a param swap makes all of it

@@ -16,6 +16,11 @@ import itertools
 import time
 from typing import AsyncIterator, Sequence
 
+from distkeras_tpu.telemetry.request_trace import (
+    new_trace_id,
+    sanitize_trace_id,
+)
+
 __all__ = [
     "ServingError",
     "QueueFullError",
@@ -27,9 +32,12 @@ __all__ = [
 
 
 class ServingError(Exception):
-    """Base class for typed serving failures (wire ``code`` per subclass)."""
+    """Base class for typed serving failures (wire ``code`` per subclass).
+    ``trace_id`` is attached when the failure is tied to one request
+    whose id is known (client-side decode of error lines)."""
 
     code = "error"
+    trace_id: str | None = None
 
 
 class QueueFullError(ServingError):
@@ -73,11 +81,19 @@ class Request:
         temperature: float = 0.0,
         priority: int = 0,
         timeout: float | None = None,
+        trace_id: str | None = None,
     ):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)  # <= 0 means greedy
         self.priority = int(priority)
+        # Every request carries a trace id: the client's (propagated over
+        # the wire, sanitized against junk) or a fresh mint — so
+        # done/error replies, debugz slot tables, and histogram exemplars
+        # can always name the request. The TIMELINE (``trace``) is only
+        # attached by an engine with a trace store/flight recorder.
+        self.trace_id = sanitize_trace_id(trace_id) or new_trace_id()
+        self.trace = None  # TimelineRecord | None, engine-owned
         # Cast defensively: this arrives from the wire, and an uncastable
         # value must fail HERE (a bad_request to one client), not later as
         # a TypeError inside the engine loop's deadline arithmetic (which
@@ -282,6 +298,36 @@ class Scheduler:
             self._c_shed.inc(len(expired))
             self._note_depth()
         return expired
+
+    def debugz(self, now: float | None = None, limit: int = 64) -> dict:
+        """Queue introspection for the ``debugz`` verb: depth plus the
+        oldest ``limit`` queued requests in service order with their ages
+        — the page that answers "WHO is waiting and for how long" where
+        the depth gauge only answers "how many"."""
+        now = time.monotonic() if now is None else now
+        queued = []
+        for prio, _, req in sorted(self._heap)[:int(limit)]:
+            age = (now - req.t_submit) if req.t_submit is not None else 0.0
+            entry = {
+                "trace_id": req.trace_id,
+                "priority": prio,
+                "age_s": round(age, 6),
+                "prompt_tokens": len(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+            }
+            if req.deadline is not None:
+                entry["deadline_in_s"] = round(req.deadline - now, 6)
+            queued.append(entry)
+        return {
+            "depth": len(self._heap),
+            "max_depth": self.max_depth,
+            # Over the WHOLE queue, not just the listed window — the
+            # starvation signal must survive a deep queue.
+            "oldest_age_s": round(max(
+                ((now - item[2].t_submit) for item in self._heap
+                 if item[2].t_submit is not None), default=0.0), 6),
+            "queued": queued,
+        }
 
     def drain(self) -> list[Request]:
         """Remove and return everything queued (engine shutdown path)."""

@@ -41,6 +41,7 @@ import json
 
 from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.scheduler import ServingError
+from distkeras_tpu.telemetry.request_trace import sanitize_trace_id
 
 __all__ = ["ServingServer"]
 
@@ -121,19 +122,21 @@ class ServingServer:
                         temperature=float(spec.get("temperature", 0.0)),
                         priority=int(spec.get("priority", 0)),
                         timeout=spec.get("timeout"),
+                        trace_id=spec.get("trace_id"),
                     )
                 except ServingError as e:
-                    await self._send(writer, {"error": str(e), "code": e.code})
+                    await self._send(writer, self._error(e, spec))
                     continue
                 except (KeyError, TypeError, ValueError) as e:
-                    await self._send(writer,
-                                     {"error": str(e), "code": "bad_request"})
+                    await self._send(writer, self._error(e, spec,
+                                                         code="bad_request"))
                     continue
                 try:
                     async for tok in req.tokens():
                         await self._send(writer, {"token": tok})
                 except ServingError as e:
-                    await self._send(writer, {"error": str(e), "code": e.code})
+                    await self._send(writer, {"error": str(e), "code": e.code,
+                                              "trace_id": req.trace_id})
                     continue
                 except (ConnectionResetError, BrokenPipeError):
                     # Client walked away mid-stream: release the decode
@@ -143,6 +146,7 @@ class ServingServer:
                 await self._send(writer, {
                     "done": True,
                     "tokens": req.out_tokens,
+                    "trace_id": req.trace_id,
                     "ttft_ms": round(1e3 * req.ttft, 3),
                     "latency_ms": round(1e3 * (req.t_done - req.t_submit), 3),
                 })
@@ -155,11 +159,26 @@ class ServingServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    @staticmethod
+    def _error(e: Exception, spec: dict, code: str | None = None) -> dict:
+        """Typed error line; carries the request's trace_id when the wire
+        spec supplied one (a rejected request never built a Request, but
+        the client's id must still come back so ITS records correlate)."""
+        out = {"error": str(e), "code": code or getattr(e, "code", "error")}
+        tid = sanitize_trace_id(spec.get("trace_id"))
+        if tid:
+            out["trace_id"] = tid
+        return out
+
     async def _control(self, spec: dict) -> dict:
         """Handle a control verb; returns the single reply object."""
         cmd = spec.get("cmd")
         if cmd == "reload":
             return await self._reload(spec)
+        if cmd == "debugz":
+            return {"debugz": self.engine.debugz()}
+        if cmd == "tracez":
+            return self._tracez(spec)
         if cmd == "metricsz":
             registry = self.engine.metrics.registry
             if spec.get("format") == "prometheus":
@@ -180,8 +199,34 @@ class ServingServer:
                 health["prefix_cache"] = engine.prefix_cache.stats()
             if engine.auditor is not None:
                 health["recompile_audit"] = engine.auditor.report()
+            if engine.slo_s is not None:
+                health["slo_s"] = engine.slo_s
+                health["slo_violations"] = engine.metrics.slo_violations
+            if engine.flight_recorder is not None:
+                health["flight_recorder"] = engine.flight_recorder.stats()
             return {"healthz": health}
         return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
+
+    def _tracez(self, spec: dict) -> dict:
+        """``{"cmd": "tracez", "trace_id": ...}``: this engine's timeline
+        record(s) for one request — or, with no trace_id, the most recent
+        ``n`` records. The router's tracez merges these per-hop replies
+        into the one cross-process trace."""
+        store = self.engine.trace_store
+        if store is None:
+            return {"error": "request tracing is not enabled on this "
+                             "server (no trace store)",
+                    "code": "bad_request"}
+        tid = spec.get("trace_id")
+        if tid:
+            return {"tracez": {"trace_id": str(tid),
+                               "hops": store.get_all(str(tid))}}
+        try:
+            n = int(spec.get("n", 20))
+        except (TypeError, ValueError):
+            return {"error": f"bad n {spec.get('n')!r}",
+                    "code": "bad_request"}
+        return {"tracez": {"recent": store.recent(n), **store.stats()}}
 
     async def _reload(self, spec: dict) -> dict:
         """``{"cmd": "reload", "weights": path}``: hot-swap the engine's

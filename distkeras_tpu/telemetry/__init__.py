@@ -18,7 +18,14 @@ layer both sides publish into. Four pillars:
   shared :func:`percentile` definition;
 - **exposition** (:mod:`.exposition`) — Prometheus text + JSONL
   snapshots; the serving server serves both via its ``metricsz`` control
-  verb, ``run.py`` wires ``--trace-out`` / ``--audit-recompiles``.
+  verb, ``run.py`` wires ``--trace-out`` / ``--audit-recompiles``;
+- **request tracing** (:mod:`.request_trace`) — per-request trace ids
+  propagated across the serving cluster's processes, per-hop timeline
+  records, bounded stores behind the ``tracez`` control verb, and
+  one-lane-per-request Chrome export;
+- **flight recorder** (:mod:`.flight_recorder`) — bounded overwrite
+  rings of recent state transitions + request timelines, dumped as a
+  replica's "last words" on crash and mined for slow-request exemplars.
 """
 
 from distkeras_tpu.telemetry.spans import (
@@ -47,6 +54,17 @@ from distkeras_tpu.telemetry.exposition import (
     prometheus_text,
     write_snapshot_jsonl,
 )
+from distkeras_tpu.telemetry.request_trace import (
+    TimelineRecord,
+    TraceStore,
+    chrome_trace,
+    merge_trace,
+    new_trace_id,
+)
+from distkeras_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    load_flight_dump,
+)
 
 __all__ = [
     "Tracer",
@@ -67,4 +85,11 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "prometheus_text",
     "write_snapshot_jsonl",
+    "new_trace_id",
+    "TimelineRecord",
+    "TraceStore",
+    "merge_trace",
+    "chrome_trace",
+    "FlightRecorder",
+    "load_flight_dump",
 ]

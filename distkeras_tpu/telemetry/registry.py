@@ -164,8 +164,15 @@ class Histogram(_Metric):
         self._sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        # Per-bucket exemplar: (worst value, label) — the label is a
+        # trace_id in serving use, so a p99 spike on the scrape page
+        # links straight to that request's flight-recorder timeline.
+        # Fixed-size (one slot per bucket) and updated only when a new
+        # within-bucket maximum lands, so steady-state cost is a compare.
+        self._exemplars: list[tuple[float, object] | None] = (
+            [None] * (len(bs) + 1))
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar=None) -> None:
         v = float(v)
         i = bisect.bisect_left(self.bucket_bounds, v)
         with self._lock:
@@ -176,6 +183,25 @@ class Histogram(_Metric):
                 self._min = v
             if v > self._max:
                 self._max = v
+            if exemplar is not None:
+                cur = self._exemplars[i]
+                if cur is None or v > cur[0]:
+                    self._exemplars[i] = (v, exemplar)
+
+    def exemplars(self) -> dict[str, dict]:
+        """Worst-sample exemplar per occupied bucket, keyed by the
+        bucket's ``le`` upper bound (``"+Inf"`` for the overflow)."""
+        with self._lock:
+            pairs = list(self._exemplars)
+        out = {}
+        for i, pair in enumerate(pairs):
+            if pair is None:
+                continue
+            bound = (self.bucket_bounds[i]
+                     if i < len(self.bucket_bounds) else math.inf)
+            key = "+Inf" if bound == math.inf else repr(bound)
+            out[key] = {"value": pair[0], "trace_id": pair[1]}
+        return out
 
     @property
     def count(self) -> int:
@@ -289,6 +315,9 @@ class MetricsRegistry:
                         "p50": m.percentile(50), "p90": m.percentile(90),
                         "p99": m.percentile(99), "mean": m.mean,
                     })
+                    ex = m.exemplars()
+                    if ex:
+                        entry["exemplars"] = ex
                 out[key] = entry
             else:
                 out[key] = {"kind": m.kind, "value": m.value}

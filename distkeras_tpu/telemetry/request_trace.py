@@ -1,0 +1,269 @@
+"""Per-request distributed tracing: trace ids, timeline records, stores.
+
+PR 2's spans answer "where does THIS PROCESS spend its wall clock"; they
+cannot follow one request across the serving cluster's four processes
+(client -> router -> replica server -> engine), where a retry, an
+affinity spill, or a slow prefill on one hop is invisible from every
+other hop's aggregate histograms. This module adds the request-scoped
+layer:
+
+- **trace ids** — :func:`new_trace_id` mints a short opaque id; the
+  client generates one per request (or the router mints one for clients
+  that don't) and it rides the JSONL protocol end to end, so every hop
+  tags its spans, events, and error lines with the same id;
+- **timeline records** (:class:`TimelineRecord`) — one per request per
+  hop: an ordered event list (submit, admit with queue wait, prefill
+  chunks with device time, first token, terminal status) plus summary
+  data (cache-hit tokens, decode iterations, retries/replica hops).
+  The engine assembles one per served request; the router assembles one
+  per routed request with its dispatch/retry events;
+- **stores** (:class:`TraceStore`) — bounded per-process map of
+  completed records by trace id, queryable over the wire via the
+  ``tracez`` control verb; the router's ``tracez`` merges its own record
+  with every replica's into ONE cross-process trace
+  (:func:`merge_trace`);
+- **Chrome export** (:func:`chrome_trace`) — renders records in the same
+  ``traceEvents`` JSON the span tracer emits, ONE LANE PER REQUEST
+  (``tid`` = request), so Perfetto shows a swimlane per request with its
+  queue wait, prefill chunks, and decode phase laid end to end.
+
+Cost stance: everything here is **per-request**, never per-token — a
+record is a list of a dozen small events over a request's lifetime.  The
+per-token hot path (the decode loop's ``_push_token``) never touches a
+timeline; with no store or recorder configured the engine skips record
+construction entirely, keeping PR 2's disabled-path bar.
+
+Timestamps are ``time.time()`` (wall clock): cross-process merging needs
+one clock every hop shares, and NTP-level skew is fine at the >= 1 ms
+granularity request phases live at. Durations inside one process are
+measured monotonically by their publishers and attached as ``dur_s``
+attrs, so skew never corrupts a span's length.
+"""
+
+from __future__ import annotations
+
+import binascii
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+
+__all__ = [
+    "new_trace_id",
+    "sanitize_trace_id",
+    "TimelineRecord",
+    "TraceStore",
+    "merge_trace",
+    "chrome_trace",
+    "export_chrome_trace",
+]
+
+
+def new_trace_id() -> str:
+    """16 hex chars of OS randomness: unique enough for a fleet's
+    retention window, short enough to read aloud off a log line."""
+    return binascii.hexlify(os.urandom(8)).decode()
+
+
+def sanitize_trace_id(trace_id) -> str | None:
+    """The ONE sanitizer for wire-supplied trace ids (Request ctor, the
+    router's minting path, the server's error lines all use it): cap the
+    length against junk, and strip ``#`` — :class:`TraceStore` uses
+    ``<id>#<n>`` keys for duplicate hops, so a client-chosen id
+    containing ``#`` could address ANOTHER request's hop records. None
+    for empty/falsy input (callers mint a fresh id)."""
+    if not trace_id:
+        return None
+    tid = str(trace_id).replace("#", "")[:64]
+    return tid or None
+
+
+class TimelineRecord:
+    """One request's life on one hop: ordered events plus summary data.
+
+    ``role`` is ``"engine"`` (a replica's serving engine) or ``"router"``
+    (the cluster front port); ``source`` identifies the process/replica
+    (e.g. ``"r0"``, ``"engine:pid4242"``). Events are
+    ``[name, wall_ts, attrs-or-None]`` triples appended in order by the
+    single owner (the engine loop or the router handler — no locking
+    needed until the record is finalized into a :class:`TraceStore`).
+    """
+
+    __slots__ = ("trace_id", "role", "source", "t_start", "events", "data")
+
+    def __init__(self, trace_id: str, role: str, source: str = ""):
+        self.trace_id = trace_id
+        self.role = role
+        self.source = source
+        self.t_start = time.time()
+        self.events: list[list] = []
+        self.data: dict = {}
+
+    def event(self, name: str, **attrs) -> None:
+        """Append one event at the current wall clock. ``dur_s`` in attrs
+        marks a timed phase (rendered as a Chrome complete event whose
+        START is ``ts - dur_s``); other attrs are annotations."""
+        self.events.append([name, time.time(), attrs or None])
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "role": self.role,
+            "source": self.source,
+            "t_start": self.t_start,
+            "events": [list(e) for e in self.events],
+            "data": dict(self.data),
+        }
+
+
+class TraceStore:
+    """Bounded per-process store of finished timeline records.
+
+    Insertion-ordered with oldest-first eviction past ``capacity`` —
+    long-lived servers keep a sliding window of recent requests, never an
+    unbounded map (the exact failure mode the span tracer's
+    ``max_events`` bounds against). Stores plain dicts so ``get`` replies
+    are JSON-ready for the ``tracez`` verb. Thread-safe: the engine loop
+    finalizes records while control handlers read them.
+    """
+
+    def __init__(self, capacity: int = 512):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._records: OrderedDict[str, dict] = OrderedDict()
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def put(self, record: "TimelineRecord | dict") -> None:
+        rec = record.to_dict() if isinstance(record, TimelineRecord) else record
+        tid = rec.get("trace_id")
+        if not tid:
+            return
+        with self._lock:
+            # A retried request revisits one trace_id on a second hop of
+            # the SAME store only in single-process (LocalReplica) tests;
+            # keep hops distinguishable by source-suffixing duplicates.
+            key = tid
+            n = 1
+            while key in self._records:
+                key = f"{tid}#{n}"
+                n += 1
+            self._records[key] = rec
+            while len(self._records) > self.capacity:
+                self._records.popitem(last=False)
+                self.evicted += 1
+
+    def get(self, trace_id: str) -> dict | None:
+        """The record for ``trace_id`` (the FIRST hop when duplicated);
+        see :meth:`get_all` for every hop recorded under the id."""
+        hops = self.get_all(trace_id)
+        return hops[0] if hops else None
+
+    def get_all(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            return [rec for key, rec in self._records.items()
+                    if key == trace_id or key.startswith(f"{trace_id}#")]
+
+    def recent(self, n: int = 20) -> list[dict]:
+        n = int(n)
+        if n <= 0:  # recs[-0:] would be the WHOLE store
+            return []
+        with self._lock:
+            recs = list(self._records.values())
+        return recs[-n:]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"records": len(self._records),
+                    "capacity": self.capacity, "evicted": self.evicted}
+
+    def export_chrome_trace(self, path: str, n: int | None = None) -> str:
+        """Write the store's (most recent ``n``) records as Chrome-trace
+        JSON, one lane per request hop."""
+        recs = self.recent(n if n is not None else self.capacity)
+        return export_chrome_trace(recs, path)
+
+
+def merge_trace(trace_id: str, records) -> dict:
+    """Assemble hop records (router + engines, dicts or
+    :class:`TimelineRecord`) into ONE cross-process trace: the router
+    record, engine hops ordered by start time, and a single
+    wall-clock-sorted event list tagged with each event's source."""
+    recs = []
+    for r in records or []:
+        if r is None:
+            continue
+        rec = r.to_dict() if isinstance(r, TimelineRecord) else r
+        if rec.get("trace_id") == trace_id:
+            recs.append(rec)
+    routers = sorted((r for r in recs if r.get("role") == "router"),
+                     key=lambda r: r.get("t_start", 0.0))
+    engines = sorted((r for r in recs if r.get("role") == "engine"),
+                     key=lambda r: r.get("t_start", 0.0))
+    events = []
+    for rec in recs:
+        src = f"{rec.get('role', '?')}:{rec.get('source', '')}"
+        for name, ts, attrs in rec.get("events", []):
+            events.append([ts, src, name, attrs])
+    events.sort(key=lambda e: e[0])
+    return {
+        "trace_id": trace_id,
+        "router": routers[0] if routers else None,
+        "engine_hops": engines,
+        "hops": [e.get("source", "") for e in engines],
+        "events": events,
+    }
+
+
+def chrome_trace(records) -> dict:
+    """Records (or one merged trace) as Chrome ``traceEvents`` JSON —
+    the format PR 2's span tracer already emits, loadable in Perfetto —
+    with ONE LANE PER REQUEST HOP: ``tid`` is the hop, named
+    ``<trace_id>:<role>:<source>``. Events carrying ``dur_s`` become
+    complete (``X``) slices ending at their timestamp; the rest are
+    instants, so a lane reads submit -> [queue] -> [prefill chunks] ->
+    first_token -> done left to right."""
+    recs = []
+    for r in records or []:
+        if isinstance(r, TimelineRecord):
+            recs.append(r.to_dict())
+        elif isinstance(r, dict) and "engine_hops" in r:  # merged trace
+            recs.extend(x for x in
+                        ([r.get("router")] + list(r.get("engine_hops", [])))
+                        if x)
+        elif isinstance(r, dict):
+            recs.append(r)
+    pid = os.getpid()
+    out = []
+    for tid_num, rec in enumerate(recs):
+        lane = (f"{rec.get('trace_id', '?')[:16]}:{rec.get('role', '?')}"
+                f":{rec.get('source', '')}")
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid_num, "args": {"name": lane}})
+        for name, ts, attrs in rec.get("events", []):
+            us = round(ts * 1e6, 3)
+            args = dict(attrs) if attrs else {}
+            dur = args.pop("dur_s", None)
+            ev = {"name": name, "pid": pid, "tid": tid_num}
+            if args or rec.get("trace_id"):
+                args.setdefault("trace_id", rec.get("trace_id"))
+                ev["args"] = args
+            if dur is not None:
+                ev.update(ph="X", ts=round(us - float(dur) * 1e6, 3),
+                          dur=round(float(dur) * 1e6, 3))
+            else:
+                ev.update(ph="i", ts=us, s="t")
+            out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(records, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(records), f)
+    return path

@@ -14,8 +14,10 @@ The reference's observability is wall-clock getters plus the Spark web UI
 Spans, the recompile auditor, and the metrics registry live in
 :mod:`distkeras_tpu.telemetry` — the unified observability layer this
 module now publishes into. ``span`` / ``enable_tracing`` / ``Tracer``
-are re-exported here for callers that treat ``tracing`` as the one
-observability import; new code should import from ``telemetry``.
+remain importable here as **deprecated shims** (a module
+``__getattr__`` that warns and forwards): they have been pure
+re-exports since the telemetry unification, and new code should import
+from ``distkeras_tpu.telemetry``.
 """
 
 from __future__ import annotations
@@ -24,18 +26,32 @@ import contextlib
 import json
 import statistics
 import time
+import warnings
 from typing import Any, Callable
 
 import jax
 
 from distkeras_tpu.telemetry.registry import percentile, sanitize_metric_name
-from distkeras_tpu.telemetry.spans import (  # noqa: F401 — re-export shims
-    Tracer,
-    active_tracer,
-    disable_tracing,
-    enable_tracing,
-    span,
-)
+
+# Names that moved to distkeras_tpu.telemetry; accessing them here still
+# works but warns — the lazy __getattr__ keeps this module from paying
+# (or masking) the telemetry.spans import on its own hot imports.
+_TELEMETRY_SHIMS = frozenset(
+    {"span", "enable_tracing", "disable_tracing", "active_tracer",
+     "Tracer"})
+
+
+def __getattr__(name: str):
+    if name in _TELEMETRY_SHIMS:
+        warnings.warn(
+            f"distkeras_tpu.tracing.{name} is deprecated; import it from "
+            f"distkeras_tpu.telemetry (it has been a pure re-export since "
+            f"the telemetry unification)",
+            DeprecationWarning, stacklevel=2)
+        from distkeras_tpu.telemetry import spans as _spans
+
+        return getattr(_spans, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "StepTimer",

@@ -41,6 +41,22 @@ def rng():
 
 
 @pytest.fixture
+def artifact_dir(tmp_path):
+    """Where observability artifacts (flight-recorder dumps, metrics
+    snapshots, Chrome traces) land. CI sets DISTKERAS_TEST_ARTIFACTS and
+    uploads the directory when the suite fails, so a red serving test
+    ships its black box with the failure; locally it is just tmp_path."""
+    import pathlib
+
+    out = os.environ.get("DISTKERAS_TEST_ARTIFACTS")
+    if out:
+        path = pathlib.Path(out)
+        path.mkdir(parents=True, exist_ok=True)
+        return path
+    return tmp_path
+
+
+@pytest.fixture
 def toy_classification(rng):
     """Linearly separable 2-class problem: fast convergence sanity checks."""
     from distkeras_tpu.data.dataset import Dataset

@@ -95,7 +95,12 @@ def test_gspmd_loss_matches_single_device():
     )
     from distkeras_tpu.training.step import TrainState, make_train_step
 
-    model = bert_tiny_mlm(seq_len=8, vocab_size=64)
+    # dropout_rate=0: dropout masks are the one train-step computation
+    # whose random bits legitimately differ between sharded and
+    # unsharded lowerings on jax 0.4.x (legacy threefry generates
+    # different bits once GSPMD shards the mask op, ~1e-3 relative on
+    # this loss) — zeroing it makes the parity check deterministic.
+    model = bert_tiny_mlm(seq_len=8, vocab_size=64, dropout_rate=0.0)
     opt = get_optimizer("sgd", 0.1)
     rng = np.random.default_rng(1)
     feats = rng.integers(0, 64, size=(4, 8)).astype(np.int32)
@@ -115,6 +120,9 @@ def test_gspmd_loss_matches_single_device():
         s2,
         {"features": jax.device_put(feats, sh), "label": jax.device_put(labels, sh)},
     )
+    # Tight bound: with dropout off the computation is deterministic, so
+    # any layout-dependent divergence is a real bug (the sharded-init
+    # divergence fixed in parallel/gspmd.py was ~7e-3 relative here).
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=2e-4)
 
 
