@@ -30,10 +30,21 @@ CPU demo run IS the acceptance test:
    token-for-token for the same prompts — including chunked
    (``--prefill-chunk``) and prefix-cached admission.
 
+``--paged``/``--kv-pool-mb`` switch the engine to **paged KV** (one
+block pool for decode slots and the prefix cache; oversubscription with
+preempt-and-requeue), and ``--slot-sweep N1,N2,...`` measures the paged
+headline directly: at a FIXED KV byte budget, which slot counts sustain
+full completion, at what saturated p99 ITL, and how many resident
+tokens per MiB the budget actually carried (``kv_tokens_per_mib``).
+Pair with a dense run at the same bytes (``--max-context`` fixes its
+per-slot cache) for the capacity-multiplier comparison.
+
 ``--record-history`` appends the run's headline numbers (TTFT/ITL
-percentiles, goodput, hit rate) to ``bench_history.json`` under
-``serving/...`` keys; ``scripts/check_bench_regression.py`` diffs them
-against the prior same-config run (direction-aware: latency up = bad).
+percentiles, goodput, hit rate — and the sweep's max-sustained-slots /
+tokens-per-MiB rows) to ``bench_history.json`` under ``serving/...``
+keys (``serving/paged_*`` for paged runs);
+``scripts/check_bench_regression.py`` diffs them against the prior
+same-config run (direction-aware: latency up = bad).
 
 ``--replicas N`` (N >= 2) swaps the single engine for an **in-process
 cluster**: N engines behind the supervised router
@@ -76,15 +87,22 @@ def _model(args):
     return model, model.init(0)
 
 
-def _make_engine(args, model, variables, metrics=None, trace_store=None):
+def _make_engine(args, model, variables, metrics=None, trace_store=None,
+                 slots=None):
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
+    paged = args.paged or args.kv_pool_mb > 0
     return ServingEngine(
-        model, variables, slots=args.slots, max_queue=args.max_queue,
+        model, variables, slots=slots or args.slots,
+        max_queue=args.max_queue,
         metrics=metrics or ServingMetrics(),
         prefill_chunk=args.prefill_chunk,
-        prefix_cache_mb=args.prefix_cache_mb,
+        prefix_cache_mb=0.0 if paged else args.prefix_cache_mb,
         prefix_block_tokens=args.prefix_block,
+        paged=paged,
+        kv_pool_mb=args.kv_pool_mb or (8.0 if paged else 0.0),
+        kv_block_tokens=args.kv_block,
+        max_context=args.max_context,
         trace_store=trace_store,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
 
@@ -360,10 +378,108 @@ async def _cluster_bench(args, report):
     return model, variables, all_results
 
 
+async def _sweep_point(args, model, variables, slots, salt):
+    """One max-concurrent-slots point: a fresh engine at ``slots`` under
+    the SAME KV byte budget, saturated closed-loop (>= one client per
+    slot), full completion required to count as sustained. Preemptions
+    are allowed — they are the oversubscription mechanism — but every
+    stream must still finish, token-identical (checked by the caller)."""
+    from distkeras_tpu.serving import (
+        PoolExhausted, QueueFullError, ServingError,
+    )
+
+    engine = _make_engine(args, model, variables, slots=slots)
+    prompts = _prompts(args, args.requests, salt=salt)
+    task = asyncio.create_task(engine.run())
+    results, failures, oom = [], 0, 0
+    it = iter(prompts)
+
+    async def client():
+        nonlocal failures, oom
+        for p in it:
+            try:
+                req = engine.submit(p, args.new_tokens)
+                results.append((p, await req.result()))
+            except PoolExhausted:
+                oom += 1
+            except (QueueFullError, ServingError):
+                failures += 1
+
+    t0 = time.monotonic()
+    await asyncio.gather(
+        *(client() for _ in range(max(args.clients, slots))))
+    elapsed = time.monotonic() - t0
+    engine.shutdown(drain=True)
+    await task
+    s = engine.metrics.summary()
+    point = {
+        "slots": slots,
+        "completed": len(results),
+        "requests": len(prompts),
+        "oom_rejected": oom,
+        "failed": failures,
+        "kv_preemptions": int(s.get("kv_preemptions", 0)),
+        "sustained": (len(results) == len(prompts)
+                      and oom == 0 and failures == 0),
+        "wall_s": round(elapsed, 3),
+        "goodput_tokens_per_sec": round(
+            sum(len(t) for _, t in results) / elapsed, 2),
+    }
+    for key in ("inter_token_p99_s", "inter_token_p50_s", "ttft_p99_s"):
+        if key in s:
+            point[key] = round(s[key], 6)
+    if engine.kv_pool is not None:
+        st = engine.kv_pool.stats()
+        point["peak_blocks_used"] = st["peak_blocks_used"]
+        point["kv_bytes"] = st["capacity_blocks"] * st["bytes_per_block"]
+        point["peak_resident_tokens"] = (st["peak_blocks_used"]
+                                         * st["block_tokens"])
+    return point, results
+
+
+async def _run_slot_sweep(args, model, variables, report):
+    counts = sorted({int(x) for x in args.slot_sweep.split(",") if x})
+    points, all_results = [], []
+    for i, slots in enumerate(counts):
+        point, results = await _sweep_point(args, model, variables, slots,
+                                            salt=1000 + i)
+        points.append(point)
+        all_results.extend(results)
+    sustained = [p["slots"] for p in points if p["sustained"]]
+    sweep = {
+        "kv_pool_mb": args.kv_pool_mb or (8.0 if args.paged else 0.0),
+        "paged": bool(args.paged or args.kv_pool_mb > 0),
+        "points": points,
+        "max_slots_sustained": max(sustained) if sustained else 0,
+    }
+    best = next((p for p in reversed(points)
+                 if p["slots"] == sweep["max_slots_sustained"]), None)
+    if best is not None:
+        if "inter_token_p99_s" in best:
+            sweep["sustained_inter_token_p99_s"] = best["inter_token_p99_s"]
+        sweep["sustained_goodput_tokens_per_sec"] = (
+            best["goodput_tokens_per_sec"])
+        if best.get("kv_bytes") and best.get("peak_resident_tokens"):
+            # Tokens-per-byte, the paged headline: resident KV tokens the
+            # budget actually carried at peak, per MiB of pool.
+            sweep["kv_tokens_per_mib"] = round(
+                best["peak_resident_tokens"] / (best["kv_bytes"] / 2**20),
+                2)
+    report["slot_sweep"] = sweep
+    return all_results
+
+
 # Headline metrics worth a drift gate, per mode section of the report.
 _HISTORY_METRICS = (
     "ttft_p50_s", "ttft_p99_s", "inter_token_p50_s", "inter_token_p99_s",
     "prefill_device_p50_s", "goodput_tokens_per_sec", "prefix_hit_rate",
+)
+
+# Sweep-level rows: concurrency-at-fixed-bytes and tokens-per-byte (both
+# higher-is-better; the p99 ITL at the sustained max is latency-shaped).
+_SWEEP_METRICS = (
+    "max_slots_sustained", "sustained_inter_token_p99_s",
+    "sustained_goodput_tokens_per_sec", "kv_tokens_per_mib",
 )
 
 
@@ -384,10 +500,15 @@ def _record_history(args, report):
 
     path = os.path.join(root, "bench_history.json")
     hist = bench.load_history(path)
-    base = f"serving/{args.model}/slots{args.slots}"
+    paged = args.paged or args.kv_pool_mb > 0
+    model_tag = f"paged_{args.model}" if paged else args.model
+    base = f"serving/{model_tag}/slots{args.slots}"
+    if paged:
+        base += (f"/pool{args.kv_pool_mb or 8:g}mb"
+                 f"/block{args.kv_block}")
     if args.prefix_ratio > 0:
         base += f"/prefix{args.prefix_ratio:g}x{args.prefix_count}"
-    if args.prefix_cache_mb > 0:
+    if args.prefix_cache_mb > 0 and not paged:
         base += f"/cache{args.prefix_cache_mb:g}mb"
     if args.prefill_chunk:
         base += f"/chunk{args.prefill_chunk}"
@@ -401,6 +522,14 @@ def _record_history(args, report):
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 continue
             key = f"{base}/{mode}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    sweep = report.get("slot_sweep")
+    if isinstance(sweep, dict):
+        for metric in _SWEEP_METRICS:
+            v = sweep.get(metric)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            key = f"{base}/sweep/{metric}"
             hist[key] = bench.history_entry(hist.get(key), float(v), when)
     bench.write_history(path, hist)
 
@@ -436,6 +565,29 @@ def main():
                     help="engine prefix-cache byte budget (MB); 0 = off")
     ap.add_argument("--prefix-block", type=int, default=16,
                     help="prefix-cache block granularity (tokens)")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV: slots allocate blocks from one "
+                         "shared pool (which doubles as the prefix "
+                         "cache); default 8 MB budget unless "
+                         "--kv-pool-mb is given")
+    ap.add_argument("--kv-pool-mb", type=float, default=0.0,
+                    help="paged-KV pool byte budget (MB); > 0 implies "
+                         "--paged")
+    ap.add_argument("--kv-block", type=int, default=16,
+                    help="paged-KV block granularity (tokens)")
+    ap.add_argument("--max-context", type=int, default=None,
+                    help="per-request context cap; in DENSE mode also "
+                         "the pre-reserved per-slot cache length — the "
+                         "knob that fixes the dense side of a "
+                         "slots-at-fixed-bytes comparison")
+    ap.add_argument("--slot-sweep", default=None, metavar="N1,N2,...",
+                    help="max-concurrent-slots-at-fixed-bytes sweep: "
+                         "re-run the closed-loop phase at each slot "
+                         "count with the SAME KV byte budget and report "
+                         "which counts sustain full completion (paged: "
+                         "pool budget fixed; dense: per-slot cache ~ "
+                         "--max-context) plus saturated p99 ITL per "
+                         "point")
     ap.add_argument("--replicas", type=int, default=0,
                     help=">= 2: drive an in-process cluster (N engines "
                          "behind the supervised router) over TCP instead "
@@ -480,6 +632,10 @@ def main():
         "prefill_chunk": args.prefill_chunk,
         "prefix_cache_mb": args.prefix_cache_mb,
         "prefix_block": args.prefix_block,
+        "paged": bool(args.paged or args.kv_pool_mb > 0),
+        "kv_pool_mb": args.kv_pool_mb,
+        "kv_block": args.kv_block,
+        "max_context": args.max_context,
         "replicas": args.replicas,
     }}
 
@@ -555,16 +711,25 @@ def main():
                    for k, v in summary.items()
                    if k.startswith(("ttft", "inter_token", "queue", "slot",
                                     "tokens_per_sec", "requests",
-                                    "prefill", "prefix", "slo"))},
+                                    "prefill", "prefix", "slo", "kv_"))},
             }
             engine.reopen()
         return all_results
 
     try:
         all_results = asyncio.run(run_all())
+        if args.slot_sweep:
+            # Fresh engines per point (slot count is compile-shape), own
+            # event loop; streams from every point join the parity check
+            # — preempt-and-requeue under sweep pressure must still be
+            # token-identical.
+            all_results.extend(asyncio.run(
+                _run_slot_sweep(args, model, variables, report)))
 
         if engine.prefix_cache is not None:
             report["prefix_cache"] = engine.prefix_cache.stats()
+        if engine.kv_pool is not None:
+            report["kv_pool"] = engine.kv_pool.stats()
         compiles = engine.decode_compile_count()
         report["decode_compile_count"] = compiles
         assert compiles in (1, -1), (

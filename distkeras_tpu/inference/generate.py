@@ -28,10 +28,14 @@ import numpy as np
 __all__ = ["generate", "beam_search", "Generator", "cache_with_index"]
 
 
-def _decode_module(model, slots: bool = False):
+def _decode_module(model, slots: bool = False, **overrides):
     """Decode-mode twin of ``model``'s module (same params, KV-cache
     attention). ``slots=True`` selects the per-slot vector-index variant
-    that the continuous-batching engine (serving/engine.py) steps."""
+    that the continuous-batching engine (serving/engine.py) steps;
+    ``overrides`` are extra BertConfig replacements (the engine's
+    ``decode_cache_len`` cap and ``paged_blocks``/``page_tokens``/
+    ``page_table_blocks`` paged-KV geometry — cache-variable shape knobs
+    only, params stay layout-identical to the trained model)."""
     from distkeras_tpu.models.bert import Bert, BertConfig
 
     cfg = getattr(model, "config", None)
@@ -47,7 +51,7 @@ def _decode_module(model, slots: bool = False):
         )
     dec_cfg = dataclasses.replace(
         cfg, decode=True, decode_slots=slots, dropout_rate=0.0,
-        ring_mesh=None, use_flash_attention=False,
+        ring_mesh=None, use_flash_attention=False, **overrides,
     )
     return Bert(dec_cfg), dec_cfg
 

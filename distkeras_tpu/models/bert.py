@@ -80,6 +80,27 @@ class BertConfig:
     # prefill and prefix-cache splice build on. Requires ``decode=True``;
     # params are still layout-identical to the training model.
     decode_slots: bool = False
+    # > 0: dense decode caches hold this many positions per slot instead
+    # of max_seq_len — lets a serving engine cap the pre-reserved
+    # per-slot KV bytes below the positional capacity (the padded max a
+    # dense engine can afford under a byte budget). Params (pos_embed in
+    # particular) are untouched; only the cache variables shrink.
+    decode_cache_len: int = 0
+    # > 0 selects PAGED decode (decode_slots only): K/V lives in a
+    # shared block pool of this many fixed-size blocks per layer
+    # ([paged_blocks, page_tokens, H, D] cache variables) instead of a
+    # dense [B, L, H, D] cache, addressed through per-row block tables.
+    # The module becomes position-stateless: the caller passes
+    # ``positions`` [B] (each row's write offset) and ``block_tables``
+    # [B, page_table_blocks] to every apply — traced arrays, so one
+    # compiled step serves every table layout (ops/attention.py
+    # paged_kv_update / paged_attention). Ids >= paged_blocks mark
+    # unallocated table entries; writes there are dropped.
+    paged_blocks: int = 0
+    page_tokens: int = 16
+    # Block-table length per row: virtual context = page_table_blocks *
+    # page_tokens. Required (> 0) when paged_blocks > 0.
+    page_table_blocks: int = 0
 
 
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
@@ -98,7 +119,8 @@ class SelfAttention(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False):
+    def __call__(self, x, mask=None, train: bool = False,
+                 positions=None, block_tables=None):
         cfg = self.cfg
         head_dim = cfg.hidden_size // cfg.num_heads
         qkv_axes = ("embed", "heads")
@@ -109,7 +131,8 @@ class SelfAttention(nn.Module):
         shape = (B, S, cfg.num_heads, head_dim)
         if cfg.decode:
             out = self._decode_attention(
-                q.reshape(shape), k.reshape(shape), v.reshape(shape)
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                positions=positions, block_tables=block_tables,
             )
         elif cfg.ring_mesh is not None and mask is None:
             if cfg.sp_impl == "ulysses":
@@ -172,7 +195,7 @@ class SelfAttention(nn.Module):
         out = out.reshape(B, S, cfg.hidden_size)
         return _dense(cfg.hidden_size, ("heads", "embed"), "out", cfg.dtype)(out)
 
-    def _decode_attention(self, q, k, v):
+    def _decode_attention(self, q, k, v, positions=None, block_tables=None):
         """KV-cache attention for incremental decoding. One generic path
         serves prefill (S = prompt length, cache index 0) and per-token
         decode (S = 1): new K/V write at the cache index, the query attends
@@ -189,13 +212,45 @@ class SelfAttention(nn.Module):
         module, provided the cache rows ``[0, n)`` hold that prefix's
         K/V (e.g. spliced from ``serving.prefix_cache.PrefixCache``).
         Garbage rows at ``>= n`` stay invisible: ``k_pos <= q_pos`` masks
-        every position not yet written by a real token."""
+        every position not yet written by a real token.
+
+        Paged mode (``cfg.paged_blocks > 0``): the cache variables are
+        the shared block pools ``[C, page_tokens, H, D]`` and the module
+        is position-stateless — ``positions``/``block_tables`` come from
+        the caller as traced arrays, the write is a dropped-OOB scatter,
+        and the read is a gather over the row's block table
+        (ops/attention.py). The ``k_pos <= q_pos`` mask is unchanged, so
+        paged greedy output is token-identical to the dense path over
+        the same resident K/V."""
         import jax
         import jax.lax as lax
 
         cfg = self.cfg
         B, S, H, D = q.shape
-        L = cfg.max_seq_len
+        if cfg.paged_blocks > 0:
+            from distkeras_tpu.ops.attention import (
+                paged_attention,
+                paged_kv_update,
+            )
+
+            C, bt = cfg.paged_blocks, cfg.page_tokens
+            pk = self.variable("cache", "pool_key", jnp.zeros,
+                               (C, bt, H, D), cfg.dtype)
+            pv = self.variable("cache", "pool_value", jnp.zeros,
+                               (C, bt, H, D), cfg.dtype)
+            if self.is_initializing():
+                return dot_product_attention(q, k, v, causal=True)
+            if positions is None or block_tables is None:
+                raise ValueError(
+                    "paged decode needs positions [B] and block_tables "
+                    "[B, T] passed to every apply")
+            pk.value = paged_kv_update(pk.value, k, block_tables,
+                                       positions, bt)
+            pv.value = paged_kv_update(pv.value, v, block_tables,
+                                       positions, bt)
+            return paged_attention(q, pk.value, pv.value, block_tables,
+                                   positions)
+        L = cfg.decode_cache_len or cfg.max_seq_len
         ck = self.variable("cache", "cached_key", jnp.zeros, (B, L, H, D), cfg.dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros, (B, L, H, D), cfg.dtype)
         idx_shape = (B,) if cfg.decode_slots else ()
@@ -242,10 +297,13 @@ class EncoderLayer(nn.Module):
     ep_size: int = 1
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False):
+    def __call__(self, x, mask=None, train: bool = False,
+                 positions=None, block_tables=None):
         cfg = self.cfg
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
-        y = SelfAttention(cfg, name="attention")(y, mask=mask, train=train)
+        y = SelfAttention(cfg, name="attention")(
+            y, mask=mask, train=train,
+            positions=positions, block_tables=block_tables)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
@@ -282,7 +340,8 @@ class Bert(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, token_ids, train: bool = False):
+    def __call__(self, token_ids, train: bool = False,
+                 positions=None, block_tables=None):
         cfg = self.cfg
         token_ids = token_ids.astype(jnp.int32)
         embed = nn.Embed(
@@ -303,7 +362,27 @@ class Bert(nn.Module):
             jnp.float32,
         )
         S = token_ids.shape[1]
-        if cfg.decode:
+        if cfg.decode and cfg.paged_blocks > 0:
+            # Paged decode is position-stateless: the engine passes each
+            # row's write offset explicitly, so the positional slice
+            # comes from ``positions`` and no index variable exists —
+            # admission/preemption never have to splice counters, only
+            # hand in different (traced) values.
+            if self.is_initializing():
+                pos = pos_embed[:, :S]
+            else:
+                import jax
+                import jax.lax as lax
+
+                if positions is None:
+                    raise ValueError("paged decode needs positions [B]")
+                pos = jax.vmap(
+                    lambda i: lax.dynamic_slice(
+                        pos_embed[0], (i, 0), (S, cfg.hidden_size)
+                    )
+                )(positions)  # [B, S, H]
+            x = embed(token_ids) + pos.astype(cfg.dtype)
+        elif cfg.decode:
             # Positions advance with the KV caches: a cache-collection
             # counter offsets the positional slice per apply (a vector of
             # per-slot counters under decode_slots — each batch row slices
@@ -352,7 +431,9 @@ class Bert(nn.Module):
             sp = dict(cfg.ring_mesh.shape)[cfg.ring_axis]
             x = stripe_shard(x, sp)
         for i in range(cfg.num_layers):
-            x = EncoderLayer(cfg, name=f"layer_{i}")(x, train=train)
+            x = EncoderLayer(cfg, name=f"layer_{i}")(
+                x, train=train,
+                positions=positions, block_tables=block_tables)
         if striped:
             from distkeras_tpu.ops.ring_flash import stripe_unshard
 

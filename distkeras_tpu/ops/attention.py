@@ -25,6 +25,8 @@ from jax import lax
 
 __all__ = [
     "dot_product_attention",
+    "paged_attention",
+    "paged_kv_update",
     "ring_attention",
     "ring_self_attention",
     "sp_batch_spec",
@@ -62,6 +64,69 @@ def dot_product_attention(q, k, v, mask=None, causal: bool = False):
         scores = jnp.where(mask, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def paged_kv_update(pool, new, tables, positions, page_tokens: int):
+    """Scatter per-row K (or V) vectors into a paged block pool.
+
+    ``pool``: ``[C, page_tokens, H, D]`` — the shared block pool (row ``c``
+    is one ``page_tokens``-token block). ``new``: ``[B, S, H, D]`` — each
+    batch row's ``S`` new K/V vectors. ``tables``: int32 ``[B, T]`` —
+    row ``b``'s block table: entry ``t`` is the pool row holding its
+    virtual positions ``[t*page_tokens, (t+1)*page_tokens)``; any id
+    ``>= C`` marks an unallocated table entry. ``positions``: int32
+    ``[B]`` — the virtual position row ``b``'s first new vector writes at.
+
+    All indices are traced, so ONE compiled program serves every table
+    layout and every offset — the property that keeps the serving
+    engine's decode step at one executable while blocks chain and move.
+
+    Writes that land outside a row's allocated blocks (right-padded
+    prefill garbage past the prompt's last block, or a freed slot whose
+    table is all-sentinel) are DROPPED wholesale (``mode="drop"``), so a
+    row can never scribble on a block it does not own — the paged
+    equivalent of the dense cache's "garbage stays in your own row"
+    discipline.
+    """
+    C = pool.shape[0]
+    T = tables.shape[1]
+    pos = positions[:, None] + jnp.arange(new.shape[1])[None, :]  # [B, S]
+    blk = pos // page_tokens
+    rows = jnp.take_along_axis(tables, jnp.minimum(blk, T - 1), axis=1)
+    # Past the table's reach: force an out-of-range pool row so the
+    # scatter drops the write instead of clamping into a real block.
+    rows = jnp.where(blk < T, rows, C)
+    offs = pos % page_tokens
+    return pool.at[rows, offs].set(new.astype(pool.dtype), mode="drop")
+
+
+def paged_attention(q, pool_k, pool_v, tables, positions):
+    """Attention over paged (block-pooled) K/V: the serving engine's
+    decode-slot read path when KV lives in a shared block pool instead of
+    a dense per-slot ``[B, L, H, D]`` cache.
+
+    ``q``: ``[B, S, H, D]`` queries whose first token sits at virtual
+    position ``positions[b]`` (int32 ``[B]``). ``pool_k``/``pool_v``:
+    ``[C, bt, H, D]`` block pools. ``tables``: int32 ``[B, T]`` per-row
+    block tables (ids ``>= C`` = unallocated; the gather clamp reads an
+    arbitrary real block there, and the position mask hides it).
+
+    Each row's virtual K/V ``[T*bt, H, D]`` is gathered in table order —
+    position order, exactly the dense cache's layout — and masked with
+    the same ``k_pos <= q_pos`` rule the dense decode path uses, so for
+    any masked-out tail the softmax contributions are exactly zero and
+    the output is bitwise identical to dense attention over the same
+    resident K/V. One compiled program for every table layout.
+    """
+    B, S = q.shape[0], q.shape[1]
+    bt = pool_k.shape[1]
+    T = tables.shape[1]
+    k = pool_k[tables].reshape((B, T * bt) + pool_k.shape[2:])
+    v = pool_v[tables].reshape((B, T * bt) + pool_v.shape[2:])
+    q_pos = positions[:, None] + jnp.arange(S)[None, :]  # [B, S]
+    k_pos = jnp.arange(T * bt)
+    mask = k_pos[None, None, None, :] <= q_pos[:, None, :, None]  # [B,1,S,L]
+    return dot_product_attention(q, k, v, mask=mask)
 
 
 def _block_attn_update(q, k_blk, v_blk, acc, m, denom, scale, mask=None):

@@ -99,6 +99,24 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "blocks instead of recomputing prefill")
     ap.add_argument("--prefix-block", type=int, default=16,
                     help="prefix-cache block granularity in tokens")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV: decode slots allocate fixed-size "
+                         "blocks from ONE shared pool (which doubles as "
+                         "the prefix cache) via per-slot block tables — "
+                         "capacity scales with resident tokens, the pool "
+                         "may be oversubscribed (preempt-and-requeue), "
+                         "and long-context requests chain blocks up to "
+                         "the trained context")
+    ap.add_argument("--kv-pool-mb", type=float, default=0.0,
+                    help="paged-KV pool byte budget (MB); > 0 implies "
+                         "--paged. See docs/serving.md 'KV pool sizing'")
+    ap.add_argument("--kv-block-tokens", type=int, default=16,
+                    help="paged-KV block granularity in tokens")
+    ap.add_argument("--max-context", type=int, default=None,
+                    help="cap per-request context below the trained "
+                         "length; in dense mode also shrinks the "
+                         "pre-reserved per-slot KV cache to this many "
+                         "positions")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=default_replicas,
                     help="> 1: start this many replica processes behind a "
@@ -207,14 +225,20 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
             capacity=recorder_cap,
             dump_path=args.flight_dump,
             source=f"serve:{args.model}:pid{os.getpid()}")
+    # --paged with no explicit budget gets a sane default pool; an
+    # explicit --kv-pool-mb implies --paged.
+    kv_pool_mb = args.kv_pool_mb or (64.0 if args.paged else 0.0)
     engine = ServingEngine(
         model, variables, slots=args.slots, max_queue=args.max_queue,
         top_k=args.top_k, metrics=metrics, seed=args.seed,
         auditor=auditor,
         arm_auditor_after_warmup=args.audit_recompiles == "arm",
         prefill_chunk=args.prefill_chunk,
-        prefix_cache_mb=args.prefix_cache_mb,
+        prefix_cache_mb=0.0 if kv_pool_mb else args.prefix_cache_mb,
         prefix_block_tokens=args.prefix_block,
+        kv_pool_mb=kv_pool_mb,
+        kv_block_tokens=args.kv_block_tokens,
+        max_context=args.max_context,
         trace_store=trace_store, flight_recorder=recorder,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
     server = ServingServer(engine, host=args.host, port=args.port)
@@ -228,6 +252,9 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
             "slots": args.slots, "max_queue": args.max_queue,
             "prefill_chunk": args.prefill_chunk,
             "prefix_cache_mb": args.prefix_cache_mb,
+            "kv_pool_mb": kv_pool_mb,
+            "kv_pool_blocks": (engine.kv_pool.capacity
+                               if engine.kv_pool is not None else 0),
         }), flush=True)
         # Signal-driven shutdown INSIDE the loop: a raw KeyboardInterrupt
         # out of asyncio.run would cancel the engine task before the
@@ -244,6 +271,8 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         summary = {k: round(v, 6) for k, v in metrics.summary().items()}
         if engine.prefix_cache is not None:
             summary["prefix_cache"] = engine.prefix_cache.stats()
+        if engine.kv_pool is not None:
+            summary["kv_pool"] = engine.kv_pool.stats()
         if auditor is not None:
             summary["recompile_audit"] = auditor.report()
         print(json.dumps(summary), flush=True)
@@ -318,6 +347,13 @@ def cluster_main(args) -> int:
             extra += ["--top-k", str(args.top_k)]
         if args.prefill_chunk is not None:
             extra += ["--prefill-chunk", str(args.prefill_chunk)]
+        if args.paged or args.kv_pool_mb:
+            if args.paged:
+                extra += ["--paged"]
+            extra += ["--kv-pool-mb", str(args.kv_pool_mb),
+                      "--kv-block-tokens", str(args.kv_block_tokens)]
+        if args.max_context is not None:
+            extra += ["--max-context", str(args.max_context)]
         if args.audit_recompiles:
             extra += ["--audit-recompiles", args.audit_recompiles]
         if args.slo_ms is not None:

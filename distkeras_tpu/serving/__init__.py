@@ -15,6 +15,13 @@ request path:
   keyed by a radix trie over prompt prefixes (ref-counted, LRU-evicted
   under a byte budget): a hit splices cached blocks instead of
   recomputing the shared prefix's prefill;
+- :class:`KVBlockPool` — the paged-KV generalization
+  (``ServingEngine(kv_pool_mb=...)``): decode slots allocate their KV
+  from the SAME block pool through per-slot block tables, prefix hits
+  become zero-copy shared blocks, the pool may be oversubscribed
+  (preempt-and-requeue, typed ``kv_oom`` rejects past capacity), and
+  long-context requests chain blocks up to the trained context instead
+  of being bounded by a padded per-slot max;
 - :class:`Scheduler` / :class:`Request` — priority-FIFO admission with
   max-depth backpressure, per-request deadlines, and (with a prefix
   cache) bounded cache-aware reordering within a priority class;
@@ -31,6 +38,7 @@ request path:
 
 from distkeras_tpu.serving.scheduler import (
     EngineStopped,
+    PoolExhausted,
     QueueFullError,
     Request,
     RequestCancelled,
@@ -39,7 +47,7 @@ from distkeras_tpu.serving.scheduler import (
     ServingError,
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
-from distkeras_tpu.serving.prefix_cache import PrefixCache
+from distkeras_tpu.serving.prefix_cache import KVBlockPool, PrefixCache
 from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.server import ServingServer
 from distkeras_tpu.serving.client import ServingClient
@@ -59,6 +67,8 @@ __all__ = [
     "LocalReplica",
     "ProcessReplica",
     "PrefixCache",
+    "KVBlockPool",
+    "PoolExhausted",
     "Scheduler",
     "Request",
     "ServingServer",

@@ -50,10 +50,15 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
     slots = dz.get("slots", [])
     if slots:
         lines.append(f"{indent}slots:")
-        for ln in _table(slots, [("slot", "slot"), ("state", "state"),
-                                 ("trace_id", "trace_id"),
-                                 ("depth", "depth"), ("age_s", "age_s"),
-                                 ("remaining", "remaining")]):
+        cols = [("slot", "slot"), ("state", "state"),
+                ("trace_id", "trace_id"),
+                ("depth", "depth"), ("age_s", "age_s"),
+                ("remaining", "remaining")]
+        if any("blocks" in s for s in slots):
+            # Paged engine: per-slot block-table depth (total blocks the
+            # slot addresses / how many are shared prefix blocks).
+            cols += [("blocks", "blocks"), ("shared", "shared_blocks")]
+        for ln in _table(slots, cols):
             lines.append(f"{indent}  {ln}")
     queued = q.get("queued", [])
     if queued:
@@ -70,6 +75,23 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
             f"{pc.get('capacity_blocks')} blocks "
             f"({pc.get('families')} families)")
         fams = pc.get("top_families", [])
+        if fams:
+            for ln in _table(fams, [("family_head", "family_head"),
+                                    ("blocks", "blocks"),
+                                    ("tokens", "tokens"),
+                                    ("pins", "pinned_refs"),
+                                    ("depth", "max_chain_depth")]):
+                lines.append(f"{indent}  {ln}")
+    kp = dz.get("kv_pool")
+    if kp:
+        lines.append(
+            f"{indent}kv_pool: {kp.get('blocks_used')}/"
+            f"{kp.get('capacity_blocks')} blocks used "
+            f"({kp.get('blocks_free')} free, "
+            f"{kp.get('families')} prefix families, "
+            f"{kp.get('preemptions', 0)} preemptions, "
+            f"{kp.get('oom_rejections', 0)} oom rejects)")
+        fams = kp.get("top_families", [])
         if fams:
             for ln in _table(fams, [("family_head", "family_head"),
                                     ("blocks", "blocks"),

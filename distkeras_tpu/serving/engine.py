@@ -31,6 +31,31 @@ step for the lifetime of the server) while making the batch *open*:
   of a fixed-shape batch, and exactly the trade the training side makes
   with padded microbatches.
 
+**Paged KV mode** (``kv_pool_mb``/``paged``) replaces the dense
+``[slots, L, H, D]`` per-slot cache — which pays worst-case length for
+every slot up front — with ONE block pool shared by decode slots and the
+prefix cache (:class:`~distkeras_tpu.serving.prefix_cache.KVBlockPool`):
+
+- each slot's KV lives in fixed-size blocks addressed through a per-slot
+  block table; the compiled decode step gathers K/V via traced table
+  indices (:func:`distkeras_tpu.ops.attention.paged_attention`), so the
+  single-compiled-decode-step invariant survives with paging on;
+- capacity scales with *resident tokens*, not ``slots × max_seq_len`` —
+  more concurrent slots per byte, and **long-context admission**:
+  requests may use the model's whole trained context because blocks are
+  chained on demand, never pre-reserved;
+- prefix-cache hits are **zero-copy** (the table points at the shared
+  ref-counted blocks) and a finished slot's blocks are **adopted** into
+  the trie in place (zero-copy insert);
+- the pool may be **oversubscribed**: when it runs dry, the engine
+  preempts the lowest-priority youngest slot — its complete blocks are
+  adopted (so re-admission re-matches them), the rest freed, and the
+  request requeued at the front of its priority class
+  (``Scheduler.requeue``); already-streamed tokens are folded into the
+  resume prefill, so greedy output stays token-identical across the
+  round trip. Requests whose full context can never fit are rejected
+  with the typed ``kv_oom`` error at submit.
+
 Per-request sampling: ``temperature <= 0`` rows take the argmax branch
 inside the same compiled step (a ``jnp.where`` select, not a retrace), so
 greedy and sampled requests coexist in one batch. ``top_k`` is
@@ -60,7 +85,7 @@ from distkeras_tpu.inference.generate import (
     sample_rows,
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
-from distkeras_tpu.serving.prefix_cache import PrefixCache
+from distkeras_tpu.serving.prefix_cache import KVBlockPool, PrefixCache
 from distkeras_tpu.telemetry import (
     FlightRecorder,
     RecompileAuditor,
@@ -70,6 +95,7 @@ from distkeras_tpu.telemetry import (
 )
 from distkeras_tpu.serving.scheduler import (
     EngineStopped,
+    PoolExhausted,
     Request,
     RequestCancelled,
     RequestTimeout,
@@ -140,6 +166,50 @@ def _decode_fn(module, top_k, params, cache, tokens, temps, key):
     return mut["cache"], nxt
 
 
+def _paged_prefill_fn(module, top_k, params, pools, padded, start, true_len,
+                      table_row, temp, key):
+    """Paged twin of :func:`_prefill_fn`: the chunk's K/V writes straight
+    into the shared block pool through the slot's block table (no
+    single-row scratch cache, no splice afterwards — admission IS the
+    table row). ``start``/``true_len``/``table_row`` are traced, so ONE
+    program serves every offset, true length, and block layout of a
+    given pad width. Right-padded garbage past the table's allocated
+    blocks is dropped by the scatter; garbage inside the tail block is
+    masked (``k_pos <= q_pos``) until real tokens overwrite it — the
+    same discipline as the dense path."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, padded, train=False,
+        mutable=["cache"],
+        positions=jnp.full((1,), start, jnp.int32),
+        block_tables=table_row[None],
+    )
+    last = jnp.take(logits[0], true_len - 1, axis=0)[None]  # [1, V]
+    tok = sample_rows(last, temp[None], key, top_k)[0]
+    return mut["cache"], tok
+
+
+def _paged_admit_fn(tokens, temps, slot, tok, temp):
+    """Paged admission epilogue: only the sampling state changes — the
+    KV is already resident in the pool, so there is nothing to splice."""
+    return tokens.at[slot].set(tok), temps.at[slot].set(temp)
+
+
+def _paged_decode_fn(module, top_k, params, pools, tokens, temps, positions,
+                     tables, key):
+    """Paged twin of :func:`_decode_fn`: K/V appends scatter into the
+    pool at each row's (traced) position and attention gathers through
+    the (traced) block tables — one compiled executable for every table
+    layout, admission pattern, and context length, which is what keeps
+    the armed ``RecompileAuditor`` silent while blocks chain, slots are
+    preempted, and long contexts grow."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, tokens[:, None], train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+    )
+    nxt = sample_rows(logits[:, -1], temps, key, top_k)
+    return mut["cache"], nxt
+
+
 @dataclasses.dataclass
 class _PrefillJob:
     """Partial-prefill progress for a slot still being admitted: the
@@ -163,6 +233,16 @@ class _SlotState:
     # admission): the row sits in the decode batch but its garbage output
     # is discarded until the finished cache is spliced in.
     prefill: _PrefillJob | None = None
+    # Paged mode: when this slot was admitted (preemption prefers the
+    # YOUNGEST victim — least sunk work thrown away), the private block
+    # ids it owns (block indices first_block, first_block+1, ... of its
+    # table), and the pinned shared-prefix match its table head points
+    # at (released only at slot teardown — the pin is what stops
+    # eviction from reallocating a block the decode step still reads).
+    t_admit: float = 0.0
+    blocks: list = dataclasses.field(default_factory=list)
+    first_block: int = 0
+    match: object | None = None
 
 
 class ServingEngine:
@@ -192,6 +272,22 @@ class ServingEngine:
     ``prefix_cache=`` to inject a pre-built pool (exact capacity
     control, test fixtures); the cache is NOT thread-safe — it must be
     driven by a single engine's loop at a time.
+
+    ``kv_pool_mb`` > 0 (or ``paged=True`` with ``kv_pool_blocks``)
+    selects **paged KV**: slots allocate fixed-size blocks
+    (``kv_block_tokens`` tokens) from ONE shared pool
+    (:class:`~distkeras_tpu.serving.prefix_cache.KVBlockPool`) instead
+    of a dense per-slot cache — see the module docstring for what that
+    buys (capacity ∝ resident tokens, zero-copy prefix sharing,
+    preempt-and-requeue oversubscription, long-context admission). In
+    paged mode prefix caching is inherent (``prefix_cache_mb`` is
+    subsumed by the pool budget; passing ``prefix_cache=`` is an error).
+
+    ``max_context``: cap each request's context (prompt + new tokens)
+    below the model's trained length. In DENSE mode this also shrinks
+    the pre-reserved per-slot cache to ``max_context`` positions — the
+    knob that makes a fixed KV byte budget an explicit trade between
+    slots and padded max length (the trade paged mode removes).
 
     Observability (all default-off; see :mod:`distkeras_tpu.telemetry`):
     ``trace_store`` keeps per-request timeline records queryable by
@@ -225,6 +321,11 @@ class ServingEngine:
         prefix_cache_mb: float = 0.0,
         prefix_block_tokens: int = 16,
         prefix_cache: PrefixCache | None = None,
+        paged: bool = False,
+        kv_pool_mb: float = 0.0,
+        kv_block_tokens: int = 16,
+        kv_pool_blocks: int | None = None,
+        max_context: int | None = None,
         trace_store: TraceStore | None = None,
         flight_recorder: FlightRecorder | None = None,
         slo_s: float | None = None,
@@ -235,7 +336,74 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
         self.model = model
-        self._module, self._cfg = _decode_module(model, slots=True)
+        self._paged = bool(paged or kv_pool_mb > 0 or kv_pool_blocks)
+        # Geometry probe: the plain decode-slots config, for the trained
+        # context limit and (paged) the per-token KV byte cost.
+        base_module, base_cfg = _decode_module(model, slots=True)
+        base_limit = _context_limit(model, base_cfg)
+        if max_context is not None:
+            if not 1 <= max_context <= base_limit:
+                raise ValueError(
+                    f"max_context={max_context} outside [1, trained "
+                    f"context {base_limit}]")
+            self.limit = int(max_context)
+        else:
+            self.limit = base_limit
+        if self._paged:
+            # Per-token KV byte cost from the UNCAPPED row geometry (the
+            # paged module's own cache leaves are pool-shaped, not
+            # row-shaped, so the budget math needs the dense twin).
+            row_shapes = jax.eval_shape(
+                lambda r: base_module.init(
+                    r, jnp.zeros((1, 1), jnp.int32), train=False),
+                jax.random.PRNGKey(0),
+            )["cache"]
+            if prefix_cache is not None:
+                raise ValueError(
+                    "paged mode subsumes the prefix cache (the KV pool "
+                    "IS the prefix cache); do not pass prefix_cache=")
+            if kv_block_tokens < 1:
+                raise ValueError(
+                    f"kv_block_tokens must be >= 1, got {kv_block_tokens}")
+            bt = int(kv_block_tokens)
+            table_blocks = -(-self.limit // bt)
+            kv_leaves = [a for a in jax.tree.leaves(row_shapes)
+                         if a.ndim > 1]
+            bytes_per_block = sum(
+                bt * int(np.prod(a.shape[2:])) * a.dtype.itemsize
+                for a in kv_leaves)
+            if kv_pool_blocks is not None:
+                capacity = int(kv_pool_blocks)
+            else:
+                capacity = int(kv_pool_mb * 2**20) // bytes_per_block
+            if capacity < 1:
+                raise ValueError(
+                    f"kv_pool_mb={kv_pool_mb} holds zero "
+                    f"{bt}-token blocks (one block = {bytes_per_block} "
+                    f"bytes)")
+            self._module, self._cfg = _decode_module(
+                model, slots=True, paged_blocks=capacity, page_tokens=bt,
+                page_table_blocks=table_blocks)
+            # Prefill pad-width bound. NOT the table reach (table_blocks
+            # * bt, which rounds UP past the context when bt doesn't
+            # divide it): a pad width past max_seq_len would make the
+            # positional dynamic_slice clamp BACKWARD and embed the
+            # chunk's real tokens at wrong positions. submit() caps
+            # every sequence at self.limit, so this loses nothing.
+            self._cache_len = self.limit
+            self.kv_block_tokens = bt
+            self._table_blocks = table_blocks
+            # Table sentinel: an id one past the pool marks "unallocated"
+            # — paged_kv_update drops writes there, paged_attention masks
+            # the reads.
+            self._sentinel = capacity
+        else:
+            overrides = ({"decode_cache_len": int(max_context)}
+                         if max_context is not None else {})
+            self._module, self._cfg = _decode_module(
+                model, slots=True, **overrides)
+            self._cache_len = (int(max_context) if max_context is not None
+                               else self._cfg.max_seq_len)
         if top_k is not None and not 1 <= top_k <= self._cfg.vocab_size:
             # Same bound generate() enforces: out-of-range top_k would
             # silently disable (or invert) the filtering via clamped
@@ -244,7 +412,6 @@ class ServingEngine:
                 f"top_k={top_k} outside [1, vocab_size={self._cfg.vocab_size}]"
             )
         self._params = variables["params"]
-        self.limit = _context_limit(model, self._cfg)
         self.slots = int(slots)
         self.metrics = metrics or ServingMetrics()
         self.scheduler = Scheduler(max_depth=max_queue,
@@ -254,43 +421,81 @@ class ServingEngine:
         self._prefill_rr = 0  # round-robin cursor over prefilling slots
         self._key = jax.random.PRNGKey(seed)
 
-        # Device-resident batch state.
+        # Device-resident batch state. In paged mode ``_cache`` holds the
+        # SHARED block pools (per-layer [capacity, bt, H, D] leaves, no
+        # per-slot index leaves — positions/tables are passed per call);
+        # in dense mode, the classic [slots, L, H, D] per-slot caches.
         self._cache = _empty_cache(self._module, self.slots)
         self._tokens = jnp.zeros((self.slots,), jnp.int32)
         self._temps = jnp.zeros((self.slots,), jnp.float32)
         self._slot_state: list[_SlotState | None] = [None] * self.slots
 
-        # Single-row cache geometry, captured ONCE: eval_shape traces the
-        # module's init, far too slow to re-run per admission. The zeroed
-        # cache itself comes from ONE jitted factory (fused device-side
-        # zeros, same cost profile as the zeros the prefill program used
-        # to create in-jit) instead of a per-leaf host dispatch per
-        # admission.
-        self._row_shapes = jax.eval_shape(
-            lambda r: self._module.init(
-                r, jnp.zeros((1, 1), jnp.int32), train=False),
-            jax.random.PRNGKey(0),
-        )["cache"]
-        self._fresh_row_cache = jax.jit(lambda: jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), self._row_shapes))
-
-        # Prefix cache: a byte-budgeted pool of KV blocks shared across
-        # requests (serving/prefix_cache.py). An explicit instance wins
-        # (tests / multi-engine sharing); prefix_cache_mb > 0 builds one.
-        if prefix_cache is not None:
-            self.prefix_cache = prefix_cache
-        elif prefix_cache_mb > 0:
-            self.prefix_cache = PrefixCache(
-                self._row_shapes, block_tokens=prefix_block_tokens,
-                budget_bytes=int(prefix_cache_mb * 2**20),
+        self.kv_pool: KVBlockPool | None = None
+        if self._paged:
+            self.kv_pool = KVBlockPool(
+                capacity, self.kv_block_tokens,
+                bytes_per_block=bytes_per_block,
                 registry=self.metrics.registry)
-        else:
+            # Host-side per-slot paging state: block tables (row i =
+            # slot i's pool row per block index, sentinel = unallocated)
+            # and written-KV lengths. The decode step gets (masked)
+            # device views of these each tick.
+            self._tables = np.full((self.slots, self._table_blocks),
+                                   self._sentinel, np.int32)
+            self._lens = np.zeros((self.slots,), np.int64)
+            # Admission parking: when a pop'd request could not get
+            # blocks (and nobody lower-priority was preemptible) it is
+            # requeued at its class head and admission pauses until the
+            # pool's version moves (a free, eviction-eligibility change,
+            # or adoption) — re-matching the same head request every
+            # iteration would only burn host time and skew hit stats.
+            self._parked_at_version: int | None = None
+            self._parked_req: Request | None = None
+            # Device-side masked table cache: tables only change on
+            # admission/growth/preemption/teardown, so the per-tick
+            # upload is skipped while the masked view is byte-identical
+            # to the last tick's (positions still upload every tick —
+            # they advance with each decoded token).
+            self._tables_host: np.ndarray | None = None
+            self._tables_dev = None
             self.prefix_cache = None
-        if self.prefix_cache is not None:
-            # Cache-aware admission: the scheduler may prefer (within one
-            # priority class, bounded window) the queued request whose
-            # prefix is already resident — see Scheduler.pop.
-            self.scheduler.cache_probe = self.prefix_cache.probe
+            self.scheduler.cache_probe = self.kv_pool.probe
+        else:
+            # Single-row cache geometry, captured ONCE: eval_shape traces
+            # the module's init, far too slow to re-run per admission.
+            # Derived from the SERVING module (so a max_context cap is
+            # reflected in the row length). The zeroed cache itself comes
+            # from ONE jitted factory (fused device-side zeros) — only
+            # paid on a prefix-cache MISS: a hit materializes its row
+            # cache straight from the matched pool blocks
+            # (PrefixCache.materialize), never building the covered
+            # leaves as zeros first.
+            self._row_shapes = jax.eval_shape(
+                lambda r: self._module.init(
+                    r, jnp.zeros((1, 1), jnp.int32), train=False),
+                jax.random.PRNGKey(0),
+            )["cache"]
+            self._fresh_row_cache = jax.jit(lambda: jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), self._row_shapes))
+
+            # Prefix cache: a byte-budgeted pool of KV blocks shared
+            # across requests (serving/prefix_cache.py). An explicit
+            # instance wins (tests / multi-engine sharing);
+            # prefix_cache_mb > 0 builds one.
+            if prefix_cache is not None:
+                self.prefix_cache = prefix_cache
+            elif prefix_cache_mb > 0:
+                self.prefix_cache = PrefixCache(
+                    self._row_shapes, block_tokens=prefix_block_tokens,
+                    budget_bytes=int(prefix_cache_mb * 2**20),
+                    registry=self.metrics.registry)
+            else:
+                self.prefix_cache = None
+            if self.prefix_cache is not None:
+                # Cache-aware admission: the scheduler may prefer (within
+                # one priority class, bounded window) the queued request
+                # whose prefix is already resident — see Scheduler.pop.
+                self.scheduler.cache_probe = self.prefix_cache.probe
 
         # One jit wrapper per engine so compile counts are per-instance:
         # the decode step must stay at exactly one executable for the
@@ -299,15 +504,26 @@ class ServingEngine:
         # call's outputs, and donation keeps the multi-MB KV caches
         # updating in place instead of copying per decoded token. _temps
         # is NOT donated in decode (it persists across iterations). The
-        # prefill's incoming single-row cache is donated too: a chunk
-        # chain threads one cache through every call, updating in place.
-        self._prefill = jax.jit(
-            functools.partial(_prefill_fn, self._module, top_k),
-            donate_argnums=(1,))
-        self._admit_jit = jax.jit(_admit_fn, donate_argnums=(0, 1, 2))
-        self._decode_step = jax.jit(
-            functools.partial(_decode_fn, self._module, top_k),
-            donate_argnums=(1, 2))
+        # prefill's incoming cache (single-row scratch in dense mode, the
+        # shared pools in paged mode) is donated too: a chunk chain
+        # threads it through every call, updating in place.
+        if self._paged:
+            self._prefill = jax.jit(
+                functools.partial(_paged_prefill_fn, self._module, top_k),
+                donate_argnums=(1,))
+            self._admit_jit = jax.jit(_paged_admit_fn,
+                                      donate_argnums=(0, 1))
+            self._decode_step = jax.jit(
+                functools.partial(_paged_decode_fn, self._module, top_k),
+                donate_argnums=(1, 2))
+        else:
+            self._prefill = jax.jit(
+                functools.partial(_prefill_fn, self._module, top_k),
+                donate_argnums=(1,))
+            self._admit_jit = jax.jit(_admit_fn, donate_argnums=(0, 1, 2))
+            self._decode_step = jax.jit(
+                functools.partial(_decode_fn, self._module, top_k),
+                donate_argnums=(1, 2))
 
         # Recompile auditing: the compile-count==1 decode invariant as a
         # RUNTIME check, not just a benchmark assertion. The auditor wraps
@@ -401,6 +617,12 @@ class ServingEngine:
                 "age_s": (round(now - req.t_submit, 6)
                           if req.t_submit is not None else None),
             }
+            if self._paged:
+                # Block-table depth: shared prefix blocks + private
+                # chain — the per-slot footprint the dense engine's
+                # fixed [L] rows could never show.
+                entry["blocks"] = st.first_block + len(st.blocks)
+                entry["shared_blocks"] = st.first_block
             if st.prefill is not None:
                 entry["prefill"] = {
                     "pos": st.prefill.pos,
@@ -418,6 +640,13 @@ class ServingEngine:
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.debugz()
+        if self.kv_pool is not None:
+            out["kv_pool"] = {
+                **self.kv_pool.debugz(),
+                "blocks_free": self.kv_pool.blocks_free,
+                "preemptions": self.metrics.preemptions,
+                "oom_rejections": self.metrics.oom_rejections,
+            }
         if self.flight_recorder is not None:
             out["flight_recorder"] = self.flight_recorder.stats()
         if self.trace_store is not None:
@@ -453,6 +682,26 @@ class ServingEngine:
                              f"got shape {prompt_arr.shape}")
         _check_context(self.model, self._cfg, prompt_arr[None, :],
                        max_new_tokens)
+        if prompt_arr.size + max_new_tokens > self.limit:
+            # Tighter than the model's trained context: the engine's
+            # max_context cap (dense mode: the pre-reserved per-slot
+            # cache length under the byte budget).
+            raise ValueError(
+                f"prompt ({prompt_arr.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds this engine's context cap "
+                f"{self.limit} (max_context)")
+        if self._paged:
+            # Resident K/V at completion: every position except the last
+            # sampled token's (never fed back). A request that can never
+            # fit the pool is a sizing error — reject typed, up front.
+            resident = prompt_arr.size + max_new_tokens - 1
+            need = -(-resident // self.kv_block_tokens)
+            if need > self.kv_pool.capacity:
+                self.metrics.record_oom_reject()
+                raise PoolExhausted(
+                    f"request needs {need} KV blocks at completion; the "
+                    f"pool holds {self.kv_pool.capacity} — raise "
+                    f"--kv-pool-mb or lower max_new_tokens")
         req = Request(
             prompt_arr.tolist(), max_new_tokens, temperature=temperature,
             priority=priority, timeout=timeout, trace_id=trace_id,
@@ -551,6 +800,11 @@ class ServingEngine:
             # weights make every cached block wrong, so the whole pool is
             # invalidated in one stroke.
             self.prefix_cache.flush()
+        if self.kv_pool is not None:
+            # Safe for the same reason the swap itself is: zero active
+            # slots means zero slot-owned blocks, so the flush only
+            # drops (now-wrong) trie entries.
+            self.kv_pool.flush()
         # Rewarm: one decode tick over the (all-free) batch. Garbage
         # output, real proof — the compiled decode step runs against the
         # new params, so an armed auditor raises here if the swap somehow
@@ -599,6 +853,7 @@ class ServingEngine:
                         self._finish_error(st.request, RequestCancelled(
                             f"cancelled with {st.remaining} tokens undecoded"))
                         self._release_prefill(st)
+                        self._free_slot_paged(i, st)
                         self._slot_state[i] = None
                     elif dl is not None and now > dl:
                         self.metrics.record_expire()
@@ -606,6 +861,7 @@ class ServingEngine:
                             f"deadline exceeded after {st.request.timeout}s "
                             f"with {st.remaining} tokens undecoded"))
                         self._release_prefill(st)
+                        self._free_slot_paged(i, st)
                         self._slot_state[i] = None
                 # 3. Shutdown: flush the queue with typed errors.
                 if self._stopping:
@@ -644,6 +900,20 @@ class ServingEngine:
                 # events are not thread-safe).
                 if not self._stopping:
                     while self.free_slots and len(self.scheduler):
+                        if (self._paged and self._parked_at_version
+                                == self.kv_pool.version
+                                and self.scheduler.peek()
+                                is self._parked_req):
+                            # The queue head is parked on a dry pool and
+                            # nothing has freed since — re-matching it
+                            # every iteration would only burn host time.
+                            # The head check keeps the park from gating
+                            # ANYONE ELSE: a higher-priority arrival
+                            # (which may preempt its way in) or the
+                            # parked request expiring/cancelling changes
+                            # the head and reopens admission without
+                            # waiting for the pool version to move.
+                            break
                         # Fresh clock per pop: an earlier admission's
                         # prefill may have taken long enough that more
                         # queued deadlines expired — a stale `now` would
@@ -653,6 +923,15 @@ class ServingEngine:
                         if req is None:
                             break
                         slot = self._slot_state.index(None)
+                        paged_job = None
+                        if self._paged:
+                            paged_job = self._reserve_paged(req, slot)
+                            if paged_job is None:
+                                # Parked: requeued at its class head,
+                                # admission resumes when blocks free.
+                                break
+                            self._parked_at_version = None
+                            self._parked_req = None
                         # ADMISSION WAIT ends HERE (slot granted); the
                         # PREFILL DEVICE TIME is recorded separately when
                         # the prefill completes (record_prefill). The two
@@ -672,8 +951,18 @@ class ServingEngine:
                         if self.flight_recorder is not None:
                             self.flight_recorder.record_event(
                                 "admit", trace_id=req.trace_id, slot=slot)
-                        st = _SlotState(req, req.max_new_tokens,
-                                        time.monotonic())
+                        now_t = time.monotonic()
+                        # Resume-aware: a preempted request re-admits
+                        # with its already-streamed tokens folded into
+                        # the prefill, so only the UNdecoded remainder
+                        # is owed.
+                        st = _SlotState(
+                            req,
+                            req.max_new_tokens - len(req.out_tokens),
+                            now_t, t_admit=now_t)
+                        if paged_job is not None:
+                            (st.prefill, st.blocks, st.first_block,
+                             st.match) = paged_job
                         self._slot_state[slot] = st
                         with span("admit", slot=slot,
                                   trace_id=req.trace_id,
@@ -681,9 +970,12 @@ class ServingEngine:
                                   queue_wait_s=round(wait, 6)):
                             # Prefix-cache lookup + splice: a hit makes
                             # admission nearly free — the matched prefix's
-                            # prefill compute is skipped entirely.
-                            st.prefill = await self._in_executor(
-                                loop, self._begin_prefill, req)
+                            # prefill compute is skipped entirely. (Paged
+                            # admission already reserved its blocks and
+                            # pinned its match — zero device work.)
+                            if st.prefill is None:
+                                st.prefill = await self._in_executor(
+                                    loop, self._begin_prefill, req)
                             if self._chunk is None:
                                 # Monolithic prefill: the whole uncached
                                 # tail, admitted inline. Normally ONE
@@ -730,8 +1022,20 @@ class ServingEngine:
                             self._finish_error(st.request, EngineStopped(
                                 "engine shut down mid-decode"))
                             self._release_prefill(st)
+                            self._free_slot_paged(i, st)
                             self._slot_state[i] = None
                     break
+                # 5c. Paged growth: before the tick, every decoding slot
+                # whose next write position crosses into an unallocated
+                # block chains one more from the pool — preempting the
+                # lowest-priority youngest slot (possibly itself) when
+                # the pool is dry. Host bookkeeping only; the decode
+                # step itself never changes shape.
+                if self._paged:
+                    for i in range(self.slots):
+                        st = self._slot_state[i]
+                        if st is not None and st.prefill is None:
+                            self._ensure_tail_block(i)
                 # 6. One decode iteration for the whole batch — skipped
                 # while EVERY active slot is still mid-prefill (the whole
                 # tick's output would be discarded; the chunk in 4b was
@@ -756,6 +1060,7 @@ class ServingEngine:
                             self._push_token(st, int(nxt[i]), t)
                             if st.remaining == 0:
                                 self._finish_ok(st.request)
+                                self._free_slot_paged(i, st)
                                 self._slot_state[i] = None
                 self.metrics.sample(
                     len(self.scheduler), self.active_slots, self.slots)
@@ -773,6 +1078,9 @@ class ServingEngine:
                 if st is not None:
                     self._finish_error(st.request, err)
                     self._release_prefill(st)
+                    # Crash path: free only (no adoption) — keep the
+                    # last-words path as simple as possible.
+                    self._free_slot_paged(i, st, adopt=False)
                     self._slot_state[i] = None
             for req in self.scheduler.drain():
                 self._finish_error(req, err)
@@ -824,29 +1132,41 @@ class ServingEngine:
 
     def _finish_admission(self, st: _SlotState, slot: int, tok0: int) -> None:
         """Loop-thread bookkeeping once a slot's prefill completed: stream
-        the first token (TTFT stamp) and free the slot if one token was
-        all the request wanted."""
+        the first token (TTFT stamp — unless this is a preempted request
+        resuming, whose TTFT already happened on its first admission) and
+        free the slot if one token was all the request wanted."""
         t = time.monotonic()
-        self._push_token(st, tok0, t, first=True)
-        st.remaining -= 1
+        if st.request.t_first_token is None:
+            self._push_token(st, tok0, t, first=True)
+            st.remaining -= 1
+        else:
+            # Resumed after preemption: the prefill over prompt + already
+            # -streamed tokens sampled the next CONTINUATION token.
+            # _push_token(first=False) decrements remaining itself.
+            self._push_token(st, tok0, t)
         if st.remaining == 0:
             self._finish_ok(st.request)
+            self._free_slot_paged(slot, st)
             self._slot_state[slot] = None
 
     def _begin_prefill(self, req: Request) -> _PrefillJob:
-        """Start a prompt's prefill (executor thread): allocate the
-        single-row cache and splice in the longest cached prefix — a hit
-        skips that prefix's prefill compute entirely; the uncached tail
-        runs through :meth:`_prefill_step` chunk by chunk."""
-        cache = self._fresh_row_cache()
+        """Start a prompt's prefill (executor thread, DENSE mode): build
+        the single-row cache — on a prefix-cache hit, materialized
+        straight from the matched pool blocks (the covered leaves are
+        never first built as zeros and re-written; see
+        PrefixCache.materialize), on a miss from the jitted zeros
+        factory. The uncached tail runs through :meth:`_prefill_step`
+        chunk by chunk."""
         match, matched = None, 0
         if self.prefix_cache is not None:
             match = self.prefix_cache.match(req.prompt)
             matched = match.matched_tokens
-            if matched:
-                with span("prefix_splice", blocks=len(match.ids),
-                          tokens=matched):
-                    cache = self.prefix_cache.splice(cache, match.ids)
+        if matched:
+            with span("prefix_splice", blocks=len(match.ids),
+                      tokens=matched):
+                cache = self.prefix_cache.materialize(match.ids)
+        else:
+            cache = self._fresh_row_cache()
         if req.trace is not None and matched:
             req.trace.event("prefix_splice", tokens=matched,
                             blocks=len(match.ids))
@@ -856,11 +1176,15 @@ class ServingEngine:
     def _prefill_step(self, st: _SlotState, slot: int) -> int | None:
         """Run ONE prefill chunk for the slot (executor thread; device
         work only). Returns None while the prompt is still incomplete;
-        on the final chunk, stores the prompt's new blocks into the
-        prefix cache, splices the finished single-row cache into batch
-        row ``slot``, and returns the request's first token."""
+        on the final chunk, DENSE mode stores the prompt's new blocks
+        into the prefix cache and splices the finished single-row cache
+        into batch row ``slot``, while PAGED mode has nothing to move —
+        the chunks already wrote into the slot's pool blocks — and only
+        the sampling state (first token, temperature) is set. Either way
+        the request's first token comes back."""
         req, job = st.request, st.prefill
-        s0 = len(req.prompt)
+        tokens = self._resident_tokens(req)
+        s0 = len(tokens)
         rem = s0 - job.pos
         c = rem if self._chunk is None else min(self._chunk, rem)
         if self._chunk is None:
@@ -870,30 +1194,38 @@ class ServingEngine:
         else:
             P = self._bucket(c, cap=self._chunk)  # ragged final chunk
         # The pad width must never overshoot the cache: with job.pos + P
-        # > max_seq_len the per-slot KV write would clamp its start
-        # backward (bert.py's OOB discipline) and silently overwrite the
-        # spliced prefix rows. Rather than compiling a bespoke
-        # non-power-of-two width per matched length, shrink to the
-        # largest power of two that fits and let the NEXT call(s) finish
-        # the remainder — the compile set stays pow2-bounded and no
-        # token is prefilled twice. (Monolithic admission loops on this
-        # method until it returns a token, so near-context-limit prompts
-        # just take an extra sub-chunk or two.)
-        room = self._cfg.max_seq_len - job.pos
+        # > cache length the dense per-slot KV write would clamp its
+        # start backward (bert.py's OOB discipline) and silently
+        # overwrite the spliced prefix rows (paged writes past the table
+        # are dropped, but the bound keeps the compile set shared).
+        # Rather than compiling a bespoke non-power-of-two width per
+        # matched length, shrink to the largest power of two that fits
+        # and let the NEXT call(s) finish the remainder — the compile
+        # set stays pow2-bounded and no token is prefilled twice.
+        # (Monolithic admission loops on this method until it returns a
+        # token, so near-context-limit prompts just take an extra
+        # sub-chunk or two.)
+        room = self._cache_len - job.pos
         if P > room:
             P = 1
             while P * 2 <= room:
                 P *= 2
             c = min(c, P)  # room >= rem >= 1, so P >= 1 and c >= 1
         padded = np.zeros((1, P), np.int32)
-        padded[0, :c] = req.prompt[job.pos:job.pos + c]
+        padded[0, :c] = tokens[job.pos:job.pos + c]
         self._key, sub = jax.random.split(self._key)
         temp = jnp.float32(req.temperature)
         t0 = time.monotonic()
         with span("prefill", bucket=P, offset=job.pos, prompt_len=s0):
-            job.cache, tok = self._prefill(
-                self._params, job.cache, jnp.asarray(padded),
-                jnp.int32(job.pos), jnp.int32(c), temp, sub)
+            if self._paged:
+                self._cache, tok = self._prefill(
+                    self._params, self._cache, jnp.asarray(padded),
+                    jnp.int32(job.pos), jnp.int32(c),
+                    jnp.asarray(self._tables[slot]), temp, sub)
+            else:
+                job.cache, tok = self._prefill(
+                    self._params, job.cache, jnp.asarray(padded),
+                    jnp.int32(job.pos), jnp.int32(c), temp, sub)
             tok0 = int(tok)  # blocks: honest device time per chunk
         chunk_s = time.monotonic() - t0
         job.device_s += chunk_s
@@ -902,22 +1234,33 @@ class ServingEngine:
             req.trace.event("prefill_chunk", offset=job.pos, tokens=c,
                             bucket=P, dur_s=round(chunk_s, 9))
         job.pos += c
+        if self._paged:
+            # Written-KV watermark: a preemption between chunks adopts /
+            # frees exactly the positions written so far.
+            self._lens[slot] = job.pos
         if job.pos < s0:
             return None
-        # Prompt complete. Store the complete blocks this prefill
-        # computed (future requests sharing the prefix hit them), then
-        # splice the row into the live batch cache.
-        if self.prefix_cache is not None:
-            with span("prefix_insert", prompt_len=s0):
-                self.prefix_cache.insert(req.prompt, job.cache)
-            self.prefix_cache.release(job.match)
-        with span("cache_splice", slot=slot):
-            self._cache, self._tokens, self._temps = self._admit_jit(
-                self._cache, self._tokens, self._temps, jnp.int32(slot),
-                job.cache, tok, temp)
+        # Prompt complete.
+        if self._paged:
+            with span("cache_admit", slot=slot):
+                self._tokens, self._temps = self._admit_jit(
+                    self._tokens, self._temps, jnp.int32(slot), tok, temp)
+        else:
+            # Store the complete blocks this prefill computed (future
+            # requests sharing the prefix hit them), then splice the row
+            # into the live batch cache.
+            if self.prefix_cache is not None:
+                with span("prefix_insert", prompt_len=s0):
+                    self.prefix_cache.insert(req.prompt, job.cache)
+                self.prefix_cache.release(job.match)
+            with span("cache_splice", slot=slot):
+                self._cache, self._tokens, self._temps = self._admit_jit(
+                    self._cache, self._tokens, self._temps, jnp.int32(slot),
+                    job.cache, tok, temp)
         self.metrics.record_prefill(
             job.device_s, job.chunks_done,
-            job.matched_tokens if self.prefix_cache is not None else None,
+            job.matched_tokens if (self._paged or
+                                   self.prefix_cache is not None) else None,
             s0)
         if req.trace is not None:
             req.trace.data.update(
@@ -929,9 +1272,174 @@ class ServingEngine:
 
     def _decode_sync(self) -> np.ndarray:
         self._key, sub = jax.random.split(self._key)
-        self._cache, self._tokens = self._decode_step(
-            self._params, self._cache, self._tokens, self._temps, sub)
+        if self._paged:
+            # Device views of the host paging state: per-row write
+            # positions, and block tables MASKED to the sentinel for
+            # rows that must not write (free slots, mid-prefill slots —
+            # their garbage decode output is discarded, and the dropped
+            # scatter guarantees it cannot scribble on live blocks the
+            # way the dense path lets a free row scribble on its own).
+            decodable = [i for i in range(self.slots)
+                         if self._slot_state[i] is not None
+                         and self._slot_state[i].prefill is None]
+            positions = np.zeros((self.slots,), np.int32)
+            tables = np.full_like(self._tables, self._sentinel)
+            for i in decodable:
+                positions[i] = self._lens[i]
+                tables[i] = self._tables[i]
+            # Tables only change on admission/growth/preemption/
+            # teardown — bt-1 of every bt steady-state ticks reuse the
+            # cached device copy instead of re-uploading. (Safe to hold
+            # across ticks: the decode jit donates cache/tokens only.)
+            if (self._tables_dev is None
+                    or not np.array_equal(tables, self._tables_host)):
+                self._tables_host = tables
+                self._tables_dev = jnp.asarray(tables)
+            self._cache, self._tokens = self._decode_step(
+                self._params, self._cache, self._tokens, self._temps,
+                jnp.asarray(positions), self._tables_dev, sub)
+            # Each decodable row appended exactly one K/V vector.
+            for i in decodable:
+                self._lens[i] += 1
+        else:
+            self._cache, self._tokens = self._decode_step(
+                self._params, self._cache, self._tokens, self._temps, sub)
         return np.asarray(self._tokens)
+
+    # -- paged-KV internals (host bookkeeping; no device work) --------------
+    @staticmethod
+    def _resident_tokens(req: Request) -> list:
+        """The slot's full resident sequence: prompt plus already-
+        streamed tokens (a preempted request resumes with its output
+        folded back in, so adoption keys, resume prefill, and block math
+        must all see the SAME sequence). Skips the list copy when
+        nothing has streamed."""
+        return (req.prompt + req.out_tokens if req.out_tokens
+                else req.prompt)
+
+    def _blocks_for(self, first_token: int, last_token: int) -> int:
+        """Blocks covering token positions [first_token, last_token]."""
+        bt = self.kv_block_tokens
+        return last_token // bt - first_token // bt + 1
+
+    def _reserve_paged(self, req: Request, slot: int):
+        """Admission-time reservation (loop thread; zero device work):
+        pin the longest shared prefix chain, allocate private blocks for
+        the rest of the prompt, and point the slot's block table at
+        both. Returns ``(job, blocks, first_block, match)``, or None
+        after parking the request (requeued at its class head) because
+        the pool is dry and nobody strictly lower-priority is running.
+
+        Admission only preempts STRICTLY lower-priority slots — an
+        equal-priority preemption would let a full pool thrash between
+        peers; growth (:meth:`_ensure_tail_block`) is the path that may
+        preempt within a class, because there a slot is wedged without
+        a block."""
+        pool = self.kv_pool
+        tokens = self._resident_tokens(req)
+        match = pool.match(tokens)
+        m = match.matched_tokens
+        first_block = m // self.kv_block_tokens
+        needed = self._blocks_for(m, len(tokens) - 1)
+        ids = pool.alloc(needed)
+        while ids is None:
+            victims = [
+                (i, s) for i, s in enumerate(self._slot_state)
+                if s is not None and s.request.priority > req.priority]
+            if not victims:
+                pool.release(match)
+                self.scheduler.requeue(req)
+                self._parked_at_version = pool.version
+                self._parked_req = req
+                return None
+            i, _ = max(victims,
+                       key=lambda v: (v[1].request.priority, v[1].t_admit))
+            self._preempt_slot(i)
+            ids = pool.alloc(needed)
+        row = self._tables[slot]
+        row[:] = self._sentinel
+        row[:first_block] = match.ids
+        row[first_block:first_block + needed] = ids
+        self._lens[slot] = m
+        if req.trace is not None and m:
+            req.trace.event("prefix_splice", tokens=m, blocks=first_block)
+        job = _PrefillJob(cache=None, pos=m, match=None, matched_tokens=m)
+        return job, ids, first_block, match
+
+    def _ensure_tail_block(self, i: int) -> bool:
+        """Pre-tick growth: make sure slot ``i``'s next write position
+        has a block, preempting the lowest-priority youngest slot —
+        itself included — when the pool is dry. Returns False when slot
+        ``i`` itself was the fairest victim (it is gone; the tick runs
+        without it)."""
+        st = self._slot_state[i]
+        blk = int(self._lens[i]) // self.kv_block_tokens
+        if self._tables[i, blk] != self._sentinel:
+            return True
+        ids = self.kv_pool.alloc(1)
+        while ids is None:
+            victims = [(j, s) for j, s in enumerate(self._slot_state)
+                       if s is not None]
+            j, _ = max(victims,
+                       key=lambda v: (v[1].request.priority, v[1].t_admit))
+            self._preempt_slot(j)
+            if j == i:
+                return False
+            ids = self.kv_pool.alloc(1)
+        self._tables[i, blk] = ids[0]
+        st.blocks.extend(ids)
+        return True
+
+    def _preempt_slot(self, i: int) -> None:
+        """Evict slot ``i`` for its KV blocks and requeue its request at
+        the front of its priority class (oversubscription's relief
+        valve). The complete blocks of its written K/V are ADOPTED into
+        the prefix trie — evictable if the pressure persists, but a
+        prompt re-admission re-matches them and resumes nearly free —
+        and its streamed tokens ride along in ``req.out_tokens``, so the
+        resume prefill continues the sequence token-identically."""
+        st = self._slot_state[i]
+        req = st.request
+        valid = int(self._lens[i])
+        tokens = self._resident_tokens(req)
+        self.kv_pool.adopt(tokens[:valid], st.blocks, st.first_block)
+        self.kv_pool.release(st.match)
+        st.blocks = []
+        st.match = None
+        st.prefill = None
+        self._tables[i, :] = self._sentinel
+        self._lens[i] = 0
+        self._slot_state[i] = None
+        self.metrics.record_preemption()
+        if req.trace is not None:
+            req.trace.event("preempt", slot=i, resident_tokens=valid,
+                            streamed=len(req.out_tokens))
+        if self.flight_recorder is not None:
+            self.flight_recorder.record_event(
+                "preempt", trace_id=req.trace_id, slot=i)
+        self.scheduler.requeue(req)
+
+    def _free_slot_paged(self, i: int, st: _SlotState,
+                         adopt: bool = True) -> None:
+        """Slot teardown (paged mode; dense no-op): adopt the complete
+        blocks of whatever K/V the slot computed into the prefix trie
+        (zero-copy insert — a follow-up prompt sharing the prefix, or a
+        multi-turn continuation sharing prompt+output, re-matches them),
+        free the rest, and unpin the shared chain."""
+        if not self._paged:
+            return
+        req = st.request
+        valid = int(self._lens[i])
+        if adopt and valid:
+            tokens = self._resident_tokens(req)
+            self.kv_pool.adopt(tokens[:valid], st.blocks, st.first_block)
+        else:
+            self.kv_pool.free(st.blocks)
+        self.kv_pool.release(st.match)
+        st.blocks = []
+        st.match = None
+        self._tables[i, :] = self._sentinel
+        self._lens[i] = 0
 
     def _push_token(self, st: _SlotState, tok: int, t: float,
                     first: bool = False) -> None:

@@ -130,6 +130,17 @@ class ServingMetrics:
             "serving_slo_violations_total",
             help="requests that finished slower than the configured "
                  "latency SLO")
+        # Paged-KV pool pressure (the pool itself publishes the
+        # kv_pool_blocks_* occupancy gauges; these count the engine's
+        # RESPONSES to pressure).
+        self._c_preemptions = reg.counter(
+            "kv_preemptions_total",
+            help="decode slots evicted (blocks released, request "
+                 "requeued) because the KV block pool ran dry")
+        self._c_oom_rejections = reg.counter(
+            "kv_oom_rejections_total",
+            help="requests rejected because their full context can "
+                 "never fit the KV block pool")
         self._g_slo = reg.gauge(
             "serving_slo_seconds",
             help="configured request-latency SLO (0 = no SLO armed)")
@@ -223,6 +234,23 @@ class ServingMetrics:
     def slo_violations(self) -> int:
         return int(self._c_slo_violations.value)
 
+    def record_preemption(self) -> None:
+        """A decode slot was evicted for KV blocks and its request
+        requeued (paged oversubscription doing its job — frequent
+        preemption means the pool is undersized for the offered load)."""
+        self._c_preemptions.inc()
+
+    def record_oom_reject(self) -> None:
+        self._c_oom_rejections.inc()
+
+    @property
+    def preemptions(self) -> int:
+        return int(self._c_preemptions.value)
+
+    @property
+    def oom_rejections(self) -> int:
+        return int(self._c_oom_rejections.value)
+
     @property
     def iterations(self) -> int:
         """Decode-loop iterations sampled so far (per-request timeline
@@ -266,6 +294,9 @@ class ServingMetrics:
         }
         if self._g_slo.value:
             out["slo_violations"] = float(self.slo_violations)
+        if self.preemptions or self.oom_rejections:
+            out["kv_preemptions"] = float(self.preemptions)
+            out["kv_oom_rejections"] = float(self.oom_rejections)
         for name, xs in (
             ("ttft", self.ttft),
             ("inter_token", self.inter_token),

@@ -1,43 +1,61 @@
-"""Device-resident prefix cache: reuse KV blocks across shared prompt prefixes.
+"""KV block pool: one fixed-size-block memory manager for prefix caching
+AND paged decode slots.
 
 Real serving traffic is dominated by shared prefixes — system prompts,
 few-shot templates, multi-turn histories. The PR 1 engine recomputed every
-request's KV cache from scratch; this module lets admission *reuse* the
-computation instead: the KV rows of previously-prefilled prompt prefixes
-live in a fixed pool of **blocks** (``block_tokens`` tokens each), keyed
-by a radix trie over the prompt's token blocks, and a cache hit splices
-the matched blocks straight into the request's prefill cache with
-``dynamic_update_slice`` — the matched prefix's prefill compute is
-skipped entirely.
+request's KV cache from scratch; :class:`PrefixCache` (PR 3) lets
+admission *reuse* the computation: the KV rows of previously-prefilled
+prompt prefixes live in a fixed pool of **blocks** (``block_tokens``
+tokens each), keyed by a radix trie over the prompt's token blocks, and a
+cache hit splices the matched blocks straight into the request's prefill
+cache with ``dynamic_update_slice``.
 
-Why this is safe: in a causal LM the K/V at position ``p`` depend only on
-tokens ``[0, p]``, so two prompts sharing a token prefix share that
-prefix's K/V exactly. A block is only ever stored from a fully-prefilled
-cache and only ever matched by the exact token sequence (trie edges are
-the block's token tuple — Python's tuple hashing IS the token hash, and
-the trie structure makes the chain a radix tree over prefixes), so a hit
-cannot alias a different prompt.
+:class:`KVBlockPool` generalizes the same pool to be the engine's ONLY
+KV memory manager (paged decode, PR 6): decode slots allocate their KV in
+blocks from this pool too, addressed through per-slot block tables, so
 
-Shape discipline (same stance as the engine's three programs):
+- capacity scales with *actual* resident tokens, not
+  ``slots × max_seq_len`` (no dense worst-case pre-reservation);
+- a prefix-cache hit is **zero-copy**: the slot's block table simply
+  points at the shared trie blocks (ref-counted so they cannot be
+  evicted or overwritten from under a reader) — the copy-on-write
+  discipline degenerates to "never write a shared block": sharing is
+  block-aligned and appends always land in freshly allocated private
+  blocks, so the copy case cannot arise by construction;
+- a finished (or preempted) slot's complete blocks are **adopted** into
+  the trie in place — prefix caching with no store copy at all;
+- when the pool runs dry the engine can preempt a slot and requeue its
+  request (blocks freed here, re-admission recomputes or re-matches the
+  adopted chain).
 
-- the pool is ONE allocation per KV leaf, ``[capacity, block_tokens, H,
-  D]``, sized up-front from a **byte budget** — no per-request device
-  allocation, no growing shapes;
-- store (an insert's new blocks -> pool rows, ONE batched scatter) and
-  splice (pool rows -> cache prefix) each compile once per power-of-two
-  block-count bucket — ≤ log2(max_seq_len / block_tokens) programs each;
-- eviction is pure host bookkeeping (LRU over unreferenced trie leaves):
-  an evicted slot is simply overwritten by the next store.
+``KVBlockPool`` is pure host bookkeeping — the device arrays live in the
+engine's cache pytree (the paged module's ``pool_key``/``pool_value``
+variables) and are threaded through its compiled programs; the pool
+decides *which rows mean what*. ``PrefixCache`` keeps owning its device
+arrays (the dense engine's splice/store path is unchanged).
 
-Ref-counting pins a matched chain for the duration of an admission (a
-concurrently-admitted request must not see its matched blocks overwritten
-mid-prefill); LRU eviction only considers nodes with no live references
-and no children (evicting a mid-chain node would strand its descendants).
+Why sharing is safe: in a causal LM the K/V at position ``p`` depend only
+on tokens ``[0, p]``, so two prompts sharing a token prefix share that
+prefix's K/V exactly. A block is only ever stored/adopted from fully
+computed positions and only ever matched by the exact token sequence
+(trie edges are the block's token tuple), so a hit cannot alias a
+different prompt.
+
+Shape discipline (same stance as the engine's compiled programs): the
+pool is ONE allocation per KV leaf, ``[capacity, block_tokens, H, D]``,
+sized up-front from a **byte budget**; store/splice/materialize compile
+once per power-of-two block-count bucket; eviction is pure host
+bookkeeping (LRU over unreferenced trie leaves).
+
+Ref-counting pins a matched chain for as long as a reader needs it (an
+admission splicing it, or — paged — a slot whose block table points at
+it); LRU eviction only considers nodes with no live references and no
+children (evicting a mid-chain node would strand its descendants).
 
 NOT thread-safe: the trie and pool are mutated without locks, relying on
 the owning :class:`~distkeras_tpu.serving.engine.ServingEngine`'s loop
-serializing every match/splice/insert (the loop awaits each executor
-call). Do not drive one cache from two concurrently running engines.
+serializing every call. Do not drive one pool from two concurrently
+running engines.
 """
 
 from __future__ import annotations
@@ -52,7 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["PrefixCache", "PrefixMatch"]
+__all__ = ["KVBlockPool", "PrefixCache", "PrefixMatch"]
 
 
 def _store_fn(block_tokens, pool, cache, slots, off0):
@@ -98,6 +116,29 @@ def _splice_fn(block_tokens, cache, pool, ids):
     return jax.tree.map(sp, cache, pool)
 
 
+def _materialize_fn(block_tokens, shapes, pool, ids):
+    """Build a FRESH single-row cache whose token prefix is pool rows
+    ``ids`` and whose tail is zeros — in one fused program per pow2
+    bucket. The splice path this replaces first materialized a full
+    max-length zeros cache (``_fresh_row_cache``) and then overwrote its
+    prefix with a second (donating) program; on backends where donation
+    cannot alias (CPU) that copied the whole leaf per admission. Here
+    the spliced region is never built as zeros at all — gather + static
+    pad, leaves the splice fully covers cost nothing extra."""
+
+    def mk(s, p):
+        if s.ndim == 1:  # index leaves: the prefill chunk sets these
+            return jnp.zeros(s.shape, s.dtype)
+        blk = p[ids]  # [n, block_tokens, ...]
+        flat = blk.reshape(
+            (1, ids.shape[0] * block_tokens) + blk.shape[2:]).astype(s.dtype)
+        pad = [(0, 0), (0, s.shape[1] - flat.shape[1])]
+        pad += [(0, 0)] * (s.ndim - 2)
+        return jnp.pad(flat, pad)
+
+    return jax.tree.map(mk, shapes, pool)
+
+
 class _Node:
     """One trie edge = one cached block. Children are keyed by the next
     block's token tuple (exact-match radix trie)."""
@@ -116,7 +157,9 @@ class _Node:
 @dataclasses.dataclass
 class PrefixMatch:
     """A pinned match: ``release()`` it (via :meth:`PrefixCache.release`)
-    once the matched blocks have been spliced."""
+    once the matched blocks are no longer being read — after the splice
+    (dense mode) or when the slot whose table points at them frees/adopts
+    (paged mode)."""
 
     nodes: list
     ids: np.ndarray  # pool slots of the matched chain, int32 [n]
@@ -124,53 +167,15 @@ class PrefixMatch:
     released: bool = False
 
 
-class PrefixCache:
-    """Block pool + radix trie over prompt prefixes.
+class _BlockTrie:
+    """Shared core of both pool classes: the block allocator (free list +
+    LRU eviction of unreferenced trie leaves) and the radix trie over
+    token blocks (probe/match/release). Subclasses call
+    :meth:`_init_trie` and provide ``_note_occupancy``."""
 
-    ``template``: the single-row decode cache pytree (concrete arrays or
-    ``jax.eval_shape`` structs) — KV leaves ``[1, L, H, D]`` define the
-    pool geometry; 1-D index leaves get no pooled storage.
-    ``block_tokens``: granularity of sharing — smaller blocks match more
-    of a prefix but cost more trie nodes and splice slots per hit.
-    ``budget_bytes``: hard cap on pool memory; capacity =
-    ``budget_bytes // bytes_per_block`` blocks, allocated up-front.
-    ``registry``: optional :class:`~distkeras_tpu.telemetry.registry.
-    MetricsRegistry` — hit/miss/eviction counters and occupancy gauges
-    for ``metricsz``.
-    """
-
-    def __init__(self, template, *, block_tokens: int = 16,
-                 budget_bytes: int = 64 * 2**20, registry=None):
-        if block_tokens < 1:
-            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+    def _init_trie(self, capacity: int, block_tokens: int) -> None:
         self.block_tokens = int(block_tokens)
-        kv_leaves = [a for a in jax.tree.leaves(template) if a.ndim > 1]
-        if not kv_leaves:
-            raise ValueError("cache template has no KV leaves")
-        L = kv_leaves[0].shape[1]
-        if self.block_tokens > L:
-            raise ValueError(
-                f"block_tokens={block_tokens} exceeds cache length {L}")
-        self.max_blocks = L // self.block_tokens
-        self.bytes_per_block = sum(
-            self.block_tokens * int(np.prod(a.shape[2:])) * a.dtype.itemsize
-            for a in kv_leaves)
-        self.capacity = int(budget_bytes) // self.bytes_per_block
-        if self.capacity < 1:
-            raise ValueError(
-                f"budget_bytes={budget_bytes} holds zero blocks "
-                f"(one block = {self.bytes_per_block} bytes)")
-        self._pool = jax.tree.map(
-            lambda a: (jnp.zeros((0,), jnp.int32) if a.ndim == 1 else
-                       jnp.zeros((self.capacity, self.block_tokens)
-                                 + a.shape[2:], a.dtype)),
-            template)
-        self._store = jax.jit(
-            functools.partial(_store_fn, self.block_tokens),
-            donate_argnums=(0,))
-        self._splice = jax.jit(
-            functools.partial(_splice_fn, self.block_tokens),
-            donate_argnums=(0,))  # the cache being built; the pool persists
+        self.capacity = int(capacity)
         self._root = _Node(-1, None, None)
         self._by_slot: dict[int, _Node] = {}
         self._free = list(range(self.capacity - 1, -1, -1))
@@ -185,59 +190,19 @@ class PrefixCache:
         self.hit_tokens = self.miss_tokens = 0
         self.inserted_blocks = self.evicted_blocks = 0
         self.flushes = 0
-        self._metrics = None
-        if registry is not None:
-            self._metrics = {
-                "hit_tokens": registry.counter(
-                    "prefix_cache_hit_tokens_total",
-                    help="prompt tokens whose prefill was skipped via the "
-                         "prefix cache"),
-                "miss_tokens": registry.counter(
-                    "prefix_cache_miss_tokens_total",
-                    help="prompt tokens prefilled from scratch"),
-                "hit_requests": registry.counter(
-                    "prefix_cache_hit_requests_total",
-                    help="lookups matching at least one block"),
-                "lookups": registry.counter(
-                    "prefix_cache_lookups_total", help="prefix lookups"),
-                "evictions": registry.counter(
-                    "prefix_cache_evicted_blocks_total",
-                    help="blocks evicted (LRU under the byte budget)"),
-                "inserts": registry.counter(
-                    "prefix_cache_inserted_blocks_total",
-                    help="blocks stored into the pool"),
-                "used": registry.gauge(
-                    "prefix_cache_blocks_used", help="pool blocks in use"),
-                "capacity": registry.gauge(
-                    "prefix_cache_blocks_capacity",
-                    help="pool block capacity"),
-                "bytes": registry.gauge(
-                    "prefix_cache_bytes_used", help="pool bytes in use"),
-            }
-            self._metrics["capacity"].set(self.capacity)
+        # Bumped whenever blocks become free or evictable — the engine's
+        # "is it worth retrying a parked admission" heuristic.
+        self.version = 0
+        self._metrics: dict | None = None
 
     # -- introspection ------------------------------------------------------
     @property
     def blocks_used(self) -> int:
         return self.capacity - len(self._free)
 
-    def stats(self) -> dict:
-        total = self.hit_tokens + self.miss_tokens
-        return {
-            "block_tokens": self.block_tokens,
-            "capacity_blocks": self.capacity,
-            "blocks_used": self.blocks_used,
-            "bytes_used": self.blocks_used * self.bytes_per_block,
-            "bytes_per_block": self.bytes_per_block,
-            "lookups": self.lookups,
-            "hit_requests": self.hit_requests,
-            "hit_tokens": self.hit_tokens,
-            "miss_tokens": self.miss_tokens,
-            "hit_rate": (self.hit_tokens / total) if total else 0.0,
-            "inserted_blocks": self.inserted_blocks,
-            "evicted_blocks": self.evicted_blocks,
-            "flushes": self.flushes,
-        }
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
 
     def debugz(self, top: int = 16) -> dict:
         """Trie occupancy grouped by **prefix family** — the root's
@@ -282,15 +247,16 @@ class PrefixCache:
         wrong). Host bookkeeping only — the device pools stay allocated
         and their rows are simply free to overwrite; cumulative hit/miss
         counters keep counting across the flush. Must be called with no
-        admission in flight (no pinned matches) — the engine's swap path
-        guarantees that by running with zero active slots; any match
-        object still held afterwards releases onto orphaned nodes,
-        harmlessly."""
+        reader in flight (no pinned matches and — paged — no slot-owned
+        blocks): the engine's swap path guarantees that by running with
+        zero active slots; any match object still held afterwards
+        releases onto orphaned nodes, harmlessly."""
         self._root = _Node(-1, None, None)
         self._by_slot.clear()
         self._free = list(range(self.capacity - 1, -1, -1))
         self._lru = []
         self.flushes += 1
+        self.version += 1
         if self._metrics is not None:
             self._note_occupancy()
 
@@ -350,6 +316,129 @@ class PrefixCache:
         match.released = True
         for n in match.nodes:
             n.refs -= 1
+        if match.nodes:
+            self.version += 1  # pinned chains may have become evictable
+
+    # -- eviction -----------------------------------------------------------
+    def _touch(self, node: _Node, now: int) -> None:
+        node.last_used = now
+        heapq.heappush(self._lru, (now, node.slot))
+        if len(self._lru) > 4 * self.capacity:
+            # Stale entries are only consumed by _alloc, which a
+            # hit-dominated workload (no inserts once warm) never runs —
+            # compact to one live entry per node so the heap stays
+            # O(capacity) over a long-running server, amortized O(1) per
+            # touch (one rebuild per >= 3·capacity pushes).
+            self._lru = [(n.last_used, n.slot)
+                         for n in self._by_slot.values()]
+            heapq.heapify(self._lru)
+
+    def _alloc(self, protect: _Node | None) -> int | None:
+        if self._free:
+            return self._free.pop()
+        victim, skipped = None, []
+        while self._lru:
+            stamp, slot = heapq.heappop(self._lru)
+            n = self._by_slot.get(slot)
+            if n is None or n.last_used != stamp:
+                continue  # stale: slot was evicted or re-touched since
+            if n.refs or n.children or n is protect:
+                # Currently unevictable, but may become a leaf later
+                # with no further touch — keep its entry alive.
+                skipped.append((stamp, slot))
+                continue
+            victim = n
+            break
+        for item in skipped:
+            heapq.heappush(self._lru, item)
+        if victim is None:
+            return None  # everything pinned or mid-chain
+        del victim.parent.children[victim.key]
+        del self._by_slot[victim.slot]
+        self.evicted_blocks += 1
+        if self._metrics is not None:
+            self._metrics["evictions"].inc()
+        return victim.slot
+
+    def _note_occupancy(self) -> None:  # pragma: no cover - overridden
+        pass
+
+
+class PrefixCache(_BlockTrie):
+    """Device-owning block pool + radix trie over prompt prefixes — the
+    DENSE engine's prefix cache (paged engines use :class:`KVBlockPool`,
+    where the device arrays live in the engine's cache pytree instead).
+
+    ``template``: the single-row decode cache pytree (concrete arrays or
+    ``jax.eval_shape`` structs) — KV leaves ``[1, L, H, D]`` define the
+    pool geometry; 1-D index leaves get no pooled storage.
+    ``block_tokens``: granularity of sharing — smaller blocks match more
+    of a prefix but cost more trie nodes and splice slots per hit.
+    ``budget_bytes``: hard cap on pool memory; capacity =
+    ``budget_bytes // bytes_per_block`` blocks, allocated up-front.
+    ``registry``: optional :class:`~distkeras_tpu.telemetry.registry.
+    MetricsRegistry` — hit/miss/eviction counters and occupancy gauges
+    for ``metricsz``.
+    """
+
+    def __init__(self, template, *, block_tokens: int = 16,
+                 budget_bytes: int = 64 * 2**20, registry=None):
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        kv_leaves = [a for a in jax.tree.leaves(template) if a.ndim > 1]
+        if not kv_leaves:
+            raise ValueError("cache template has no KV leaves")
+        L = kv_leaves[0].shape[1]
+        if block_tokens > L:
+            raise ValueError(
+                f"block_tokens={block_tokens} exceeds cache length {L}")
+        self.max_blocks = L // int(block_tokens)
+        self.bytes_per_block = sum(
+            int(block_tokens) * int(np.prod(a.shape[2:])) * a.dtype.itemsize
+            for a in kv_leaves)
+        capacity = int(budget_bytes) // self.bytes_per_block
+        if capacity < 1:
+            raise ValueError(
+                f"budget_bytes={budget_bytes} holds zero blocks "
+                f"(one block = {self.bytes_per_block} bytes)")
+        self._init_trie(capacity, block_tokens)
+        self._pool = jax.tree.map(
+            lambda a: (jnp.zeros((0,), jnp.int32) if a.ndim == 1 else
+                       jnp.zeros((self.capacity, self.block_tokens)
+                                 + a.shape[2:], a.dtype)),
+            template)
+        self._row_shapes = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), template)
+        self._store = jax.jit(
+            functools.partial(_store_fn, self.block_tokens),
+            donate_argnums=(0,))
+        self._splice = jax.jit(
+            functools.partial(_splice_fn, self.block_tokens),
+            donate_argnums=(0,))  # the cache being built; the pool persists
+        self._materialize = jax.jit(
+            functools.partial(_materialize_fn, self.block_tokens,
+                              self._row_shapes))
+        if registry is not None:
+            self._metrics = _register_trie_metrics(registry)
+            self._metrics["capacity"].set(self.capacity)
+
+    def stats(self) -> dict:
+        total = self.hit_tokens + self.miss_tokens
+        return {
+            "block_tokens": self.block_tokens,
+            "capacity_blocks": self.capacity,
+            "blocks_used": self.blocks_used,
+            "bytes_used": self.blocks_used * self.bytes_per_block,
+            "bytes_per_block": self.bytes_per_block,
+            "lookups": self.lookups,
+            "hit_requests": self.hit_requests,
+            "hit_tokens": self.hit_tokens,
+            "miss_tokens": self.miss_tokens,
+            "hit_rate": (self.hit_tokens / total) if total else 0.0,
+            "inserted_blocks": self.inserted_blocks,
+            "evicted_blocks": self.evicted_blocks,
+            "flushes": self.flushes,
+        }
 
     # -- device ops ---------------------------------------------------------
     def _pad_ids(self, ids, fill: int) -> np.ndarray:
@@ -375,6 +464,16 @@ class PrefixCache:
         them. Donates ``cache``."""
         return self._splice(cache, self._pool,
                             jnp.asarray(self._pad_ids(ids, 0)))
+
+    def materialize(self, ids: np.ndarray):
+        """Build a FRESH single-row cache with pool rows ``ids`` as its
+        token prefix and zeros past it — the hit-path replacement for
+        "allocate a full zeros cache, then splice": the leaves the
+        splice covers are never materialized as zeros first (and never
+        round-trip through a donation the backend may have to copy).
+        Same pad-width bucketing as :meth:`splice`."""
+        return self._materialize(self._pool,
+                                 jnp.asarray(self._pad_ids(ids, 0)))
 
     def insert(self, tokens, cache) -> int:
         """Store every complete block of ``tokens`` not already cached,
@@ -412,52 +511,212 @@ class PrefixCache:
             self._touch(child, now)
             node = child
         self.inserted_blocks += n
+        self.version += 1
         if self._metrics is not None:
             self._metrics["inserts"].inc(n)
             self._note_occupancy()
         return n
 
-    # -- eviction -----------------------------------------------------------
-    def _touch(self, node: _Node, now: int) -> None:
-        node.last_used = now
-        heapq.heappush(self._lru, (now, node.slot))
-        if len(self._lru) > 4 * self.capacity:
-            # Stale entries are only consumed by _alloc, which a
-            # hit-dominated workload (no inserts once warm) never runs —
-            # compact to one live entry per node so the heap stays
-            # O(capacity) over a long-running server, amortized O(1) per
-            # touch (one rebuild per >= 3·capacity pushes).
-            self._lru = [(n.last_used, n.slot)
-                         for n in self._by_slot.values()]
-            heapq.heapify(self._lru)
-
-    def _alloc(self, protect: _Node) -> int | None:
-        if self._free:
-            return self._free.pop()
-        victim, skipped = None, []
-        while self._lru:
-            stamp, slot = heapq.heappop(self._lru)
-            n = self._by_slot.get(slot)
-            if n is None or n.last_used != stamp:
-                continue  # stale: slot was evicted or re-touched since
-            if n.refs or n.children or n is protect:
-                # Currently unevictable, but may become a leaf later
-                # with no further touch — keep its entry alive.
-                skipped.append((stamp, slot))
-                continue
-            victim = n
-            break
-        for item in skipped:
-            heapq.heappush(self._lru, item)
-        if victim is None:
-            return None  # everything pinned or mid-chain: skip the insert
-        del victim.parent.children[victim.key]
-        del self._by_slot[victim.slot]
-        self.evicted_blocks += 1
-        if self._metrics is not None:
-            self._metrics["evictions"].inc()
-        return victim.slot
-
     def _note_occupancy(self) -> None:
         self._metrics["used"].set(self.blocks_used)
         self._metrics["bytes"].set(self.blocks_used * self.bytes_per_block)
+
+
+def _register_trie_metrics(registry) -> dict:
+    """The prefix-sharing metric family — shared by both pool classes so
+    an operator reads ONE set of hit/miss/eviction series whether the
+    engine runs dense (PrefixCache) or paged (KVBlockPool)."""
+    return {
+        "hit_tokens": registry.counter(
+            "prefix_cache_hit_tokens_total",
+            help="prompt tokens whose prefill was skipped via the "
+                 "prefix cache"),
+        "miss_tokens": registry.counter(
+            "prefix_cache_miss_tokens_total",
+            help="prompt tokens prefilled from scratch"),
+        "hit_requests": registry.counter(
+            "prefix_cache_hit_requests_total",
+            help="lookups matching at least one block"),
+        "lookups": registry.counter(
+            "prefix_cache_lookups_total", help="prefix lookups"),
+        "evictions": registry.counter(
+            "prefix_cache_evicted_blocks_total",
+            help="blocks evicted (LRU under the byte budget)"),
+        "inserts": registry.counter(
+            "prefix_cache_inserted_blocks_total",
+            help="blocks stored/adopted into the prefix trie"),
+        "used": registry.gauge(
+            "prefix_cache_blocks_used", help="pool blocks in use"),
+        "capacity": registry.gauge(
+            "prefix_cache_blocks_capacity",
+            help="pool block capacity"),
+        "bytes": registry.gauge(
+            "prefix_cache_bytes_used", help="pool bytes in use"),
+    }
+
+
+class KVBlockPool(_BlockTrie):
+    """Host-side allocator + prefix trie over ONE shared KV block pool —
+    the paged engine's single memory manager for decode slots AND the
+    prefix cache.
+
+    Unlike :class:`PrefixCache` this class owns NO device arrays: the
+    pools (``[capacity, block_tokens, H, D]`` per layer K/V) are the
+    paged module's cache variables, threaded through the engine's
+    compiled programs. The pool hands out *row ids*:
+
+    - :meth:`alloc` — take ``n`` private blocks for a slot (all-or-
+      nothing; evicts LRU unreferenced trie leaves when the free list is
+      dry; ``None`` means the caller must preempt someone or park);
+    - :meth:`free` — return private blocks;
+    - :meth:`match`/:meth:`release` — pin/unpin a shared prefix chain
+      (the slot's block table points at the pinned rows, zero-copy);
+    - :meth:`adopt` — a finished/preempted slot's complete blocks become
+      trie nodes in place (zero-copy prefix-cache insert); already-
+      cached duplicates are freed instead.
+
+    Write-sharing is impossible by construction (block-aligned matches;
+    appends go to private blocks), so the copy-on-write refcount's only
+    job is to keep shared rows from being evicted/reallocated under a
+    reader — there is never a copy to make.
+
+    ``kv_pool_blocks_{total,used,free}`` gauges plus the shared
+    ``prefix_cache_*`` hit/miss series publish into ``registry``.
+    """
+
+    def __init__(self, capacity: int, block_tokens: int, *,
+                 bytes_per_block: int = 0, registry=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        self._init_trie(capacity, block_tokens)
+        self.bytes_per_block = int(bytes_per_block)
+        # High-water mark of blocks in use — what a byte budget must
+        # actually cover; serving_bench turns it into tokens-per-byte.
+        self.peak_blocks_used = 0
+        self._g_pool = None
+        if registry is not None:
+            self._metrics = _register_trie_metrics(registry)
+            self._metrics["capacity"].set(self.capacity)
+            self._g_pool = {
+                "total": registry.gauge(
+                    "kv_pool_blocks_total",
+                    help="KV block pool capacity (blocks)"),
+                "used": registry.gauge(
+                    "kv_pool_blocks_used",
+                    help="KV blocks held by decode slots or the prefix "
+                         "trie"),
+                "free": registry.gauge(
+                    "kv_pool_blocks_free", help="KV blocks on the free "
+                                                "list"),
+            }
+            self._g_pool["total"].set(self.capacity)
+            self._note_occupancy()
+
+    def stats(self) -> dict:
+        total = self.hit_tokens + self.miss_tokens
+        return {
+            "block_tokens": self.block_tokens,
+            "capacity_blocks": self.capacity,
+            "blocks_used": self.blocks_used,
+            "blocks_free": self.blocks_free,
+            "peak_blocks_used": self.peak_blocks_used,
+            "bytes_per_block": self.bytes_per_block,
+            "bytes_used": self.blocks_used * self.bytes_per_block,
+            "lookups": self.lookups,
+            "hit_requests": self.hit_requests,
+            "hit_tokens": self.hit_tokens,
+            "miss_tokens": self.miss_tokens,
+            "hit_rate": (self.hit_tokens / total) if total else 0.0,
+            "inserted_blocks": self.inserted_blocks,
+            "evicted_blocks": self.evicted_blocks,
+            "flushes": self.flushes,
+        }
+
+    # -- slot allocation ----------------------------------------------------
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` private block rows, evicting LRU unreferenced trie
+        leaves as needed. All-or-nothing: on shortfall every row taken is
+        returned and ``None`` comes back (the caller preempts a slot or
+        parks the request) — a partial grant would leave a slot unable
+        to write its next token with no way to make progress."""
+        if n <= 0:
+            return []
+        got: list[int] = []
+        while len(got) < n:
+            slot = self._alloc(protect=None)
+            if slot is None:
+                self._free.extend(got)
+                return None
+            got.append(slot)
+        self.peak_blocks_used = max(self.peak_blocks_used, self.blocks_used)
+        if self._metrics is not None:
+            self._note_occupancy()
+        return got
+
+    def free(self, ids) -> None:
+        """Return private rows to the free list. Only rows handed out by
+        :meth:`alloc` and not since adopted may be freed."""
+        if not len(ids):
+            return
+        self._free.extend(int(i) for i in ids)
+        self.version += 1
+        if self._metrics is not None:
+            self._note_occupancy()
+
+    def adopt(self, tokens, ids, first_block: int) -> int:
+        """Zero-copy prefix-cache insert: chain the slot's private rows
+        ``ids`` — holding the K/V of ``tokens``' blocks ``first_block,
+        first_block+1, ...`` — into the trie, making them shareable (and
+        evictable once unreferenced). Blocks the trie already holds (a
+        concurrent request cached the same prefix first) free our
+        duplicate row instead; rows past ``tokens``' complete blocks are
+        freed too. Returns the count actually adopted."""
+        keys = list(self._blocks(tokens, len(tokens) // self.block_tokens))
+        node = self._root
+        for key in keys[:first_block]:
+            child = node.children.get(key)
+            if child is None:
+                # The matched prefix chain this slot hung off was flushed
+                # or evicted out from under a non-pinned walk — cannot
+                # attach a disconnected suffix; just free the rows.
+                self.free(ids)
+                return 0
+            node = child
+        now = next(self._clock)
+        adopted = 0
+        extra: list[int] = []
+        for key, slot in zip(keys[first_block:], ids):
+            child = node.children.get(key)
+            if child is not None:
+                extra.append(int(slot))  # duplicate: cached copy wins
+                self._touch(child, now)
+                node = child
+                continue
+            child = _Node(int(slot), node, key)
+            node.children[key] = child
+            self._by_slot[int(slot)] = child
+            self._touch(child, now)
+            node = child
+            adopted += 1
+        tail = len(keys) - first_block
+        extra.extend(int(s) for s in ids[max(0, tail):])
+        if extra:
+            self.free(extra)
+        self.inserted_blocks += adopted
+        self.version += 1  # adopted rows are now evictable
+        if self._metrics is not None:
+            if adopted:
+                self._metrics["inserts"].inc(adopted)
+            self._note_occupancy()
+        return adopted
+
+    def _note_occupancy(self) -> None:
+        if self._metrics is not None:
+            self._metrics["used"].set(self.blocks_used)
+            self._metrics["bytes"].set(
+                self.blocks_used * self.bytes_per_block)
+        if self._g_pool is not None:
+            self._g_pool["used"].set(self.blocks_used)
+            self._g_pool["free"].set(self.blocks_free)

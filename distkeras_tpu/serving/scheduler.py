@@ -24,6 +24,7 @@ from distkeras_tpu.telemetry.request_trace import (
 __all__ = [
     "ServingError",
     "QueueFullError",
+    "PoolExhausted",
     "RequestTimeout",
     "EngineStopped",
     "Request",
@@ -44,6 +45,16 @@ class QueueFullError(ServingError):
     """Backpressure: queue is at ``max_depth``; retry later."""
 
     code = "queue_full"
+
+
+class PoolExhausted(ServingError):
+    """The request can NEVER fit the paged KV pool: the blocks its full
+    context (prompt + max_new_tokens) needs exceed the pool's capacity.
+    Rejected at admission, before any device work — unlike transient
+    pressure (queued until blocks free, or resolved by preemption), this
+    is a sizing error only a bigger ``--kv-pool-mb`` fixes."""
+
+    code = "kv_oom"
 
 
 class RequestTimeout(ServingError):
@@ -175,6 +186,11 @@ class Scheduler:
         self.max_overtake = int(max_overtake)
         self._heap: list[tuple[int, int, Request]] = []
         self._seq = itertools.count()
+        # Requeues (preemption, admission park) jump to the FRONT of
+        # their priority class: sequence numbers from a deeply negative
+        # counter sort before every arrival seq (which starts at 0)
+        # while staying FIFO among requeues themselves.
+        self._requeue_seq = itertools.count(-(2**62))
         self._arrival = asyncio.Event()
         # Requests found expired during pop(), awaiting pickup by expire().
         self._expired_backlog: list[Request] = []
@@ -182,7 +198,7 @@ class Scheduler:
         # depth gauge, so a scrape sees queue pressure without waiting for
         # the engine's next sample() record.
         self._c_submitted = self._c_shed = self._g_depth = None
-        self._c_cache_preferred = None
+        self._c_cache_preferred = self._c_requeued = None
         if registry is not None:
             self._c_submitted = registry.counter(
                 "scheduler_submitted_total", help="requests enqueued")
@@ -195,6 +211,10 @@ class Scheduler:
                 "scheduler_cache_preferred_total",
                 help="pops that served a prefix-cache hit ahead of an "
                      "older same-priority request")
+            self._c_requeued = registry.counter(
+                "scheduler_requeued_total",
+                help="requests returned to the queue head (KV preemption "
+                     "or admission parked on a dry pool)")
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -216,6 +236,21 @@ class Scheduler:
             self._note_depth()
         self._arrival.set()
 
+    def requeue(self, request: Request) -> None:
+        """Return an already-admitted (or popped-but-unadmittable)
+        request to the FRONT of its priority class — the preempt-and-
+        requeue half of KV-pool oversubscription. Bypasses ``max_depth``
+        (shedding a request the engine itself displaced would turn a
+        capacity wobble into a client-visible error) and keeps the
+        original ``t_submit`` so the deadline clock never resets."""
+        heapq.heappush(
+            self._heap,
+            (request.priority, next(self._requeue_seq), request))
+        if self._c_requeued is not None:
+            self._c_requeued.inc()
+            self._note_depth()
+        self._arrival.set()
+
     def _pop_valid(self, now: float):
         """Pop heap entries until a live one surfaces; dead ones (expired
         or cancelled while queued) go to the expired backlog so expire()
@@ -229,6 +264,13 @@ class Scheduler:
                 continue
             return item
         return None
+
+    def peek(self) -> Request | None:
+        """Non-destructive view of the head request (heap order), or
+        None if empty. May return an expired/cancelled request — callers
+        using peek() as an admission hint must still pop() for deadline
+        handling."""
+        return self._heap[0][2] if self._heap else None
 
     def pop(self, now: float | None = None) -> Request | None:
         """Highest-priority non-expired request, or None if empty.

@@ -197,6 +197,8 @@ class ServingServer:
             }
             if engine.prefix_cache is not None:
                 health["prefix_cache"] = engine.prefix_cache.stats()
+            if engine.kv_pool is not None:
+                health["kv_pool"] = engine.kv_pool.stats()
             if engine.auditor is not None:
                 health["recompile_audit"] = engine.auditor.report()
             if engine.slo_s is not None:

@@ -94,6 +94,11 @@ def main(argv=None) -> int:
                     help="warn when value < (1 - threshold) * prior")
     ap.add_argument("--all", action="store_true",
                     help="check every config, not just the latest-updated")
+    ap.add_argument("--only", default=None, metavar="PREFIX",
+                    help="restrict to config keys starting with PREFIX "
+                         "(e.g. 'serving/' to gate only the serving "
+                         "latency rows strictly while the noisier "
+                         "training rows stay warn-only)")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on regression (default: warn-only, exit 0)")
     args = ap.parse_args(argv)
@@ -105,6 +110,8 @@ def main(argv=None) -> int:
         return 0
 
     keys = list(hist)
+    if args.only:
+        keys = [k for k in keys if k.startswith(args.only)]
     if not args.all:
         # Most recently updated config(s) only — the rows the run just
         # wrote. A serving-bench run records many metrics with one

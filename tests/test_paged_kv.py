@@ -1,0 +1,397 @@
+"""Paged KV memory: one block pool for decode slots AND the prefix cache.
+
+The invariants under test, all on CPU with a tiny causal LM:
+
+- paged greedy streams are token-identical to offline ``generate()`` AND
+  to the dense-cache engine, including prefix-cache hit/miss and
+  evict-round-trip cases (the paged pool doubles as the prefix cache);
+- the single-compiled-decode-step invariant survives paging: an ARMED
+  ``RecompileAuditor`` stays silent across admissions, block-table
+  growth, preemptions, and long-context requests;
+- oversubscription: a pool sized to force preemption under load still
+  completes every request token-identically (preempt -> adopt blocks ->
+  requeue -> resume prefill folds streamed tokens back in), and the
+  request's timeline shows both admission hops under one trace_id;
+- long-context admission: a request longer than a dense engine's padded
+  max (same byte budget) is served to completion because blocks chain
+  on demand instead of being pre-reserved;
+- requests that can NEVER fit the pool are rejected with the typed
+  ``kv_oom`` error at submit, before any device work;
+- pool health is observable: ``kv_pool_blocks_{total,used,free}``
+  gauges, ``kv_preemptions_total`` / ``kv_oom_rejections_total``
+  counters, and per-slot block-table depth in ``debugz``.
+
+``KVBlockPool`` unit behavior (alloc/free/adopt/match, model-free) rides
+along at the top — it is the host-side allocator everything above
+leans on.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.inference.generate import generate
+from distkeras_tpu.models.bert import gpt_tiny
+from distkeras_tpu.serving import (
+    KVBlockPool,
+    PoolExhausted,
+    ServingEngine,
+    ServingMetrics,
+)
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = gpt_tiny(seq_len=32, vocab_size=VOCAB)
+    return model, model.init(0)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _prompt(rng, n):
+    return rng.integers(0, VOCAB, size=(n,)).tolist()
+
+
+def _want(lm, prompt, n):
+    model, variables = lm
+    return generate(model, variables, np.asarray([prompt], np.int32), n,
+                    greedy=True)[0].tolist()
+
+
+async def _run_engine(engine, coro):
+    task = asyncio.create_task(engine.run())
+    try:
+        return await coro
+    finally:
+        engine.shutdown(drain=True)
+        await task
+
+
+# -- KVBlockPool unit behavior (model-free) ----------------------------------
+
+def test_block_pool_alloc_is_all_or_nothing():
+    pool = KVBlockPool(4, 2)
+    got = pool.alloc(3)
+    assert len(got) == 3 and pool.blocks_free == 1
+    # Shortfall: nothing is kept, the partial grant is rolled back.
+    assert pool.alloc(2) is None
+    assert pool.blocks_free == 1
+    pool.free(got)
+    assert pool.blocks_free == 4
+
+
+def test_block_pool_adopt_then_match_zero_copy():
+    """A finished slot's complete blocks become trie nodes IN PLACE: a
+    later match returns the same pool row ids (no store copy), pinned so
+    alloc-side eviction cannot reallocate them."""
+    pool = KVBlockPool(4, 2)
+    ids = pool.alloc(3)
+    tokens = [1, 2, 3, 4, 5]  # blocks (1,2), (3,4); 5 is incomplete
+    adopted = pool.adopt(tokens, ids, 0)
+    assert adopted == 2
+    # The incomplete tail block's row went back to the free list.
+    assert pool.blocks_free == 2
+    m = pool.match([1, 2, 3, 4, 9, 9])
+    assert m.matched_tokens == 4
+    assert list(m.ids) == [int(i) for i in ids[:2]]  # the SAME rows
+    # Pinned rows survive allocation pressure (all-or-nothing fails
+    # rather than evicting a pinned chain).
+    assert pool.alloc(3) is None
+    pool.release(m)
+    assert len(pool.alloc(3)) == 3  # now the LRU chain was evictable
+
+
+def test_block_pool_adopt_duplicate_frees_loser():
+    """Two slots computing the same prefix: the second adoption keeps the
+    cached copy and frees its duplicate rows."""
+    pool = KVBlockPool(4, 2)
+    a = pool.alloc(1)
+    assert pool.adopt([1, 2], a, 0) == 1
+    b = pool.alloc(1)
+    assert pool.adopt([1, 2], b, 0) == 0  # duplicate: cached copy wins
+    assert pool.blocks_free == 3  # b's row was freed, a's retained
+    assert pool.match([1, 2, 7]).matched_tokens == 2
+
+
+def test_block_pool_version_moves_on_free_and_adopt():
+    """The engine's admission-parking heuristic watches ``version``: it
+    must move whenever blocks become free or evictable."""
+    pool = KVBlockPool(4, 2)
+    v0 = pool.version
+    ids = pool.alloc(2)
+    pool.free(ids)
+    assert pool.version > v0
+    v1 = pool.version
+    ids = pool.alloc(1)
+    pool.adopt([1, 2], ids, 0)
+    assert pool.version > v1
+
+
+# -- paged engine: parity + the compile invariant ----------------------------
+
+def test_paged_parity_vs_generate_and_dense_with_armed_auditor(lm, rng):
+    """THE tentpole invariant: paged greedy output is token-identical to
+    offline generate() and to the dense-cache engine across staggered
+    admissions into freed slots — and the ARMED auditor proves the
+    decode step compiled exactly once while block tables changed under
+    it every admission."""
+    from distkeras_tpu.telemetry import RecompileAuditor
+
+    model, variables = lm
+    auditor = RecompileAuditor()
+    paged = ServingEngine(model, variables, slots=2, max_queue=8,
+                          kv_pool_blocks=64, kv_block_tokens=4,
+                          auditor=auditor, arm_auditor_after_warmup=True)
+    dense = ServingEngine(model, variables, slots=2, max_queue=8)
+    prompts = [_prompt(rng, n) for n in (5, 9, 3, 7, 4)]
+
+    async def work(engine):
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(engine.submit(p, 6))
+            await asyncio.sleep(0.01 * i)  # arrive mid-decode, post-arming
+        return [await r.result() for r in reqs]
+
+    got_paged = asyncio.run(_run_engine(paged, work(paged)))
+    got_dense = asyncio.run(_run_engine(dense, work(dense)))
+    want = [_want(lm, p, 6) for p in prompts]
+    assert got_paged == want
+    assert got_dense == want
+    assert auditor.compiles("serving_decode") == 1
+    assert auditor.report()["serving_decode"]["armed"]
+    assert paged.decode_compile_count() in (1, -1)
+    # Slot teardown adopted every finished sequence's complete blocks
+    # into the trie; nothing leaked to a non-free, non-trie limbo.
+    assert paged.active_slots == 0
+    assert all((t == paged._sentinel).all() for t in paged._tables)
+
+
+def test_paged_prefix_hits_are_zero_copy_and_parity_exact(lm, rng):
+    """Paged prefix caching is inherent: repeated prompt prefixes match
+    the blocks ADOPTED from earlier slots (no store copy ever ran) and
+    the hit's output stays token-identical, chunked admission included."""
+    model, variables = lm
+    engine = ServingEngine(model, variables, slots=1, max_queue=16,
+                           kv_pool_blocks=32, kv_block_tokens=4,
+                           prefill_chunk=4)
+    shared = _prompt(rng, 12)
+    prompts = [shared + _prompt(rng, k) for k in (3, 4, 5, 3)]
+
+    async def drive():
+        outs = []
+        for p in prompts:  # sequential: later prompts hit earlier ones
+            outs.append(await engine.submit(p, 5).result())
+        return outs
+
+    outs = asyncio.run(_run_engine(engine, drive()))
+    assert outs == [_want(lm, p, 5) for p in prompts]
+    s = engine.kv_pool.stats()
+    assert s["hit_requests"] >= 3  # every repeat matched the prefix
+    assert s["hit_tokens"] >= 3 * 12
+    # Zero-copy: blocks entered the trie by adoption, not a device store
+    # (the paged engine has no store program at all).
+    assert s["inserted_blocks"] > 0
+    assert engine.decode_compile_count() in (1, -1)
+
+
+def test_paged_hit_after_evict_round_trip(lm, rng):
+    """Evicting a cached prefix under pool pressure costs performance,
+    never correctness: A cached -> displaced by B/C -> A re-prefilled
+    and re-adopted -> A hits again; parity holds throughout."""
+    model, variables = lm
+    # 5 blocks x 4 tokens: a finished 15-token sequence adopts 3 blocks,
+    # so b's from-scratch admission (3 private + 1 growth) must evict
+    # part of a's resident chain.
+    engine = ServingEngine(model, variables, slots=1, max_queue=16,
+                           kv_pool_blocks=5, kv_block_tokens=4)
+    a, b = _prompt(rng, 11), _prompt(rng, 11)
+
+    async def drive():
+        outs = []
+        for p in (a, a, b, a, a):  # hit, evict via b, miss, re-hit
+            outs.append(await engine.submit(p, 4).result())
+        return outs
+
+    outs = asyncio.run(_run_engine(engine, drive()))
+    wa, wb = _want(lm, a, 4), _want(lm, b, 4)
+    assert outs == [wa, wa, wb, wa, wa]
+    s = engine.kv_pool.stats()
+    assert s["evicted_blocks"] > 0  # pressure really displaced blocks
+    assert s["hit_requests"] >= 2
+
+
+# -- oversubscription: preempt-and-requeue -----------------------------------
+
+def test_preempt_and_requeue_completes_token_identical(lm, rng):
+    """THE satellite invariant: a pool sized to force preemption under
+    concurrent load must still complete every request with output
+    token-identical to the unconstrained run — and the preempted
+    request's timeline shows the preemption and BOTH admission hops
+    under one trace_id."""
+    from distkeras_tpu.telemetry import RecompileAuditor, TraceStore
+
+    model, variables = lm
+    auditor = RecompileAuditor()
+    store = TraceStore()
+    # 4 slots x (12-token prompt + 10 new) needs ~4 * 6 blocks at
+    # completion; 13 blocks can hold ~2 full sequences, so concurrent
+    # decode growth MUST preempt.
+    tight = ServingEngine(model, variables, slots=4, max_queue=16,
+                          kv_pool_blocks=13, kv_block_tokens=4,
+                          trace_store=store, auditor=auditor,
+                          arm_auditor_after_warmup=True)
+    roomy = ServingEngine(model, variables, slots=4, max_queue=16,
+                          kv_pool_blocks=64, kv_block_tokens=4)
+    prompts = [_prompt(rng, 12) for _ in range(4)]
+
+    async def work(engine):
+        reqs = [engine.submit(p, 10) for p in prompts]
+        return [await r.result() for r in reqs]
+
+    got_tight = asyncio.run(_run_engine(tight, work(tight)))
+    got_roomy = asyncio.run(_run_engine(roomy, work(roomy)))
+    want = [_want(lm, p, 10) for p in prompts]
+    assert got_tight == want, "preempt-and-requeue changed output"
+    assert got_roomy == want
+    assert tight.metrics.preemptions > 0, (
+        "pool was supposed to be tight enough to force preemption")
+    # The armed auditor held through every preemption + re-admission.
+    assert auditor.compiles("serving_decode") == 1
+    # The preempted request's merged timeline: one trace_id, a preempt
+    # event, and an admission hop on EACH side of it.
+    preempted = [rec for rec in store.recent(10)
+                 if any(e[0] == "preempt" for e in rec["events"])]
+    assert preempted, "no preempted request left a timeline"
+    for rec in preempted:
+        names = [e[0] for e in rec["events"]]
+        assert names.count("admit") >= 2, names
+        assert names.index("admit") < names.index("preempt") < (
+            len(names) - 1 - names[::-1].index("admit"))
+        assert rec["trace_id"]  # one id spans both hops
+
+
+def test_oversubscribed_sequential_load_never_wedges(lm, rng):
+    """Many queued requests against a pool that fits ~one at a time:
+    admission parks on the dry pool, unparks as slots free, and every
+    request completes correctly (no deadlock, no starvation)."""
+    model, variables = lm
+    engine = ServingEngine(model, variables, slots=2, max_queue=32,
+                           kv_pool_blocks=7, kv_block_tokens=4)
+    prompts = [_prompt(rng, 9) for _ in range(6)]
+
+    async def work():
+        reqs = [engine.submit(p, 6) for p in prompts]
+        return [await r.result() for r in reqs]
+
+    outs = asyncio.run(_run_engine(engine, work()))
+    assert outs == [_want(lm, p, 6) for p in prompts]
+
+
+# -- long-context admission + typed OOM --------------------------------------
+
+def test_paged_serves_context_beyond_dense_padded_max(lm, rng):
+    """The capacity headline in miniature: at the SAME byte budget a
+    dense engine must shrink its padded per-slot max (max_context) to
+    afford its slots, rejecting longer requests up front — the paged
+    engine chains blocks on demand and serves the same request to
+    completion, token-identically."""
+    model, variables = lm
+    # Dense at this budget: 2 slots x 16-position rows. 8 blocks x 4
+    # tokens is the same 32 positions' worth of KV bytes.
+    dense = ServingEngine(model, variables, slots=2, max_context=16)
+    paged = ServingEngine(model, variables, slots=2,
+                          kv_pool_blocks=8, kv_block_tokens=4)
+    long_prompt = _prompt(rng, 20)  # + 6 new = 26 > dense's padded 16
+
+    with pytest.raises(ValueError, match="context cap"):
+        dense.submit(long_prompt, 6)
+
+    async def drive():
+        return await paged.submit(long_prompt, 6).result()
+
+    got = asyncio.run(_run_engine(paged, drive()))
+    assert got == _want(lm, long_prompt, 6)
+
+
+def test_paged_prefill_bucket_never_overshoots_trained_context(lm, rng):
+    """Regression: with a block size that does NOT divide the context
+    (table reach rounds UP past max_seq_len) a prefix hit near the
+    trained limit used to let the tail chunk's pad width overshoot the
+    positional table — the positional dynamic_slice then clamps
+    BACKWARD and embeds the chunk's real tokens at wrong positions.
+    The pad-width bound must be the context limit, not the table
+    reach."""
+    # seq 64 == the positional table's full length (no slack), and 12
+    # does not divide it: the table reach rounds up to 72 > 64.
+    model = gpt_tiny(seq_len=64, vocab_size=VOCAB)
+    variables = model.init(0)
+    engine = ServingEngine(model, variables, slots=1, max_queue=8,
+                           kv_pool_blocks=16, kv_block_tokens=12)
+    prompt = _prompt(rng, 61)  # + 3 new = the full trained context
+
+    async def drive():
+        outs = []
+        for _ in range(2):  # second run hits 60 cached tokens: the
+            # tail chunk prefills 1 token at pos 60, padded past it
+            outs.append(await engine.submit(prompt, 3).result())
+        return outs
+
+    outs = asyncio.run(_run_engine(engine, drive()))
+    want = generate(model, variables, np.asarray([prompt], np.int32), 3,
+                    greedy=True)[0].tolist()
+    assert outs == [want, want], "positional clamp corrupted the hit"
+    assert engine.kv_pool.stats()["hit_tokens"] >= 60
+
+
+def test_pool_exhausted_is_typed_and_counted(lm, rng):
+    """A request whose full context can NEVER fit the pool is a sizing
+    error: typed ``kv_oom`` reject at submit, before any device work,
+    with the counter bumped — unlike transient pressure, which queues."""
+    model, variables = lm
+    engine = ServingEngine(model, variables, slots=1,
+                           kv_pool_blocks=3, kv_block_tokens=4)
+    with pytest.raises(PoolExhausted) as ei:
+        engine.submit(_prompt(rng, 10), 8)  # 17 resident > 12 poolable
+    assert ei.value.code == "kv_oom"
+    assert engine.metrics.oom_rejections == 1
+
+
+# -- observability ------------------------------------------------------------
+
+def test_pool_gauges_counters_and_debugz_block_depth(lm, rng):
+    """Satellite: kv_pool_blocks_{total,used,free} gauges and the
+    preemption/oom counters publish to the registry, and the debugz slot
+    table carries per-slot block-table depth while a request decodes."""
+    model, variables = lm
+    metrics = ServingMetrics()
+    engine = ServingEngine(model, variables, slots=2, max_queue=8,
+                           kv_pool_blocks=16, kv_block_tokens=4,
+                           metrics=metrics)
+    seen = {}
+
+    async def drive():
+        req = engine.submit(_prompt(rng, 9), 8)
+        async for _ in req.tokens():
+            if "dz" not in seen:
+                seen["dz"] = engine.debugz()
+        return req
+
+    asyncio.run(_run_engine(engine, drive()))
+    snap = metrics.registry.snapshot()
+    assert snap["kv_pool_blocks_total"]["value"] == 16
+    assert (snap["kv_pool_blocks_used"]["value"]
+            + snap["kv_pool_blocks_free"]["value"]) == 16
+    assert snap["kv_preemptions_total"]["kind"] == "counter"
+    assert snap["kv_oom_rejections_total"]["kind"] == "counter"
+    # Mid-decode debugz: the busy slot reported its block-table depth.
+    busy = [s for s in seen["dz"]["slots"] if s["state"] != "free"]
+    assert busy and busy[0]["blocks"] >= 3  # 9 prompt tokens -> >= 3 blocks
+    assert "shared_blocks" in busy[0]
+    kp = seen["dz"]["kv_pool"]
+    assert kp["capacity_blocks"] == 16 and kp["blocks_free"] < 16

@@ -81,6 +81,25 @@ def test_scheduler_priority_fifo_and_backpressure():
     asyncio.run(go())
 
 
+def test_scheduler_peek_is_nondestructive_head():
+    """peek() shows the next pop without consuming it — the paged
+    engine's admission-park gate watches it so a parked head that is
+    displaced (higher-priority arrival, cancel/expire) reopens
+    admission without waiting for the pool version to move."""
+    async def go():
+        s = Scheduler(max_depth=4)
+        assert s.peek() is None
+        a = Request([1], 1, priority=1)
+        s.submit(a)
+        assert s.peek() is a and s.peek() is a  # non-destructive
+        b = Request([2], 1, priority=0)
+        s.submit(b)
+        assert s.peek() is b  # higher priority displaced the head
+        assert s.pop() is b and s.peek() is a
+
+    asyncio.run(go())
+
+
 def test_scheduler_expires_queued_deadlines():
     async def go():
         s = Scheduler(max_depth=4)
