@@ -12,14 +12,28 @@ serialized-pytree format ``Model.save_weights`` writes and ``run.py
 serve --weights`` / the cluster's rolling ``reload`` verb read. Saves
 are ATOMIC (tmp + ``os.replace``) — the reload contract is that a
 replica reading the path mid-publish sees either the old file or the
-new one, never a torn write. These helpers need only numpy/jax, so a
-serving host without orbax installed can still hot-reload weights (the
-orbax import is gated; only :class:`CheckpointManager` requires it).
+new one, never a torn write — and every save is **stamped with weight
+provenance**: a monotonic ``version`` (prior version at the path + 1)
+and a content ``digest`` (sha256 of the serialized pytree bytes,
+truncated), embedded as an extra zip member the array loaders ignore.
+The serving stack carries that stamp from the file into every response
+and trace timeline, so a bad served answer names the exact weights that
+produced it (:func:`weights_provenance` reads the stamp back; for
+legacy un-stamped files it computes the SAME digest the stamper would
+have, since the file bytes ARE the serialized pytree there). These
+helpers need only numpy/jax, so a serving host without orbax installed
+can still hot-reload weights (the orbax import is gated; only
+:class:`CheckpointManager` requires it).
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
+import json
 import os
+import time
+import zipfile
 from typing import Any
 
 import jax
@@ -30,24 +44,69 @@ try:
 except ImportError:  # pragma: no cover - present in the dev container
     ocp = None
 
-__all__ = ["CheckpointManager", "save_weights_file", "load_weights_file"]
+__all__ = [
+    "CheckpointManager",
+    "save_weights_file",
+    "load_weights_file",
+    "load_weights_file_with_provenance",
+    "load_weights_meta",
+    "weights_provenance",
+    "weights_digest",
+]
+
+# Zip member carrying the provenance stamp. The npz readers
+# (deserialize_pytree) touch only ``leaf_*`` and ``__treedef__`` members,
+# so stamped files stay loadable by every existing reader — and by
+# np.load directly.
+_META_MEMBER = "__weights_meta__.json"
 
 
-def save_weights_file(path: str, variables: Any) -> str:
+def weights_digest(data: bytes) -> str:
+    """The ONE content-digest definition for weight files: sha256 over
+    the serialized-pytree bytes (BEFORE the stamp member is appended),
+    truncated to 16 hex chars — short enough for a log line, unique
+    enough for a fleet's weight churn."""
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+def save_weights_file(path: str, variables: Any,
+                      version: int | None = None,
+                      meta: dict | None = None) -> str:
     """Write ``variables`` (any pytree of arrays — typically the model's
     ``{"params": ...}`` dict) to ``path`` in the serialized-pytree format,
     atomically: the bytes land in a same-directory temp file first and
     ``os.replace`` publishes them, so a concurrent reader (a replica
-    executing ``reload``) can never observe a half-written file. Returns
-    ``path``."""
+    executing ``reload``) can never observe a half-written file.
+
+    Every save is stamped: ``version`` defaults to the previous stamped
+    version at ``path`` plus one (1 for a fresh path) — monotonic per
+    publish path, which is exactly the train→serve loop's cadence —
+    plus the content ``digest`` and a wall-clock ``saved_at``. ``meta``
+    merges extra caller fields (e.g. the trainer's step) into the stamp.
+    Returns ``path``."""
     from distkeras_tpu.utils.pytree import pytree_to_host, serialize_pytree
 
     data = serialize_pytree(pytree_to_host(variables))
+    if version is None:
+        prev = load_weights_meta(path)
+        version = int(prev.get("version", 0)) + 1 if prev else 1
+    stamp = {
+        "version": int(version),
+        "digest": weights_digest(data),
+        "saved_at": time.time(),
+        **(meta or {}),
+    }
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
+        # Stamp the tmp FILE in place (zip append) rather than an
+        # in-memory copy: `data` is the only full serialized copy held —
+        # a multi-GB save must not transiently triple host memory.
         with open(tmp, "wb") as f:
             f.write(data)
-            f.flush()
+        del data
+        with zipfile.ZipFile(tmp, "a") as z:
+            z.writestr(_META_MEMBER, json.dumps(stamp))
+        with open(tmp, "rb") as f:
             os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
@@ -69,6 +128,68 @@ def load_weights_file(path: str, like: Any | None = None) -> Any:
 
     with open(path, "rb") as f:
         return deserialize_pytree(f.read(), like=like)
+
+
+def load_weights_file_with_provenance(
+        path: str, like: Any | None = None) -> tuple[Any, dict]:
+    """One-read variant for reload paths: arrays AND provenance come
+    from the SAME file bytes, so a concurrent atomic re-publish can
+    never pair version N's arrays with version N+1's stamp."""
+    from distkeras_tpu.utils.pytree import deserialize_pytree
+
+    with open(path, "rb") as f:
+        data = f.read()
+    provenance = _provenance_from_bytes(data)
+    provenance["path"] = os.path.abspath(path)
+    return deserialize_pytree(data, like=like), provenance
+
+
+def _provenance_from_bytes(data: bytes) -> dict:
+    try:
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            if _META_MEMBER in z.namelist():
+                meta = json.loads(z.read(_META_MEMBER).decode("utf-8"))
+                if isinstance(meta, dict) and meta.get("digest"):
+                    return {"version": int(meta.get("version", 0)),
+                            "digest": str(meta["digest"])}
+    except (ValueError, KeyError, zipfile.BadZipFile):
+        pass
+    return {"version": 0, "digest": weights_digest(data)}
+
+
+def load_weights_meta(path: str) -> dict | None:
+    """The provenance stamp of a weights file, without loading any
+    arrays (a zip central-directory read). None when the file is
+    missing, unreadable, or predates stamping."""
+    try:
+        with zipfile.ZipFile(path) as z:
+            if _META_MEMBER not in z.namelist():
+                return None
+            meta = json.loads(z.read(_META_MEMBER).decode("utf-8"))
+            return meta if isinstance(meta, dict) else None
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+        return None
+
+
+def weights_provenance(path: str) -> dict:
+    """``{"version": ..., "digest": ..., "path": ...}`` for a weights
+    file — the stamp when present; for a legacy un-stamped file the
+    digest is computed over the file bytes (identical to what the
+    stamper would have recorded, since an un-stamped file IS the bare
+    serialized pytree) with ``version=0``. This is what ``run.py
+    serve --weights`` and the ``reload`` verb hand the engine, and what
+    every response's ``weight_version`` field traces back to. Stamped
+    files cost a zip central-directory read; only the legacy fallback
+    (digest over the file bytes) reads the whole file."""
+    meta = load_weights_meta(path)
+    if meta and meta.get("digest"):
+        out = {"version": int(meta.get("version", 0)),
+               "digest": str(meta["digest"])}
+    else:
+        with open(path, "rb") as f:
+            out = _provenance_from_bytes(f.read())
+    out["path"] = os.path.abspath(path)
+    return out
 
 
 class CheckpointManager:

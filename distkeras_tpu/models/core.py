@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distkeras_tpu.utils.pytree import deserialize_pytree, serialize_pytree
+from distkeras_tpu.utils.pytree import deserialize_pytree
 
 __all__ = ["Model", "TrainedModel"]
 
@@ -187,8 +187,12 @@ class TrainedModel:
     # -- persistence ---------------------------------------------------------
 
     def save_weights(self, path: str) -> None:
-        with open(path, "wb") as f:
-            f.write(serialize_pytree(self.variables))
+        # Atomic + provenance-stamped (monotonic version, content
+        # digest): the serving stack traces every response back to the
+        # exact weights file that produced it.
+        from distkeras_tpu.checkpoint import save_weights_file
+
+        save_weights_file(path, self.variables)
 
     def load_weights(self, path: str) -> None:
         with open(path, "rb") as f:

@@ -104,7 +104,11 @@ class StampingClient:
 
     def _stamp(self, payload: dict) -> dict:
         self._counter += 1
-        return {**payload, "commit_id": f"w{self._worker_id}:{self._counter}"}
+        # ``worker`` rides along for the health layer's per-worker
+        # accounting (the commit_id encodes the same index, but parsing
+        # it back out is a fallback, not the contract).
+        return {**payload, "worker": self._worker_id,
+                "commit_id": f"w{self._worker_id}:{self._counter}"}
 
     def commit(self, payload: dict) -> None:
         self._client.commit(self._stamp(payload))

@@ -47,6 +47,7 @@ import optax
 
 from distkeras_tpu.utils.pytree import (
     pytree_add,
+    pytree_l2,
     pytree_scale,
     pytree_sub,
     pytree_to_host,
@@ -112,6 +113,35 @@ class AsyncProtocol:
         retried ``commit_pull`` caught by the PS dedupe window): nothing is
         re-applied, but the worker still needs an answer."""
         return center, num_updates
+
+    # -- health telemetry ----------------------------------------------------
+
+    def commit_stats(
+        self, center: PyTree, num_updates: int, payload: dict,
+        num_workers: int
+    ) -> dict:
+        """Health accounting for ONE commit, evaluated against the
+        PRE-commit PS state (the staleness and divergence definitions
+        need the counter/center the committer raced against). Called by
+        the PS loop when a :class:`~distkeras_tpu.telemetry.
+        training_health.TrainingHealth` is attached; one O(n_params)
+        host pass, same order as the commit apply itself. Returns:
+
+        - ``staleness`` — ``num_updates - last_update`` (the quantity
+          DynSGD damps by; 0 for a perfectly fresh pull);
+        - ``damping`` — the scalar mass factor this protocol applies to
+          the update (goodput = damped / committed mass);
+        - ``update_norm`` — L2 of the committed update, when the
+          payload carries one;
+        - ``divergence`` — elastic family only: ``||local - center||``.
+        """
+        out: dict = {"damping": 1.0}
+        last = payload.get("last_update")
+        if last is not None:
+            out["staleness"] = max(0, num_updates - int(last))
+        if "delta" in payload:
+            out["update_norm"] = pytree_l2(payload["delta"])
+        return out
 
     # -- worker side ---------------------------------------------------------
 
@@ -219,6 +249,11 @@ class ADAGProtocol(_DeltaWindowMixin, AsyncProtocol):
         scaled = pytree_scale(payload["delta"], 1.0 / max(1, num_workers))
         return pytree_add(center, scaled), num_updates + 1
 
+    def commit_stats(self, center, num_updates, payload, num_workers):
+        out = super().commit_stats(center, num_updates, payload, num_workers)
+        out["damping"] = 1.0 / max(1, num_workers)
+        return out
+
 
 class AEASGDProtocol(AsyncProtocol):
     """Asynchronous Elastic Averaging SGD (Zhang et al.; reference ``AEASGD``
@@ -295,6 +330,10 @@ class AEASGDProtocol(AsyncProtocol):
         self._last_reply: "collections.OrderedDict[str, tuple]" = (
             collections.OrderedDict()
         )
+        # Consume-once memo handing commit_stats' local-params
+        # reconstruction to the server_commit_pull that immediately
+        # follows it in the single-owner PS loop (see _local_of).
+        self._local_memo: tuple | None = None
 
     def server_commit(self, center, num_updates, payload, num_workers):
         return pytree_add(center, payload["delta"]), num_updates + 1
@@ -307,6 +346,44 @@ class AEASGDProtocol(AsyncProtocol):
         """Round a freshly-advanced mirror to the storage dtype — the ONE
         cast both sides share; any asymmetry here would split the mirrors."""
         return _wire_bf16(tree) if self.mirror_dtype == "bfloat16" else tree
+
+    def _local_of(self, payload):
+        """Reconstruct the committing worker's local params (bootstrap
+        ``local``, or steady-state mirror + ``elastic_diff``); None when
+        the mirror is gone and the diff alone cannot. One O(n_params)
+        host pass, shared between commit_stats and the
+        server_commit_pull that immediately follows it in the
+        single-owner PS loop via a consume-once memo — health telemetry
+        must not double the loop's dominant host cost."""
+        memo, self._local_memo = self._local_memo, None
+        if memo is not None and memo[0] is payload:
+            return memo[1]
+        if "elastic_diff" in payload:
+            wid = payload.get("worker_id")
+            if wid not in self._mirrors:
+                return None
+            return pytree_add(
+                _wire_f32(self._mirrors[wid]),
+                _wire_f32(payload["elastic_diff"]))
+        if "local" in payload:
+            return pytree_to_host(payload["local"])
+        return None
+
+    def commit_stats(self, center, num_updates, payload, num_workers):
+        """Elastic health: ``divergence = ||local - center||_2`` against
+        the pre-commit center (the quantity elastic averaging is built
+        to shrink — its growth IS the diverging-run signal), and the
+        applied force's norm ``alpha * divergence`` as the update mass.
+        The local-params reconstruction is memoized for the
+        server_commit_pull about to apply this same payload."""
+        out = super().commit_stats(center, num_updates, payload, num_workers)
+        local = self._local_of(payload)
+        if local is not None:
+            self._local_memo = (payload, local)
+            divergence = pytree_l2(pytree_sub(local, center))
+            out["divergence"] = divergence
+            out["update_norm"] = self.rho * self.learning_rate * divergence
+        return out
 
     def host_state_budget(self, n_params: int, num_workers: int) -> int:
         """Worst-case PS host bytes for this protocol's per-worker state:
@@ -324,7 +401,8 @@ class AEASGDProtocol(AsyncProtocol):
         # ``elastic_diff`` (bf16 delta against the shared mirror).
         wid = payload.get("worker_id")
         if "elastic_diff" in payload:
-            if wid not in self._mirrors:
+            local_est = self._local_of(payload)
+            if local_est is None:
                 # Mirror lost (PS restarted from checkpoint, or LRU-evicted):
                 # the diff alone cannot reconstruct the worker's local
                 # params. Apply nothing; the flagged counter tells the
@@ -336,9 +414,6 @@ class AEASGDProtocol(AsyncProtocol):
                 # _mirrors, so _set_mirror's eviction can never reach it).
                 zero = pytree_scale(payload["elastic_diff"], 0.0)  # stays bf16: unread
                 return center, num_updates, (zero, _REBOOTSTRAP | num_updates)
-            local_est = pytree_add(
-                _wire_f32(self._mirrors[wid]), _wire_f32(payload["elastic_diff"])
-            )
             e_wire = _wire_bf16(self._elastic(local_est, center))
             e = _wire_f32(e_wire)
             self._set_mirror(
@@ -348,7 +423,7 @@ class AEASGDProtocol(AsyncProtocol):
             self._set_reply(wid, reply, num_workers)
             return pytree_add(center, e), num_updates + 1, reply
         if "local" in payload:
-            local = pytree_to_host(payload["local"])
+            local = self._local_of(payload)
             e = self._elastic(local, center)
             reply = (e, num_updates)
             if wid is not None:
@@ -512,3 +587,11 @@ class DynSGDProtocol(_DeltaWindowMixin, AsyncProtocol):
         staleness = max(0, num_updates - int(payload["last_update"]))
         damped = pytree_scale(payload["delta"], 1.0 / (staleness + 1))
         return pytree_add(center, damped), num_updates + 1
+
+    def commit_stats(self, center, num_updates, payload, num_workers):
+        # The SAME damping expression server_commit applies — goodput
+        # accounting must never disagree with the update rule.
+        out = super().commit_stats(center, num_updates, payload, num_workers)
+        staleness = out.get("staleness", 0)
+        out["damping"] = 1.0 / (staleness + 1)
+        return out

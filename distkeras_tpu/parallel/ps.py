@@ -56,10 +56,18 @@ class ParameterServerService:
         num_workers: int,
         dedupe_window: int = 8192,
         registry=None,
+        health=None,
     ):
         self.protocol = protocol
         self.num_workers = int(num_workers)
         self._center = _to_host(center)
+        # Optional TrainingHealth (telemetry.training_health): per-commit
+        # staleness/divergence/goodput accounting, fed from inside the
+        # single-owner loop with the PRE-commit state each definition
+        # needs. Its observe hooks swallow their own exceptions.
+        self._health = health
+        if health is not None:
+            health.attach_ps(self)  # statusz folds in health() rollup
         # Optional telemetry (MetricsRegistry): live commit/duplicate
         # counters + queue-depth gauge, the scrapeable face of health().
         self._c_commits = self._c_dups = self._g_depth = None
@@ -126,6 +134,16 @@ class ParameterServerService:
                     if reply is not None:
                         reply.put(False)
                     continue
+                if self._health is not None:
+                    # Host-convert the delta ONCE (idempotent; the
+                    # protocol's host apply needs it anyway) so the
+                    # health layer's norm pass doesn't add a second
+                    # device-to-host transfer per commit.
+                    if "delta" in payload:
+                        payload["delta"] = _to_host(payload["delta"])
+                    self._health.observe_commit(
+                        self.protocol, self._center, self._num_updates,
+                        payload, self.num_workers)
                 self._center, self._num_updates = self.protocol.server_commit(
                     self._center, self._num_updates, payload, self.num_workers
                 )
@@ -144,6 +162,18 @@ class ParameterServerService:
                     )
                 else:
                     before = self._num_updates
+                    if self._health is not None:
+                        # Pre-apply: staleness/divergence are defined
+                        # against the state the committer raced with. A
+                        # no-op exchange (elastic re-bootstrap answer)
+                        # still counts the contact — its damping/norm
+                        # fields are simply absent. Delta host-converted
+                        # once, shared with the apply below.
+                        if "delta" in payload:
+                            payload["delta"] = _to_host(payload["delta"])
+                        self._health.observe_commit(
+                            self.protocol, self._center, self._num_updates,
+                            payload, self.num_workers)
                     (
                         self._center,
                         self._num_updates,
@@ -172,6 +202,8 @@ class ParameterServerService:
             self._num_duplicates += 1
             if self._c_dups is not None:
                 self._c_dups.inc()
+            if self._health is not None:
+                self._health.record_duplicate(payload)
             return True
         self._seen_ids[cid] = None
         while len(self._seen_ids) > self._dedupe_window:

@@ -144,6 +144,8 @@ class GrpcParameterServer:
         host: str = "127.0.0.1",
         port: int = DEFAULT_PORT,
         max_message_mb: int = 1024,
+        registry=None,
+        health=None,
     ):
         """``host`` defaults to loopback: the PS speaks an unauthenticated
         protocol, so exposing it beyond the host must be an explicit choice
@@ -151,11 +153,16 @@ class GrpcParameterServer:
         open PS port lets anyone pull weights or poison training with
         arbitrary deltas. ``max_message_mb`` bounds frame size (commit frames
         scale with model size; 1 GiB covers multi-hundred-M-param models
-        while still rejecting pathological frames)."""
+        while still rejecting pathological frames). ``registry``/``health``
+        thread straight through to the wrapped
+        :class:`ParameterServerService` — the gRPC front end adds no
+        telemetry of its own, so a remote fleet's commit staleness lands
+        in the same statusz a local one's does."""
         import grpc
 
         self._grpc = grpc
-        self.service = ParameterServerService(protocol, center, num_workers)
+        self.service = ParameterServerService(
+            protocol, center, num_workers, registry=registry, health=health)
         self._host = host
         self._port = port
         self._max_message_bytes = int(max_message_mb) * 1024 * 1024

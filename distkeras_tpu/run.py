@@ -171,6 +171,10 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                     help="request-latency SLO in ms: slower requests bump "
                          "serving_slo_violations_total and pin their full "
                          "timeline as a flight-recorder slow exemplar")
+    ap.add_argument("--profile-out", default=None, metavar="DIR",
+                    help="capture a jax.profiler (XLA) trace of the whole "
+                         "serve into this directory — the device-timeline "
+                         "complement to the host spans --trace-out writes")
     ap.add_argument("--flight-dir", default=None,
                     help="cluster mode: directory for per-replica flight-"
                          "recorder dumps (default: a fresh temp dir, "
@@ -195,10 +199,12 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     tracer = enable_tracing() if args.trace_out else None
     model = load_model(args.model, json.loads(args.model_args))
     variables = model.init(args.seed)
+    weight_version = None
     if args.weights:
-        from distkeras_tpu.checkpoint import load_weights_file
+        from distkeras_tpu.checkpoint import load_weights_file_with_provenance
 
-        variables = load_weights_file(args.weights, like=variables)
+        variables, weight_version = load_weights_file_with_provenance(
+            args.weights, like=variables)
     # One registry behind everything this process publishes — serving
     # metrics, the scheduler, the stream's last-value gauges, the auditor
     # — so a metricsz scrape shows the whole picture.
@@ -240,7 +246,8 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         kv_block_tokens=args.kv_block_tokens,
         max_context=args.max_context,
         trace_store=trace_store, flight_recorder=recorder,
-        slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
+        slo_s=args.slo_ms / 1e3 if args.slo_ms else None,
+        weight_version=weight_version)
     server = ServingServer(engine, host=args.host, port=args.port)
 
     async def go():
@@ -277,8 +284,15 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
             summary["recompile_audit"] = auditor.report()
         print(json.dumps(summary), flush=True)
 
+    import contextlib
+
+    from distkeras_tpu.telemetry import profile_trace
+
+    profiler = (profile_trace(args.profile_out) if args.profile_out
+                else contextlib.nullcontext())
     try:
-        asyncio.run(go())
+        with profiler:
+            asyncio.run(go())
     except KeyboardInterrupt:
         pass
     finally:
@@ -362,6 +376,11 @@ def cluster_main(args) -> int:
             extra += ["--metrics-out", f"{args.metrics_out}.r{i}"]
         if args.trace_out:
             extra += ["--trace-out", f"{args.trace_out}.r{i}"]
+        if args.profile_out:
+            # Each replica is its own jax process: per-replica profiler
+            # dirs, or N children race on one XLA trace session.
+            extra += ["--profile-out",
+                      os.path.join(args.profile_out, f"r{i}")]
         return extra
 
     def replica_env(i: int) -> dict[str, str]:
@@ -483,6 +502,58 @@ def debugz_main(argv=None) -> int:
     return 0
 
 
+def _write_statusz(trainer, path: str) -> bool:
+    """One atomic statusz snapshot (tmp + replace, same contract as the
+    weight publisher: a concurrent reader sees old or new, never torn).
+    False when the trainer has no training-health layer (yet)."""
+    health = getattr(trainer, "training_health", None)
+    if health is None:
+        return False
+    import threading
+
+    # Per-thread tmp name: the periodic writer thread and the final
+    # main-thread snapshot may overlap when join() times out on a
+    # wedged statusz() — two writers on ONE tmp path would interleave
+    # and os.replace would publish the torn result.
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(health.statusz(), f)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def statusz_main(argv=None) -> int:
+    """``statusz`` subcommand: pretty-print a training-health snapshot
+    file (the JSON ``train --statusz-out`` rewrites live) — worker
+    table, staleness percentiles, divergence, goodput, device memory.
+    Run it in a second terminal against a live run's file."""
+    ap = argparse.ArgumentParser(prog="distkeras_tpu.run statusz")
+    ap.add_argument("--file", required=True,
+                    help="statusz JSON written by train --statusz-out")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON payload instead of the pretty page")
+    args = ap.parse_args(argv)
+
+    from distkeras_tpu.serving.debugz import format_statusz
+
+    try:
+        with open(args.file) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"statusz: cannot read {args.file}: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(payload, indent=1) if args.json
+          else format_statusz(payload))
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -492,6 +563,10 @@ def main(argv=None) -> int:
         return serve_main(argv[1:], prog="cluster", default_replicas=2)
     if argv and argv[0] == "debugz":
         return debugz_main(argv[1:])
+    if argv and argv[0] == "statusz":
+        return statusz_main(argv[1:])
+    if argv and argv[0] == "train":  # explicit alias for the default mode
+        argv = argv[1:]
     ap = argparse.ArgumentParser(prog="distkeras_tpu.run")
     ap.add_argument("--config", required=True, help="TrainerConfig JSON file")
     ap.add_argument("--data", required=True, help=".npz (features/label) or CSV")
@@ -502,13 +577,31 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-out", default=None,
                     help="enable spans; write Chrome-trace JSON (Perfetto-"
                          "loadable) of the whole run here")
+    ap.add_argument("--profile-out", default=None, metavar="DIR",
+                    help="capture a jax.profiler (XLA) trace of the whole "
+                         "run into this directory — the device-timeline "
+                         "complement to --trace-out's host spans")
+    ap.add_argument("--statusz-out", default=None, metavar="PATH",
+                    help="async trainers: rewrite the training-health "
+                         "statusz snapshot (worker table, staleness "
+                         "percentiles, divergence, goodput, device memory) "
+                         "to this JSON file every --statusz-interval "
+                         "seconds; inspect live with `run.py statusz "
+                         "--file PATH`")
+    ap.add_argument("--statusz-interval", type=float, default=10.0,
+                    help="seconds between --statusz-out rewrites")
     ap.add_argument("--audit-recompiles", action="store_true",
                     help="count train-step compiles (+ triggering shapes); "
                          "report appears in the summary line")
     ap.add_argument("--shuffle", action="store_true")
     args = ap.parse_args(argv)
 
-    from distkeras_tpu.telemetry import RecompileAuditor, enable_tracing
+    from distkeras_tpu.telemetry import (
+        MetricsRegistry,
+        RecompileAuditor,
+        enable_tracing,
+        profile_trace,
+    )
     from distkeras_tpu.tracing import MetricStream
     from distkeras_tpu.utils.config import TrainerConfig
 
@@ -517,16 +610,44 @@ def main(argv=None) -> int:
     model = load_model(args.model, json.loads(args.model_args))
     ds = load_data(args.data, cfg.features_col, cfg.label_col)
     trainer = cfg.build(model)
+    # One registry behind the whole run: step counters, PS commit/dup
+    # counters, and (async trainers) the training-health histograms all
+    # land in the same scrapeable surface.
+    trainer.registry = MetricsRegistry()
     if args.metrics_out:
-        trainer.metric_stream = MetricStream.to_jsonl(args.metrics_out)
+        trainer.metric_stream = MetricStream.to_jsonl(
+            args.metrics_out, registry=trainer.registry)
     if args.audit_recompiles:
-        trainer.auditor = RecompileAuditor()
+        trainer.auditor = RecompileAuditor(registry=trainer.registry)
 
+    import contextlib
+    import threading
+
+    stop_statusz = threading.Event()
+    statusz_thread = None
+    if args.statusz_out:
+        def _statusz_loop():
+            while not stop_statusz.wait(args.statusz_interval):
+                _write_statusz(trainer, args.statusz_out)
+
+        statusz_thread = threading.Thread(
+            target=_statusz_loop, name="statusz-writer", daemon=True)
+        statusz_thread.start()
+
+    profiler = (profile_trace(args.profile_out) if args.profile_out
+                else contextlib.nullcontext())
     try:
-        trained = trainer.train(ds, shuffle=args.shuffle)
+        with profiler:
+            trained = trainer.train(ds, shuffle=args.shuffle)
     finally:
         # The JSONL stream owns a file handle; the trace is only useful
         # if it lands on disk even when training dies mid-run.
+        if statusz_thread is not None:
+            stop_statusz.set()
+            statusz_thread.join(timeout=5)
+            # Final snapshot: the post-mortem view even for runs shorter
+            # than one interval.
+            _write_statusz(trainer, args.statusz_out)
         if trainer.metric_stream is not None:
             trainer.metric_stream.close()
         if tracer is not None:
@@ -543,6 +664,16 @@ def main(argv=None) -> int:
         summary["recompile_audit"] = trainer.auditor.report()
     if args.trace_out:
         summary["trace_out"] = args.trace_out
+    if args.profile_out:
+        summary["profile_out"] = args.profile_out
+    if args.statusz_out and getattr(trainer, "training_health", None):
+        summary["statusz"] = args.statusz_out
+        health = trainer.training_health
+        stale = health.staleness_percentiles()
+        if stale:
+            summary["staleness_p99"] = round(stale["p99"], 3)
+        if health.goodput_ratio is not None:
+            summary["goodput_ratio"] = round(health.goodput_ratio, 6)
     if args.out:
         if isinstance(trained, list):  # EnsembleTrainer
             for i, t in enumerate(trained):

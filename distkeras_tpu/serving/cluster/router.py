@@ -501,19 +501,34 @@ class Router:
             fetched = await asyncio.gather(*(
                 self._fetch_verb(info, "healthz") for _, info in infos))
             replicas = {}
+            versions: dict[str, int] = {}
             for (rid, info), sub in zip(infos, fetched):
                 entry = info.public()
                 if sub is not None:
                     entry["healthz"] = sub
+                    # Weight-provenance rollup: count each reachable
+                    # replica's live (version, digest) so a mixed-
+                    # version fleet — a half-finished rolling reload, a
+                    # replica restarted onto stale weights — is visible
+                    # at the ROUTER, not only one replica at a time.
+                    wv = (sub.get("weight_version")
+                          if isinstance(sub, dict) else None)
+                    if isinstance(wv, dict):
+                        key = f"{wv.get('version')}:{wv.get('digest')}"
+                        versions[key] = versions.get(key, 0) + 1
                 replicas[rid] = entry
+            router = {
+                "replicas_total": len(self.supervisor.replicas),
+                "replicas_ready": self.supervisor.ready_count,
+                "outstanding_total": sum(
+                    r.outstanding
+                    for r in self.supervisor.replicas.values()),
+            }
+            if versions:
+                router["weight_versions"] = versions
+                router["mixed_weight_versions"] = len(versions) > 1
             return {"healthz": {
-                "router": {
-                    "replicas_total": len(self.supervisor.replicas),
-                    "replicas_ready": self.supervisor.ready_count,
-                    "outstanding_total": sum(
-                        r.outstanding
-                        for r in self.supervisor.replicas.values()),
-                },
+                "router": router,
                 "replicas": replicas,
             }}
         if cmd == "metricsz":

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import time
 
-__all__ = ["format_debugz", "format_tracez"]
+__all__ = ["format_debugz", "format_tracez", "format_statusz"]
 
 
 def _table(rows: list[dict], columns: list[tuple[str, str]]) -> list[str]:
@@ -47,6 +47,10 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
                  + (" SWAP-PENDING" if dz.get("pending_swap") else ""))
     if dz.get("slo_s") is not None:
         lines.append(f"{indent}slo={dz['slo_s']}s")
+    wv = dz.get("weight_version")
+    if isinstance(wv, dict):
+        lines.append(f"{indent}weights: v{wv.get('version')} "
+                     f"digest={wv.get('digest') or '-'}")
     slots = dz.get("slots", [])
     if slots:
         lines.append(f"{indent}slots:")
@@ -165,6 +169,90 @@ def format_debugz(payload: dict) -> str:
     else:
         lines.extend(_engine_section(payload))
     return "\n".join(lines)
+
+
+def format_statusz(payload: dict) -> str:
+    """Pretty-print a training-health statusz snapshot
+    (:meth:`distkeras_tpu.telemetry.training_health.TrainingHealth.
+    statusz`): run header, staleness/divergence/goodput rollup, the
+    per-worker vitals table, the PS rollup, and the per-device memory
+    table (``unavailable`` where the backend publishes no stats — never
+    a lying 0). Same scan discipline as debugz: run -> worker -> device,
+    in metric-triage order."""
+    lines: list[str] = []
+    lines.append(
+        f"training: protocol={payload.get('protocol') or '?'} "
+        f"workers={payload.get('num_workers')} "
+        f"uptime={payload.get('uptime_s', 0):.1f}s")
+    ps = payload.get("ps")
+    if isinstance(ps, dict):
+        lines.append(
+            f"ps: running={ps.get('running')} "
+            f"updates={ps.get('num_updates')} "
+            f"commits={ps.get('num_commits')} "
+            f"dups={ps.get('num_duplicates')} "
+            f"queue_depth={ps.get('queue_depth')} "
+            f"snapshot_failures={ps.get('snapshot_failures')}")
+    stale = payload.get("staleness")
+    if isinstance(stale, dict):
+        lines.append(
+            f"staleness: p50={stale.get('p50')} p90={stale.get('p90')} "
+            f"p99={stale.get('p99')} max={stale.get('max')} "
+            f"({stale.get('samples')} samples)")
+    if payload.get("divergence") is not None:
+        lines.append(f"divergence: ||local-center||={payload['divergence']}")
+    gp = payload.get("goodput")
+    if isinstance(gp, dict):
+        lines.append(
+            f"goodput: applied/committed update mass = "
+            f"{gp.get('applied_mass')}/{gp.get('update_mass')} "
+            f"(ratio {gp.get('ratio')})")
+    workers = payload.get("workers", [])
+    if workers:
+        lines.append("workers:")
+        cols = [("worker", "worker"), ("commits", "commits"),
+                ("dups", "duplicates"), ("pulls", "pulls"),
+                ("rebases", "rebases"), ("windows", "windows"),
+                ("last_commit_age_s", "last_commit_age_s"),
+                ("stale_last", "last_staleness"),
+                ("stale_p50", "staleness_p50"),
+                ("stale_p99", "staleness_p99"),
+                ("rate/s", "commit_rate_per_s")]
+        if any("divergence" in w for w in workers):
+            cols.append(("divergence", "divergence"))
+        for ln in _table(workers, cols):
+            lines.append(f"  {ln}")
+    mem = payload.get("memory", [])
+    if mem:
+        lines.append("device memory:")
+        rows = []
+        for m in mem:
+            if m.get("available"):
+                rows.append({
+                    "device": m.get("device"),
+                    "in_use_mb": _mb(m.get("bytes_in_use")),
+                    "limit_mb": _mb(m.get("bytes_limit")),
+                    "peak_mb": _mb(m.get("peak_bytes_in_use")),
+                    "headroom_mb": _mb(m.get("headroom_bytes")),
+                })
+            else:
+                # The typed sentinel: no data is NOT zero bytes.
+                rows.append({"device": m.get("device"),
+                             "in_use_mb": "unavailable"})
+        for ln in _table(rows, [("device", "device"),
+                                ("in_use_mb", "in_use_mb"),
+                                ("limit_mb", "limit_mb"),
+                                ("peak_mb", "peak_mb"),
+                                ("headroom_mb", "headroom_mb")]):
+            lines.append(f"  {ln}")
+    if payload.get("observe_errors"):
+        lines.append(f"observe_errors: {payload['observe_errors']} "
+                     f"(health hooks failing — see the training log)")
+    return "\n".join(lines)
+
+
+def _mb(n) -> str | None:
+    return None if n is None else f"{n / 2**20:.1f}"
 
 
 def _fmt_event(ts: float, source: str, name: str, attrs) -> str:

@@ -224,6 +224,18 @@ class _PrefillJob:
     device_s: float = 0.0         # prefill device time (TTFT's other half)
 
 
+def _public_provenance(provenance: dict | None) -> dict:
+    """The client-facing face of a weights stamp: version + digest
+    ONLY. checkpoint.weights_provenance also carries the server-side
+    file ``path`` (and trainer stamps arbitrary meta) — stamping that
+    into every done line and trace would disclose the server's
+    filesystem layout to remote clients."""
+    if not provenance:
+        return {"version": 0, "digest": None}
+    return {"version": int(provenance.get("version") or 0),
+            "digest": provenance.get("digest")}
+
+
 @dataclasses.dataclass
 class _SlotState:
     request: Request
@@ -329,6 +341,7 @@ class ServingEngine:
         trace_store: TraceStore | None = None,
         flight_recorder: FlightRecorder | None = None,
         slo_s: float | None = None,
+        weight_version: dict | None = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -411,7 +424,13 @@ class ServingEngine:
             raise ValueError(
                 f"top_k={top_k} outside [1, vocab_size={self._cfg.vocab_size}]"
             )
-        self._params = variables["params"]
+        # Device-resident params from the start. An engine booted from a
+        # weights FILE used to hold raw numpy leaves here — every jitted
+        # dispatch re-converted them, and the first param swap (which
+        # device_puts) then RETRACED the decode step: numpy and jax.Array
+        # arguments occupy different jit-cache entries. One transfer at
+        # construction makes boot and swap paths aval-identical.
+        self._params = jax.device_put(variables["params"])
         self.slots = int(slots)
         self.metrics = metrics or ServingMetrics()
         self.scheduler = Scheduler(max_depth=max_queue,
@@ -556,6 +575,21 @@ class ServingEngine:
         if self.slo_s is not None:
             self.metrics.set_slo(self.slo_s)
 
+        # Weight provenance: which checkpoint the live params came from
+        # ({"version": int, "digest": str} — see
+        # checkpoint.weights_provenance). Stamped into every request at
+        # admission, every done line, healthz/metricsz/debugz; updated
+        # by a successful param swap. An engine started on inline
+        # variables gets version 0 / digest None — the field is ALWAYS
+        # present so consumers never branch on its existence.
+        self.weight_version = _public_provenance(weight_version)
+        self.metrics.set_weight_version(self.weight_version)
+        # Device-memory accounting: params bytes are fixed at
+        # construction; KV-pool bytes come from the pool's capacity and
+        # high-water mark at refresh time.
+        self._params_bytes = sum(
+            getattr(l, "nbytes", 0) for l in jax.tree.leaves(self._params))
+
         self._running = False
         self._stopping = False
         self._draining = True
@@ -583,6 +617,30 @@ class ServingEngine:
         if self.auditor is not None:
             return self.auditor.compiles("serving_decode")
         return -1
+
+    def refresh_memory_metrics(self) -> list[dict]:
+        """Probe per-device ``memory_stats()`` (typed sentinel — a
+        backend without the API publishes ``available=0``, never a fake
+        0 bytes), publish the gauges plus this engine's workload-side
+        bytes (params, KV pool reserved/peak), and return the per-device
+        rows for healthz. Host-only; called per metricsz/healthz scrape,
+        never on the decode path."""
+        from distkeras_tpu.telemetry.device import publish_memory_gauges
+
+        kv_bytes = kv_peak = None
+        if self.kv_pool is not None and self.kv_pool.bytes_per_block:
+            kv_bytes = self.kv_pool.capacity * self.kv_pool.bytes_per_block
+            kv_peak = (self.kv_pool.peak_blocks_used
+                       * self.kv_pool.bytes_per_block)
+        try:
+            mems = publish_memory_gauges(
+                self.metrics.registry,
+                params_bytes=self._params_bytes,
+                kv_pool_bytes=kv_bytes,
+                kv_pool_peak_bytes=kv_peak)
+        except Exception:
+            return []
+        return [m.to_dict() for m in mems]
 
     @property
     def active_slots(self) -> int:
@@ -637,6 +695,7 @@ class ServingEngine:
             "stopping": self._stopping,
             "pending_swap": self._pending_swap is not None,
             "decode_compile_count": self.decode_compile_count(),
+            "weight_version": self.weight_version,
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.debugz()
@@ -729,9 +788,16 @@ class ServingEngine:
             self.flight_recorder.record_event("shutdown", drain=drain)
         self.scheduler.kick()
 
-    def request_param_swap(self, variables):
+    def request_param_swap(self, variables, provenance: dict | None = None):
         """Queue an in-place parameter swap (the replica half of the
         cluster's zero-downtime weight reload).
+
+        ``provenance`` is the new weights' version stamp
+        (``checkpoint.weights_provenance`` of the file being reloaded);
+        it becomes the engine's :attr:`weight_version` when the swap
+        lands, so every post-swap response names the new checkpoint.
+        Without one (inline callers), the version is bumped by one with
+        no digest — still distinguishable per swap.
 
         ``variables`` is either a full variables dict (``{"params": ...}``,
         the ``save_weights`` / ``checkpoint.save_weights_file`` layout) or
@@ -776,9 +842,16 @@ class ServingEngine:
         # (or attr-ordering) differences between a weights file and the
         # live tree must not matter as long as the leaves line up.
         params = jax.tree.unflatten(cur_def, new_leaves)
+        if provenance is None:
+            provenance = {
+                "version": int(self.weight_version.get("version") or 0) + 1,
+                "digest": None,
+            }
+        else:
+            provenance = _public_provenance(provenance)
         event: asyncio.Event = asyncio.Event()
         result: dict = {}
-        self._pending_swap = (params, event, result)
+        self._pending_swap = (params, event, result, provenance)
         self.scheduler.kick()  # wake an idle run loop now
         return event, result
 
@@ -869,20 +942,31 @@ class ServingEngine:
                         self._finish_error(
                             req, EngineStopped("engine shut down while queued"))
                 # 3b. Pending parameter swap: runs only when NO slot is
-                # in flight (in-flight requests finish under the weights
-                # they started with; the cluster router guarantees this
-                # by draining the replica first). Before admission, so a
-                # queued request never splices old-weight prefix blocks.
-                if self._pending_swap is not None and self.active_slots == 0:
-                    params, ev, res = self._pending_swap
+                # in flight AND no queued request has streamed tokens (a
+                # preempted-and-requeued resume must finish under the
+                # weights that produced its streamed prefix — in-flight
+                # requests finish under the weights they started with;
+                # the cluster router guarantees this by draining the
+                # replica first). Before admission, so a queued request
+                # never splices old-weight prefix blocks.
+                if (self._pending_swap is not None
+                        and self.active_slots == 0
+                        and not self.scheduler.has_streamed()):
+                    params, ev, res, prov = self._pending_swap
                     self._pending_swap = None
                     if self.flight_recorder is not None:
-                        self.flight_recorder.record_event("param_swap")
+                        self.flight_recorder.record_event(
+                            "param_swap",
+                            version=prov.get("version"),
+                            digest=prov.get("digest"))
                     with span("param_swap"):
                         try:
                             await self._in_executor(
                                 loop, self._swap_sync, params)
+                            self.weight_version = prov
+                            self.metrics.set_weight_version(prov)
                             res["ok"] = True
+                            res["weight_version"] = prov
                         except Exception as e:
                             res["error"] = e
                         finally:
@@ -940,7 +1024,17 @@ class ServingEngine:
                         # queueing delay from prefill cost.
                         wait = time.monotonic() - req.t_submit
                         self.metrics.record_admit(wait)
+                        # Provenance stamp, FIRST admission only: swaps
+                        # run at zero active slots and never while a
+                        # preempted resume is queued, so the first stamp
+                        # IS completion-time provenance; a re-admission
+                        # after preemption must keep the stamp its
+                        # streamed prefix was served under.
+                        if req.weight_version is None:
+                            req.weight_version = self.weight_version
                         if req.trace is not None:
+                            req.trace.data["weight_version"] = (
+                                req.weight_version)
                             # Rendered as a slice ENDING here: the queue
                             # wait lane segment between submit and admit.
                             req.trace.event("admit", slot=slot,
@@ -1088,7 +1182,7 @@ class ServingEngine:
             # blocks its full timeout and reports "busy" for an engine
             # that is in fact dead.
             if self._pending_swap is not None:
-                _, ev, res = self._pending_swap
+                _, ev, res, _ = self._pending_swap
                 self._pending_swap = None
                 res["error"] = err
                 ev.set()
@@ -1467,6 +1561,7 @@ class ServingEngine:
             "tokens": len(req.out_tokens),
             "ttft_s": req.ttft,
             "latency_s": req.t_done - req.t_submit,
+            "weight_version": req.weight_version,
         }))
         req.done.set()
 

@@ -150,6 +150,16 @@ class ServingMetrics:
         self._c_prompt_tokens = reg.counter(
             "serving_prompt_tokens_total",
             help="admitted prompt tokens total")
+        # Weight provenance: numeric version gauge plus an info-style
+        # gauge whose LABELS carry the digest (the Prometheus idiom for
+        # string facts); superseded info series drop to 0 so a scrape
+        # shows exactly one live (version, digest) at value 1.
+        self._g_weight_version = reg.gauge(
+            "serving_weight_version",
+            help="monotonic version of the live weights (0 = unversioned "
+                 "init)")
+        self._last_weight_info: object | None = None
+        self._prev_weight_info: object | None = None
         self._g_queue_depth = reg.gauge(
             "serving_queue_depth", help="queued requests")
         self._g_slots_active = reg.gauge(
@@ -226,6 +236,34 @@ class ServingMetrics:
 
     def set_slo(self, slo_s: float) -> None:
         self._g_slo.set(slo_s)
+
+    def set_weight_version(self, provenance: dict | None) -> None:
+        """Publish the live weights' provenance: ``serving_weight_version``
+        (numeric) and ``serving_weight_info{version=,digest=} 1`` (the
+        digest as a label). The immediately-superseded info series is
+        zeroed (the transition is visible on the next scrape); anything
+        older is unregistered — a replica on a continuous reload
+        cadence must not grow its scrape with one dead series per
+        reload."""
+        if not provenance:
+            return
+        version = int(provenance.get("version") or 0)
+        self._g_weight_version.set(version)
+        info = self.registry.gauge(
+            "serving_weight_info",
+            help="1 for the live weights' (version, digest); the "
+                 "just-superseded series reads 0, older ones are dropped",
+            version=str(version),
+            digest=str(provenance.get("digest")))
+        if self._last_weight_info is not None \
+                and self._last_weight_info is not info:
+            if self._prev_weight_info is not None \
+                    and self._prev_weight_info is not info:
+                self.registry.remove(self._prev_weight_info)
+            self._last_weight_info.set(0)
+            self._prev_weight_info = self._last_weight_info
+        info.set(1)
+        self._last_weight_info = info
 
     def record_slo_violation(self) -> None:
         self._c_slo_violations.inc()

@@ -105,6 +105,11 @@ class Request:
         # attached by an engine with a trace store/flight recorder.
         self.trace_id = sanitize_trace_id(trace_id) or new_trace_id()
         self.trace = None  # TimelineRecord | None, engine-owned
+        # Weight provenance ({"version", "digest", ...}), stamped by the
+        # engine at ADMISSION (a request finishes under the weights it
+        # was admitted with — param swaps only run at zero active
+        # slots), echoed on the done line and in the trace timeline.
+        self.weight_version: dict | None = None
         # Cast defensively: this arrives from the wire, and an uncastable
         # value must fail HERE (a bad_request to one client), not later as
         # a TypeError inside the engine loop's deadline arithmetic (which
@@ -271,6 +276,15 @@ class Scheduler:
         using peek() as an admission hint must still pop() for deadline
         handling."""
         return self._heap[0][2] if self._heap else None
+
+    def has_streamed(self) -> bool:
+        """True when any queued live request has already streamed tokens
+        — a preempted-and-requeued resume. Such a request must finish
+        under the weights that produced its streamed prefix, so the
+        engine holds a pending param swap while the queue carries one
+        (admission==completion provenance survives preempt-requeue)."""
+        return any(item[2].out_tokens and not item[2].cancelled
+                   for item in self._heap)
 
     def pop(self, now: float | None = None) -> Request | None:
         """Highest-priority non-expired request, or None if empty.

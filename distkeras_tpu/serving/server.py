@@ -143,13 +143,19 @@ class ServingServer:
                     # slot instead of generating tokens nobody will read.
                     req.cancel()
                     raise
-                await self._send(writer, {
+                done = {
                     "done": True,
                     "tokens": req.out_tokens,
                     "trace_id": req.trace_id,
                     "ttft_ms": round(1e3 * req.ttft, 3),
                     "latency_ms": round(1e3 * (req.t_done - req.t_submit), 3),
-                })
+                }
+                if req.weight_version is not None:
+                    # Provenance: the exact checkpoint (version + content
+                    # digest) the serving params came from — a bad answer
+                    # names its weights.
+                    done["weight_version"] = req.weight_version
+                await self._send(writer, done)
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
@@ -181,6 +187,9 @@ class ServingServer:
             return self._tracez(spec)
         if cmd == "metricsz":
             registry = self.engine.metrics.registry
+            # Memory gauges are refreshed per scrape (a passive registry
+            # cannot probe devices itself).
+            self.engine.refresh_memory_metrics()
             if spec.get("format") == "prometheus":
                 from distkeras_tpu.telemetry import prometheus_text
 
@@ -194,6 +203,8 @@ class ServingServer:
                 "queue_depth": len(engine.scheduler),
                 "decode_compile_count": engine.decode_compile_count(),
                 "stopping": engine._stopping,
+                "weight_version": engine.weight_version,
+                "device_memory": engine.refresh_memory_metrics(),
             }
             if engine.prefix_cache is not None:
                 health["prefix_cache"] = engine.prefix_cache.stats()
@@ -252,11 +263,14 @@ class ServingServer:
                     "code": "bad_request"}
         loop = asyncio.get_running_loop()
         try:
-            from distkeras_tpu.checkpoint import load_weights_file
+            from distkeras_tpu.checkpoint import (
+                load_weights_file_with_provenance,
+            )
 
-            variables = await loop.run_in_executor(
-                None, load_weights_file, path)
-            event, result = self.engine.request_param_swap(variables)
+            variables, provenance = await loop.run_in_executor(
+                None, load_weights_file_with_provenance, path)
+            event, result = self.engine.request_param_swap(
+                variables, provenance=provenance)
         except RuntimeError as e:
             # Another reload's swap is still pending.
             return {"error": str(e), "code": "busy"}
@@ -280,7 +294,9 @@ class ServingServer:
         if "error" in result:
             return {"error": f"reload failed: {result['error']!r}",
                     "code": "error"}
-        return {"reload": {"weights": path, "ok": True}}
+        return {"reload": {"weights": path, "ok": True,
+                           "weight_version":
+                               result.get("weight_version")}}
 
     @staticmethod
     async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:

@@ -25,7 +25,15 @@ layer both sides publish into. Four pillars:
   one-lane-per-request Chrome export;
 - **flight recorder** (:mod:`.flight_recorder`) — bounded overwrite
   rings of recent state transitions + request timelines, dumped as a
-  replica's "last words" on crash and mined for slow-request exemplars.
+  replica's "last words" on crash and mined for slow-request exemplars;
+- **training health** (:mod:`.training_health`) — the training-side
+  peer of the serving stack: per-worker commit staleness histograms
+  with exemplars, EASGD center-divergence gauges, goodput (effective
+  vs staleness-damped update mass), and the ``statusz`` snapshot
+  ``run.py --statusz-out`` writes live;
+- **device accounting** (:mod:`.device`) — ``memory_stats()`` probes
+  behind a typed "unavailable" sentinel, per-device memory gauges, and
+  the promoted ``jax.profiler`` capture (``--profile-out``).
 """
 
 from distkeras_tpu.telemetry.spans import (
@@ -65,6 +73,17 @@ from distkeras_tpu.telemetry.flight_recorder import (
     FlightRecorder,
     load_flight_dump,
 )
+from distkeras_tpu.telemetry.training_health import (
+    STALENESS_BUCKETS,
+    TrainingHealth,
+)
+from distkeras_tpu.telemetry.device import (
+    DeviceMemory,
+    all_device_memory,
+    device_memory,
+    profile_trace,
+    publish_memory_gauges,
+)
 
 __all__ = [
     "Tracer",
@@ -92,4 +111,11 @@ __all__ = [
     "chrome_trace",
     "FlightRecorder",
     "load_flight_dump",
+    "TrainingHealth",
+    "STALENESS_BUCKETS",
+    "DeviceMemory",
+    "device_memory",
+    "all_device_memory",
+    "publish_memory_gauges",
+    "profile_trace",
 ]

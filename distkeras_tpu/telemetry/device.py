@@ -1,0 +1,191 @@
+"""Device-side observability: memory accounting and XLA profiling.
+
+Two things only the accelerator runtime can answer — "how much HBM is
+actually in use, and how close to the limit are we" and "what did XLA
+run on the chip" — promoted here from their previous scattered homes
+(``tracing.trace`` for the profiler, an inline ``memory_stats()`` probe
+inside the trainers' device-cache heuristic for the accounting).
+
+**Memory accounting.** ``device.memory_stats()`` is an optional backend
+API: TPU/GPU runtimes publish it, the CPU backend may not, and some
+backends raise instead of returning. Every probe in the repo therefore
+goes through :func:`device_memory`, which never raises and returns a
+typed :class:`DeviceMemory` whose ``available`` flag distinguishes
+"the backend has no data" from "0 bytes in use" — statusz/metricsz
+render the former as ``unavailable`` instead of a lying zero.
+:func:`publish_memory_gauges` pushes the same probe into a
+:class:`~distkeras_tpu.telemetry.registry.MetricsRegistry` as per-device
+labeled gauges (``device_bytes_in_use`` / ``device_bytes_limit`` /
+``device_memory_headroom_bytes``), alongside the workload-side bytes the
+caller already knows (params, KV pool) so one scrape shows both sides of
+the headroom equation.
+
+**Profiling.** :func:`profile_trace` is the ``jax.profiler``
+start/stop pair as a context manager — the XLA-timeline complement to
+the host-side spans in :mod:`.spans`. ``run.py`` wires it as
+``--profile-out`` on both train and serve; ``tracing.trace`` remains as
+a deprecated shim forwarding here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+
+__all__ = [
+    "DeviceMemory",
+    "device_memory",
+    "all_device_memory",
+    "publish_memory_gauges",
+    "profile_trace",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceMemory:
+    """One device's memory picture at probe time.
+
+    ``available=False`` is the typed "no data" sentinel: the backend has
+    no ``memory_stats()`` (or it raised), and every byte field is None —
+    deliberately NOT 0, so a dashboard can never mistake a blind backend
+    for an empty one.
+    """
+
+    device: str
+    available: bool
+    bytes_in_use: int | None = None
+    bytes_limit: int | None = None
+    peak_bytes_in_use: int | None = None
+
+    @property
+    def headroom_bytes(self) -> int | None:
+        """``bytes_limit - bytes_in_use`` when both are known."""
+        if self.bytes_in_use is None or self.bytes_limit is None:
+            return None
+        return self.bytes_limit - self.bytes_in_use
+
+    def to_dict(self) -> dict:
+        out = {"device": self.device, "available": self.available,
+               "bytes_in_use": self.bytes_in_use,
+               "bytes_limit": self.bytes_limit,
+               "peak_bytes_in_use": self.peak_bytes_in_use}
+        hr = self.headroom_bytes
+        if hr is not None:
+            out["headroom_bytes"] = hr
+        return out
+
+
+def _device_name(device) -> str:
+    did = getattr(device, "id", None)
+    if did is not None:
+        return f"{getattr(device, 'platform', 'dev')}:{did}"
+    return str(device)
+
+
+def device_memory(device) -> DeviceMemory:
+    """Probe one device's ``memory_stats()``; NEVER raises. Backends
+    without the API (or whose probe raises, or which return an empty /
+    None result) yield the ``available=False`` sentinel."""
+    name = _device_name(device)
+    stats = None
+    try:
+        fn = getattr(device, "memory_stats", None)
+        if fn is not None:
+            stats = fn()
+    except Exception:
+        stats = None
+    if not stats:
+        return DeviceMemory(device=name, available=False)
+
+    def _num(key):
+        v = stats.get(key)
+        return int(v) if isinstance(v, (int, float)) else None
+
+    return DeviceMemory(
+        device=name,
+        available=True,
+        bytes_in_use=_num("bytes_in_use"),
+        bytes_limit=_num("bytes_limit"),
+        peak_bytes_in_use=_num("peak_bytes_in_use"),
+    )
+
+
+def all_device_memory(devices=None) -> list[DeviceMemory]:
+    """Probe every (given or local) device. Importing jax lazily keeps
+    this module importable in the stdlib-only tooling environment."""
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    return [device_memory(d) for d in devices]
+
+
+def publish_memory_gauges(
+    registry,
+    devices=None,
+    params_bytes: int | None = None,
+    kv_pool_bytes: int | None = None,
+    kv_pool_peak_bytes: int | None = None,
+) -> list[DeviceMemory]:
+    """Publish per-device memory gauges (and the caller's workload-side
+    byte counts) into ``registry``; returns the probed list so callers
+    can also render it (healthz, statusz).
+
+    Per device: ``device_memory_stats_available{device=...}`` is ALWAYS
+    set (1/0 — the scrapeable face of the typed sentinel); the byte
+    gauges (``device_bytes_in_use`` / ``device_bytes_limit`` /
+    ``device_memory_headroom_bytes`` / ``device_peak_bytes_in_use``) are
+    set only when the backend reports them, so an unavailable backend
+    shows NO byte series rather than a flat 0.
+    """
+    mems = all_device_memory(devices)
+    for mem in mems:
+        registry.gauge(
+            "device_memory_stats_available",
+            help="1 when the backend publishes memory_stats() for this "
+                 "device; 0 = no data (byte gauges absent, not zero)",
+            device=mem.device).set(1.0 if mem.available else 0.0)
+        if not mem.available:
+            continue
+        pairs = (
+            ("device_bytes_in_use", "live device bytes in use",
+             mem.bytes_in_use),
+            ("device_bytes_limit", "device memory capacity",
+             mem.bytes_limit),
+            ("device_peak_bytes_in_use", "high-water device bytes",
+             mem.peak_bytes_in_use),
+            ("device_memory_headroom_bytes",
+             "bytes_limit - bytes_in_use", mem.headroom_bytes),
+        )
+        for name, help_, val in pairs:
+            if val is not None:
+                registry.gauge(name, help=help_, device=mem.device).set(val)
+    if params_bytes is not None:
+        registry.gauge(
+            "model_params_bytes",
+            help="bytes of the live model parameters").set(params_bytes)
+    if kv_pool_bytes is not None:
+        registry.gauge(
+            "kv_pool_reserved_bytes",
+            help="bytes reserved by the KV block pool").set(kv_pool_bytes)
+    if kv_pool_peak_bytes is not None:
+        registry.gauge(
+            "kv_pool_peak_bytes",
+            help="high-water bytes of KV blocks in use").set(
+                kv_pool_peak_bytes)
+    return mems
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a ``jax.profiler`` trace of everything inside the block
+    (view in TensorBoard/Perfetto) — the XLA-timeline complement to the
+    host spans. The ONE copy of the start/stop pairing;
+    ``tracing.trace`` forwards here as a deprecated shim."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -295,6 +295,18 @@ class MetricsRegistry:
         return self._get_or_create(Histogram, name, help, labels,
                                    buckets=buckets)
 
+    def remove(self, metric) -> None:
+        """Unregister a metric instance (e.g. a superseded info-style
+        labeled series that would otherwise live in every scrape
+        forever). No-op when it was never (or already un-) registered;
+        existing handles to the object keep working but stop being
+        collected."""
+        with self._lock:
+            for key, m in list(self._metrics.items()):
+                if m is metric:
+                    del self._metrics[key]
+                    return
+
     def collect(self) -> list[_Metric]:
         with self._lock:
             return list(self._metrics.values())

@@ -7,22 +7,20 @@ The reference's observability is wall-clock getters plus the Spark web UI
   BASELINE cares about (samples/sec/chip, step-time variance, tail
   percentiles, MFU);
 - :class:`MetricStream` — structured per-step metric records with pluggable
-  sinks (in-memory, JSONL file, stdout);
-- :func:`trace` — context manager around ``jax.profiler`` for
-  TensorBoard/Perfetto traces of the XLA timeline.
+  sinks (in-memory, JSONL file, stdout).
 
 Spans, the recompile auditor, and the metrics registry live in
 :mod:`distkeras_tpu.telemetry` — the unified observability layer this
 module now publishes into. ``span`` / ``enable_tracing`` / ``Tracer``
-remain importable here as **deprecated shims** (a module
-``__getattr__`` that warns and forwards): they have been pure
-re-exports since the telemetry unification, and new code should import
-from ``distkeras_tpu.telemetry``.
+— and now ``trace``, the ``jax.profiler`` capture promoted to
+:func:`distkeras_tpu.telemetry.device.profile_trace` — remain
+importable here as **deprecated shims** (a module ``__getattr__`` that
+warns and forwards): they are pure re-exports, and new code should
+import from ``distkeras_tpu.telemetry``.
 """
 
 from __future__ import annotations
 
-import contextlib
 import json
 import statistics
 import time
@@ -51,15 +49,27 @@ def __getattr__(name: str):
         from distkeras_tpu.telemetry import spans as _spans
 
         return getattr(_spans, name)
+    if name == "trace":
+        # The jax.profiler start/stop pairing now lives in ONE place —
+        # telemetry.device.profile_trace; this shim forwards rather than
+        # keeping a second copy of the logic.
+        warnings.warn(
+            "distkeras_tpu.tracing.trace is deprecated; use "
+            "distkeras_tpu.telemetry.profile_trace (the promoted "
+            "jax.profiler helper)",
+            DeprecationWarning, stacklevel=2)
+        from distkeras_tpu.telemetry.device import profile_trace
+
+        return profile_trace
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "StepTimer",
     "MetricStream",
-    "trace",
     "device_peak_flops",
     "compiled_step_flops",
     # re-exported from distkeras_tpu.telemetry (canonical home):
+    "trace",
     "span",
     "enable_tracing",
     "disable_tracing",
@@ -263,11 +273,3 @@ def _floats(metrics: dict) -> dict:
     return out
 
 
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """Capture a jax.profiler trace (view in TensorBoard/Perfetto)."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()

@@ -724,6 +724,7 @@ class AsynchronousDistributedTrainer(Trainer):
         compress_deltas: bool = False,
         overlap_window: bool = True,
         device_cache: bool | str = "auto",
+        track_health: bool = True,
         loss_weights=None,
         metric_stream=None,
         registry=None,
@@ -769,6 +770,13 @@ class AsynchronousDistributedTrainer(Trainer):
         self.protocol = self._allocate_protocol(**protocol_kwargs)
         self.communication_window = self.protocol.communication_window
         self.parameter_server: ParameterServerService | None = None
+        # Async-protocol health telemetry (telemetry.training_health):
+        # built fresh per train() and fed by the PS loop + worker
+        # threads; ``trainer.training_health.statusz()`` is the live
+        # worker-table/staleness/divergence snapshot run.py serves via
+        # --statusz-out. track_health=False turns the whole layer off.
+        self.track_health = bool(track_health)
+        self.training_health = None
 
     def _allocate_protocol(self, **kwargs) -> AsyncProtocol:
         return self.protocol_cls(**kwargs)
@@ -784,18 +792,19 @@ class AsynchronousDistributedTrainer(Trainer):
         ``memory_stats()['bytes_limit']`` minus three times the training
         state (the resident params + optimizer slots themselves, their
         gradients, and the donation ping-pong copy), minus a 25% headroom
-        for activations/XLA workspace. Falls back to the 256 MB constant
-        when the backend has no stats (CPU meshes)."""
-        stats = None
+        for activations/XLA workspace. The probe goes through
+        :func:`distkeras_tpu.telemetry.device.device_memory` — the typed
+        ``available=False`` sentinel (backend has no ``memory_stats``,
+        the CPU-mesh case) falls back to the 256 MB constant, and
+        statusz/metricsz can tell "no data" from "0 bytes"."""
         if device is not None:
-            try:
-                stats = device.memory_stats()
-            except Exception:
-                stats = None
-        if not stats or not stats.get("bytes_limit"):
-            return self._DEVICE_CACHE_LIMIT
-        limit = int(stats["bytes_limit"])
-        return max(0, limit - 3 * int(state_bytes) - limit // 4)
+            from distkeras_tpu.telemetry.device import device_memory
+
+            mem = device_memory(device)
+            if mem.available and mem.bytes_limit:
+                limit = int(mem.bytes_limit)
+                return max(0, limit - 3 * int(state_bytes) - limit // 4)
+        return self._DEVICE_CACHE_LIMIT
 
     def _use_device_cache(
         self, part: Dataset, device=None, state_bytes: int = 0
@@ -847,6 +856,8 @@ class AsynchronousDistributedTrainer(Trainer):
                 center_params,
                 self.num_workers,
                 port=self.master_port or 0,
+                registry=self.registry,
+                health=self.training_health,
             )
             self.master_port = grpc_ps.start()
             if self.master_host is None:
@@ -857,7 +868,7 @@ class AsynchronousDistributedTrainer(Trainer):
         self._grpc_ps = None
         self.parameter_server = ParameterServerService(
             self.protocol, center_params, self.num_workers,
-            registry=self.registry,
+            registry=self.registry, health=self.training_health,
         )
         self.parameter_server.start()
         return self.parameter_server
@@ -892,6 +903,16 @@ class AsynchronousDistributedTrainer(Trainer):
         ), "async_cached_window_step")
         init_state = TrainState.create(self.model, optimizer, rng=self.seed)
         center_init = init_state.params
+        if self.track_health:
+            from distkeras_tpu.telemetry import TrainingHealth
+
+            self.training_health = TrainingHealth(
+                registry=self.registry, num_workers=self.num_workers,
+                protocol=self.protocol.name)
+            self.training_health.set_params_bytes(sum(
+                getattr(l, "nbytes", 0)
+                for l in jax.tree.leaves(center_init)))
+        health = self.training_health
         ckpt_mgr = None
         if self.checkpoint_dir is not None:
             from distkeras_tpu.checkpoint import CheckpointManager
@@ -913,10 +934,15 @@ class AsynchronousDistributedTrainer(Trainer):
             def _periodic_checkpoint():
                 while not stop_ckpt.wait(self.checkpoint_interval_s):
                     try:
+                        # Provenance: the commit counter doubles as the
+                        # snapshot's monotonic weight version, so a
+                        # weights file published from this checkpoint
+                        # names the exact training position it came from.
                         ckpt_mgr.save(
                             svc.num_commits,
                             ps_center=svc.get_model(),
                             ps_num_updates=svc.num_updates,
+                            meta={"weight_version": int(svc.num_commits)},
                         )
                     except Exception:
                         # Snapshotting must never take down training — but a
@@ -993,6 +1019,8 @@ class AsynchronousDistributedTrainer(Trainer):
                 # silently at-least-once; SURVEY §5).
                 client = StampingClient(client, widx)
                 center, carry = self.protocol.worker_begin(client, None)
+                if health is not None:
+                    health.record_pull(widx)
                 params = put_state(center)
                 state = TrainState.create(
                     self.model, optimizer, rng=worker_seed(self.seed, widx)
@@ -1046,9 +1074,13 @@ class AsynchronousDistributedTrainer(Trainer):
                             state, ms, wsize = exec_window(state, item)
                             jax.block_until_ready(ms["loss"])
                         win_histories[widx].append((ms, wsize, time.time()))
+                        if health is not None:
+                            health.record_window(widx, wsize)
                         if pending is not None:
                             with span("ps_rebase", worker=widx):
                                 state, carry = _rebase(state, pending)
+                            if health is not None:
+                                health.record_rebase(widx)
                             pending = None
                         if exchanger is not None:
                             snap = state.params
@@ -1155,6 +1187,8 @@ class AsynchronousDistributedTrainer(Trainer):
                 self.parameter_server.num_commits,
                 ps_center=center,
                 ps_num_updates=self.parameter_server.num_updates,
+                meta={"weight_version":
+                      int(self.parameter_server.num_commits)},
             )
             ckpt_mgr.close()
         self.stop_service()

@@ -70,6 +70,27 @@ def pytree_zeros_like(a: Any) -> Any:
     )
 
 
+def pytree_l2(tree: Any) -> float:
+    """Whole-tree L2 norm ``sqrt(sum_leaves sum(x^2))`` as a host float.
+
+    The ONE norm definition the training-health layer uses for update
+    mass, divergence gauges, and goodput accounting — host numpy in
+    float64 accumulation (bf16 wire trees upcast exactly), never a
+    device dispatch: it runs inside the PS loop, which must not bounce
+    through the accelerator. Non-numeric leaves are skipped."""
+    import math
+
+    total = 0.0
+    for leaf in jax.tree.leaves(tree):
+        try:
+            a = np.asarray(leaf).astype(np.float64)
+        except (TypeError, ValueError):
+            continue
+        a = a.ravel()
+        total += float(a @ a)
+    return math.sqrt(total)
+
+
 def pytree_mean(trees: list[Any]) -> Any:
     """Arithmetic mean of a list of PyTrees (reference
     ``distkeras/trainers.py`` § ``AveragingTrainer`` semantics)."""

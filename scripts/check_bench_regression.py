@@ -6,12 +6,16 @@
 forced), and ``benchmarks/serving_bench.py --record-history`` records
 serving rows under ``serving/...`` keys (TTFT/ITL percentiles, goodput,
 prefix-cache hit rate) — both keep a bounded trail of displaced entries
-under ``prev``. This script compares the latest entry of each config (by
-default only the most recently updated one) against its prior
-same-config entry and WARNS when it drifted by more than ``--threshold``
-(default 10%) **in the bad direction**: training throughput, goodput and
-hit rate regress by dropping; serving latency metrics (ttft/inter_token/
-prefill_device/queue_wait/latency) regress by RISING.
+under ``prev``. Training-health rows live under ``train/...`` keys
+(``train/<protocol>/workersN/staleness_p99``, ``.../goodput_ratio``)
+and stay warn-only like every training row. This script compares the
+latest entry of each config (by default only the most recently updated
+one) against its prior same-config entry and WARNS when it drifted by
+more than ``--threshold`` (default 10%) **in the bad direction**:
+training throughput, goodput (incl. ``goodput_ratio``) and hit rate
+regress by dropping; latency-shaped metrics (ttft/inter_token/
+prefill_device/queue_wait/latency) and commit ``staleness`` regress by
+RISING.
 
 Warn-only by design: CPU rows in a shared container are noisy, and a
 hard gate on them would train people to delete the history. Exit code is
@@ -41,12 +45,15 @@ def load_history(path: str) -> dict:
     return hist
 
 
-# Serving metrics where a RISE is the regression. Matched against the
-# key's final path segment (serving rows look like
-# ``serving/<model>/slots4/closed/ttft_p99_s``); training throughput
-# rows never end in these names, so they keep higher-is-better.
+# Metrics where a RISE is the regression. Matched against the key's
+# final path segment (serving rows look like
+# ``serving/<model>/slots4/closed/ttft_p99_s``; training-health rows
+# like ``train/<protocol>/workers4/staleness_p99``). Throughput rows —
+# including ``goodput_*`` and the training-health ``goodput_ratio``,
+# where a DROP means the protocol is damping away more of the fleet's
+# work — never end in these names, so they keep higher-is-better.
 _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
-                    "queue_wait", "latency")
+                    "queue_wait", "latency", "staleness")
 
 
 def lower_is_better(key: str) -> bool:

@@ -43,9 +43,13 @@ def rng():
 @pytest.fixture
 def artifact_dir(tmp_path):
     """Where observability artifacts (flight-recorder dumps, metrics
-    snapshots, Chrome traces) land. CI sets DISTKERAS_TEST_ARTIFACTS and
-    uploads the directory when the suite fails, so a red serving test
-    ships its black box with the failure; locally it is just tmp_path."""
+    snapshots, Chrome traces, training-health statusz snapshots) land.
+    CI sets DISTKERAS_TEST_ARTIFACTS and uploads the directory when the
+    suite fails, so a red serving test ships its black box — and a red
+    async-trainer test its statusz worker table — with the failure;
+    locally it is just tmp_path. Tests that exercise a multi-worker
+    trainer should dump ``trainer.training_health.statusz()`` here
+    (see tests/test_training_health.py)."""
     import pathlib
 
     out = os.environ.get("DISTKERAS_TEST_ARTIFACTS")

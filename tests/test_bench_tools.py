@@ -189,6 +189,9 @@ def test_check_bench_regression_serving_rows_are_direction_aware(
     assert cbr.lower_is_better("serving/m/slots1/open/queue_wait_p50_s")
     assert not cbr.lower_is_better("serving/m/slots1/open/goodput_tokens_per_sec")
     assert not cbr.lower_is_better("bert_train_samples_per_sec/batch8/cpu")
+    # Training-health rows: commit staleness regresses UP, goodput DOWN.
+    assert cbr.lower_is_better("train/dynsgd/workers4/staleness_p99")
+    assert not cbr.lower_is_better("train/dynsgd/workers4/goodput_ratio")
 
 
 def test_check_bench_regression_skips_unusable_rows(tmp_path):

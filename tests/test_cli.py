@@ -46,6 +46,38 @@ def test_cli_end_to_end(job):
     assert len(lines) == summary["steps"]
 
 
+def test_cli_train_statusz_and_stamped_out(job, capsys):
+    """In-process `run.py train`: the statusz writer leaves a final
+    worker-table snapshot, the summary line carries staleness/goodput,
+    --out is provenance-stamped, and the `statusz` subcommand renders
+    the snapshot for humans."""
+    data, cfg, tmp = job
+    out = tmp / "weights.bin"
+    statusz = tmp / "statusz.json"
+    from distkeras_tpu.run import main
+
+    rc = main(["train", "--config", str(cfg), "--data", str(data),
+               "--model", "higgs_mlp", "--out", str(out),
+               "--statusz-out", str(statusz),
+               "--statusz-interval", "0.2"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["statusz"] == str(statusz)
+    assert "staleness_p99" in summary and "goodput_ratio" in summary
+    payload = json.loads(statusz.read_text())
+    assert {w["worker"] for w in payload["workers"]} == {0, 1}
+    assert payload["ps"]["num_commits"] >= 2
+    # The saved weights carry the provenance stamp serve/reload read.
+    from distkeras_tpu.checkpoint import load_weights_meta
+
+    assert load_weights_meta(str(out))["version"] == 1
+
+    rc = main(["statusz", "--file", str(statusz)])
+    assert rc == 0
+    page = capsys.readouterr().out
+    assert "workers:" in page and "staleness:" in page
+
+
 def test_cli_unknown_model(job):
     data, cfg, _ = job
     r = subprocess.run(

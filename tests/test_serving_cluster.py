@@ -251,7 +251,8 @@ def test_rolling_reload_under_load_zero_downtime(lm, rng, tmp_path):
                     p = pool[(k + len(completions)) % len(pool)]
                     done = await c.generate(p, 5)
                     completions.append(
-                        (time.monotonic(), tuple(p), done["tokens"]))
+                        (time.monotonic(), tuple(p), done["tokens"],
+                         done.get("weight_version")))
 
         async with cluster:
             workers = [asyncio.create_task(worker(k)) for k in range(3)]
@@ -290,10 +291,34 @@ def test_rolling_reload_under_load_zero_downtime(lm, rng, tmp_path):
             await _wait_until(lambda: cluster.supervisor.ready_count == 2,
                               what="post-reload restart")
             restarted = await engines[0].submit(pool[0], 5).result()
-        return rep, completions, t0, t1, audits, per_replica, restarted
+            # Weight-provenance rollup at the ROUTER: after the roll and
+            # the restart (brought to current weights), the fleet must
+            # be single-version on the reloaded file's stamp.
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                fleet_health = await c.healthz()
+        return (rep, completions, t0, t1, audits, per_replica, restarted,
+                fleet_health)
 
     (rep, completions, t0, t1, audits, per_replica,
-     restarted) = asyncio.run(go())
+     restarted, fleet_health) = asyncio.run(go())
+    from distkeras_tpu.checkpoint import weights_provenance
+
+    new_prov = weights_provenance(weights_path)
+    assert new_prov["version"] == 1 and new_prov["digest"]
+    # Per-request provenance: pre-roll requests carry the boot stamp
+    # (version 0, inline variables), post-roll requests the reloaded
+    # file's version+digest — old vs new visible on every done line.
+    for t, p, got, wv in completions:
+        assert isinstance(wv, dict), "done line lost weight_version"
+        if t < t0:
+            assert wv["version"] == 0
+        elif t > t1:
+            assert wv["version"] == new_prov["version"]
+            assert wv["digest"] == new_prov["digest"]
+    router_h = fleet_health["router"]
+    key = f"{new_prov['version']}:{new_prov['digest']}"
+    assert router_h["weight_versions"] == {key: 2}
+    assert router_h["mixed_weight_versions"] is False
     assert restarted == want_new[tuple(pool[0])], \
         "restarted replica rejoined on stale boot weights"
     for i, got in per_replica.items():
@@ -307,7 +332,7 @@ def test_rolling_reload_under_load_zero_downtime(lm, rng, tmp_path):
     assert during, "no request completed while the reload was rolling"
     # Token parity: before the roll -> old weights; after it -> new
     # weights; inside the window either (depends which replica served).
-    for t, p, got in completions:
+    for t, p, got, _wv in completions:
         if t < t0:
             assert got == want_old[p]
         elif t > t1:

@@ -1,0 +1,351 @@
+"""Training-run observability (telemetry.training_health + .device).
+
+What's under test, all CPU:
+
+- staleness accounting against a SCRIPTED commit sequence: known lags
+  in, known percentiles/buckets/goodput out (DynSGD's damping is the
+  goodput definition, so the numbers are exact);
+- EASGD divergence gauge parity with a hand-computed L2;
+- duplicate/pull/rebase bookkeeping and the per-worker statusz table;
+- the typed device-memory sentinel: "backend has no memory_stats" is
+  ``available=False`` with None bytes — never a lying 0 — and the
+  trainers' device-cache budget falls back accordingly;
+- a REAL multi-worker async run (DOWNPOUR and AEASGD, 2 workers)
+  producing per-worker staleness percentiles and (elastic) divergence
+  in statusz, rendered by ``format_statusz`` and dumped into
+  ``artifact_dir`` so a red run ships its worker table;
+- the deprecated ``tracing.trace`` shim forwards to the promoted
+  ``telemetry.profile_trace`` with its DeprecationWarning intact.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.models.core import Model
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.parallel.protocols import (
+    AEASGDProtocol,
+    ADAGProtocol,
+    DynSGDProtocol,
+)
+from distkeras_tpu.parallel.ps import ParameterServerService
+from distkeras_tpu.serving.debugz import format_statusz
+from distkeras_tpu.telemetry import MetricsRegistry, TrainingHealth
+
+
+def _tree(val, n=4):
+    return {"w": np.full(n, val, np.float32)}
+
+
+# -- scripted staleness / goodput --------------------------------------------
+
+def test_staleness_histogram_matches_scripted_commits():
+    """Known lags -> known staleness samples, buckets, and goodput.
+    Commit k is applied when the PS counter reads k, with
+    ``last_update = k - lag_k`` -> staleness = lag_k exactly."""
+    reg = MetricsRegistry()
+    health = TrainingHealth(registry=reg, num_workers=2, protocol="dynsgd")
+    svc = ParameterServerService(
+        DynSGDProtocol(), _tree(0.0), 2, registry=reg, health=health)
+    svc.start()
+    client = svc.client()
+    lags = [0, 0, 1, 3, 2, 5]
+    try:
+        for k, lag in enumerate(lags):
+            client.commit_pull({
+                "delta": _tree(1.0),  # ||ones(4)|| = 2.0
+                "last_update": k - lag,
+                "worker": k % 2,
+                "commit_id": f"w{k % 2}:{k}",
+            })
+    finally:
+        svc.stop()
+
+    sz = health.statusz()
+    assert sz["staleness"]["samples"] == len(lags)
+    assert sz["staleness"]["max"] == max(lags)
+    from distkeras_tpu.telemetry import percentile
+
+    assert sz["staleness"]["p50"] == pytest.approx(
+        percentile(lags, 50))
+    assert sz["staleness"]["p99"] == pytest.approx(
+        percentile(lags, 99), abs=0.01)
+
+    # Goodput: raw mass = 2.0 per commit; applied mass damped by the
+    # SAME 1/(staleness+1) DynSGD applies to the center.
+    raw = 2.0 * len(lags)
+    applied = sum(2.0 / (lag + 1) for lag in lags)
+    assert sz["goodput"]["update_mass"] == pytest.approx(raw)
+    assert sz["goodput"]["applied_mass"] == pytest.approx(applied, rel=1e-5)
+    assert sz["goodput"]["ratio"] == pytest.approx(applied / raw, rel=1e-5)
+
+    # Registry histogram: cumulative counts land in the right buckets,
+    # and the worst-sample exemplar names the worker that committed it.
+    snap = reg.snapshot()
+    hist = snap["train_commit_staleness"]
+    assert hist["count"] == len(lags)
+    ex = reg.histogram("train_commit_staleness").exemplars()
+    worst_worker = lags.index(max(lags)) % 2
+    assert any(v["trace_id"] == f"worker:{worst_worker}"
+               for v in ex.values())
+    # Per-worker table: both workers committed, ages recorded.
+    workers = {w["worker"]: w for w in sz["workers"]}
+    assert workers[0]["commits"] == 3 and workers[1]["commits"] == 3
+    assert all(w["last_commit_age_s"] is not None for w in sz["workers"])
+    assert sz["ps"]["num_commits"] == len(lags)
+
+
+def test_duplicate_commits_counted_per_worker():
+    health = TrainingHealth(num_workers=1, protocol="dynsgd")
+    svc = ParameterServerService(
+        DynSGDProtocol(), _tree(0.0), 1, health=health)
+    svc.start()
+    client = svc.client()
+    try:
+        payload = {"delta": _tree(1.0), "last_update": 0,
+                   "worker": 0, "commit_id": "w0:1"}
+        client.commit_pull(payload)
+        client.commit_pull(payload)  # retried commit: deduped
+    finally:
+        svc.stop()
+    w = health.statusz()["workers"][0]
+    assert w["commits"] == 1 and w["duplicates"] == 1
+
+
+def test_adag_goodput_uses_one_over_n():
+    health = TrainingHealth(num_workers=4, protocol="adag")
+    svc = ParameterServerService(
+        ADAGProtocol(), _tree(0.0), 4, health=health)
+    svc.start()
+    try:
+        svc.client().commit_pull({"delta": _tree(1.0), "last_update": 0,
+                                  "worker": 0, "commit_id": "w0:1"})
+    finally:
+        svc.stop()
+    assert health.goodput_ratio == pytest.approx(0.25)
+
+
+def test_worker_identity_falls_back_to_commit_id():
+    """The gRPC wire drops the ``worker`` field; the stamped commit_id
+    (``w<idx>:<counter>``) still attributes the commit."""
+    assert TrainingHealth.worker_of({"commit_id": "w3:17"}) == 3
+    assert TrainingHealth.worker_of({"worker": 5, "commit_id": "w3:1"}) == 5
+    assert TrainingHealth.worker_of({"commit_id": "nonsense"}) is None
+
+
+# -- EASGD divergence ---------------------------------------------------------
+
+def test_easgd_divergence_matches_hand_computed_l2():
+    """One elastic exchange from local params a known offset away from
+    the center: the recorded divergence IS ||local - center||_2."""
+    rho, lr = 5.0, 0.1
+    protocol = AEASGDProtocol(rho=rho, learning_rate=lr)
+    health = TrainingHealth(num_workers=1, protocol="aeasgd")
+    center = {"a": np.zeros(3, np.float32), "b": np.ones(2, np.float32)}
+    svc = ParameterServerService(protocol, center, 1, health=health)
+    svc.start()
+    client = svc.client()
+    try:
+        _, carry = protocol.worker_begin(client, None)
+        local = {"a": np.array([3.0, 0.0, 4.0], np.float32),
+                 "b": np.array([1.0, 2.0], np.float32)}
+        protocol.worker_window(local, carry, client)
+    finally:
+        svc.stop()
+    # offset: a = [3,0,4] (norm 5), b - center_b = [0,1] (norm 1)
+    want = math.sqrt(5.0**2 + 1.0**2)
+    assert health.divergence == pytest.approx(want, rel=1e-6)
+    # The applied force's mass is alpha * divergence.
+    sz = health.statusz()
+    assert sz["goodput"]["update_mass"] == pytest.approx(
+        rho * lr * want, rel=1e-5)
+    assert sz["workers"][0]["divergence"] == pytest.approx(want, rel=1e-5)
+
+
+# -- device-memory sentinel ---------------------------------------------------
+
+class _DevNoStats:
+    platform = "fake"
+    id = 0
+
+
+class _DevRaises:
+    platform = "fake"
+    id = 1
+
+    def memory_stats(self):
+        raise NotImplementedError("no stats on this backend")
+
+
+class _DevWithStats:
+    platform = "fake"
+    id = 2
+
+    def memory_stats(self):
+        return {"bytes_in_use": 10, "bytes_limit": 100,
+                "peak_bytes_in_use": 50}
+
+
+def test_device_memory_typed_sentinel_vs_zero():
+    from distkeras_tpu.telemetry import device_memory
+
+    for dev in (_DevNoStats(), _DevRaises()):
+        mem = device_memory(dev)
+        assert mem.available is False
+        # "No data" is None, NEVER 0 bytes.
+        assert mem.bytes_in_use is None and mem.bytes_limit is None
+        assert mem.headroom_bytes is None
+    mem = device_memory(_DevWithStats())
+    assert mem.available and mem.bytes_in_use == 10
+    assert mem.headroom_bytes == 90
+
+
+def test_memory_gauges_distinguish_unavailable():
+    from distkeras_tpu.telemetry import publish_memory_gauges
+
+    reg = MetricsRegistry()
+    publish_memory_gauges(reg, devices=[_DevNoStats(), _DevWithStats()],
+                          params_bytes=123)
+    snap = reg.snapshot()
+    assert snap['device_memory_stats_available{device=fake:0}'][
+        "value"] == 0.0
+    assert snap['device_memory_stats_available{device=fake:2}'][
+        "value"] == 1.0
+    # The blind device publishes NO byte series at all.
+    assert 'device_bytes_in_use{device=fake:0}' not in snap
+    assert snap['device_bytes_in_use{device=fake:2}']["value"] == 10
+    assert snap["model_params_bytes"]["value"] == 123
+
+
+def test_device_cache_budget_uses_sentinel_fallback():
+    trainer = dk.DOWNPOUR(_model(), num_workers=1)
+    # No stats -> the conservative constant, not a budget from fake 0s.
+    assert (trainer._device_cache_budget(_DevNoStats(), 10)
+            == trainer._DEVICE_CACHE_LIMIT)
+    assert (trainer._device_cache_budget(_DevRaises(), 10)
+            == trainer._DEVICE_CACHE_LIMIT)
+    # Real stats -> limit - 3*state - limit/4.
+    assert trainer._device_cache_budget(_DevWithStats(), 10) == \
+        max(0, 100 - 30 - 25)
+
+
+# -- real multi-worker runs ---------------------------------------------------
+
+def _model(input_dim=16, classes=2):
+    return Model.from_flax(
+        MLP(features=(32,), num_classes=classes),
+        input_shape=(input_dim,),
+        output_dim=classes,
+    )
+
+
+def test_downpour_statusz_on_real_two_worker_run(toy_classification,
+                                                 artifact_dir):
+    reg = MetricsRegistry()
+    trainer = dk.DOWNPOUR(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        num_workers=2, batch_size=16, num_epoch=1,
+        communication_window=4, registry=reg,
+    )
+    trainer.train(toy_classification)
+    health = trainer.training_health
+    assert health is not None
+    sz = health.statusz()
+    # Failure artifact: a red async-trainer run ships its worker table.
+    (artifact_dir / "training_statusz.json").write_text(json.dumps(sz))
+
+    assert sz["protocol"] == "downpour" and sz["num_workers"] == 2
+    workers = {w["worker"] for w in sz["workers"]}
+    assert workers == {0, 1}
+    for w in sz["workers"]:
+        assert w["commits"] >= 1 and w["pulls"] == 1
+        assert "staleness_p50" in w and "staleness_p99" in w
+    assert sz["staleness"]["samples"] >= 2
+    assert sz["ps"]["num_commits"] == sum(
+        w["commits"] for w in sz["workers"])
+    assert sz["goodput"]["ratio"] == pytest.approx(1.0)  # DOWNPOUR: undamped
+    # Overlapped exchanges rebase (default overlap_window=True).
+    assert sum(w["rebases"] for w in sz["workers"]) >= 1
+    # Memory rows exist and are typed (CPU backend may be blind — then
+    # available=False with None bytes, never 0).
+    assert sz["memory"], "no device memory rows"
+    for m in sz["memory"]:
+        if not m["available"]:
+            assert m["bytes_in_use"] is None
+    # Registry surface: the same story is scrapeable.
+    snap = reg.snapshot()
+    assert snap["train_commit_staleness"]["count"] >= 2
+    assert snap["train_worker_pulls_total"]["value"] == 2
+    # Human rendering: the statusz page names the load-bearing parts.
+    page = format_statusz(sz)
+    assert "workers:" in page and "staleness:" in page
+    assert "goodput" in page and "device memory:" in page
+
+
+def test_aeasgd_statusz_reports_divergence_on_real_run(toy_classification):
+    trainer = dk.AEASGD(
+        _model(), worker_optimizer="adam", learning_rate=0.05,
+        num_workers=2, batch_size=16, num_epoch=1,
+        communication_window=4, rho=2.0,
+    )
+    trainer.train(toy_classification)
+    sz = trainer.training_health.statusz()
+    assert sz["divergence"] is not None and sz["divergence"] > 0
+    assert all("staleness_p99" in w for w in sz["workers"])
+    assert "divergence" in format_statusz(sz)
+
+
+def test_track_health_false_disables_the_layer(toy_classification):
+    trainer = dk.DOWNPOUR(
+        _model(), worker_optimizer="adam", learning_rate=0.01,
+        num_workers=1, batch_size=32, num_epoch=1,
+        communication_window=8, track_health=False,
+    )
+    trainer.train(toy_classification)
+    assert trainer.training_health is None
+
+
+# -- shims & rendering --------------------------------------------------------
+
+def test_tracing_trace_shim_forwards_to_promoted_helper():
+    import distkeras_tpu.tracing as tracing
+    from distkeras_tpu.telemetry.device import profile_trace
+
+    with pytest.warns(DeprecationWarning, match="profile_trace"):
+        shim = tracing.trace
+    assert shim is profile_trace
+
+
+def test_format_statusz_renders_canned_payload():
+    payload = {
+        "protocol": "dynsgd", "num_workers": 2, "uptime_s": 1.5,
+        "staleness": {"p50": 1.0, "p90": 2.0, "p99": 3.0, "max": 3.0,
+                      "samples": 7},
+        "goodput": {"update_mass": 10.0, "applied_mass": 6.0,
+                    "ratio": 0.6},
+        "workers": [
+            {"worker": 0, "commits": 4, "duplicates": 0, "pulls": 1,
+             "rebases": 2, "last_commit_age_s": 0.1, "last_staleness": 1,
+             "staleness_p50": 1.0, "staleness_p99": 2.0,
+             "commit_rate_per_s": 3.0},
+        ],
+        "ps": {"running": True, "num_updates": 7, "num_commits": 7,
+               "num_duplicates": 0, "queue_depth": 0,
+               "snapshot_failures": 0},
+        "memory": [
+            {"device": "cpu:0", "available": False, "bytes_in_use": None},
+            {"device": "tpu:0", "available": True,
+             "bytes_in_use": 2**20, "bytes_limit": 4 * 2**20,
+             "peak_bytes_in_use": 2 * 2**20, "headroom_bytes": 3 * 2**20},
+        ],
+    }
+    page = format_statusz(payload)
+    assert "protocol=dynsgd" in page
+    assert "p99=3.0" in page
+    assert "unavailable" in page          # the sentinel, not a fake 0
+    assert "3.0" in page and "cpu:0" in page and "tpu:0" in page
+    assert "queue_depth=0" in page
