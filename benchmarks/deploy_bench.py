@@ -1,0 +1,304 @@
+"""Sustained weight churn through a serving cluster: the continuous-
+deployment benchmark.
+
+The loop PRs 1-7 built is only trustworthy if reloads stay invisible
+under *repeated* weight churn — one rolling reload proving zero-downtime
+says little about the tenth. This bench runs the whole train->serve loop
+shape in one process: a publisher task standing in for the trainer
+(fresh weight versions published into a watch directory on a fixed
+cadence), a LocalReplica cluster behind the router serving closed-loop
+load the entire time, and a :class:`DeployController` canary-validating
+and rolling every version. It measures and asserts:
+
+- **zero downtime**: every client request completes across every canary
+  drain + rolling reload (no client-visible error, ever);
+- **provenance flips**: each completion names its ``(version, digest)``;
+  the bench tracks the distinct versions observed and that the served
+  version never moves backwards in completion order;
+- **deploy latency**: manifest-seen -> fleet-verified, per deploy
+  (p50/p95) — the staleness window between "trained" and "serving";
+- **canary discipline**: with ``--corrupt-every K``, every K-th publish
+  is NaN-poisoned and must be rejected without touching the fleet
+  (``canary_pass_rate`` = good publishes deployed / good publishes);
+- **compile-once**: every replica's decode step compiled exactly once
+  across all of it.
+
+``--record-history`` appends ``deploy/...`` rows to
+``bench_history.json`` (``deploy_latency_*`` regresses UP,
+``canary_pass_rate`` and goodput DOWN) for
+``scripts/check_bench_regression.py``.
+
+Run (CPU):
+    JAX_PLATFORMS=cpu python benchmarks/deploy_bench.py \
+        --replicas 2 --publishes 4 --publish-interval 2 --corrupt-every 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import tempfile
+import time
+
+import numpy as np
+
+
+async def _run(args, report):
+    import jax
+
+    from distkeras_tpu.checkpoint import (
+        load_weights_file_with_provenance,
+        publish_weights,
+    )
+    from distkeras_tpu.deploy.harness import wire_controller
+    from distkeras_tpu.models.bert import gpt_tiny
+    from distkeras_tpu.serving import (
+        LocalReplica,
+        ServingClient,
+        ServingCluster,
+        ServingEngine,
+    )
+    from distkeras_tpu.serving.metrics import percentile
+    from distkeras_tpu.telemetry import MetricsRegistry, RecompileAuditor
+
+    model = gpt_tiny(seq_len=args.seq_len, vocab_size=args.vocab)
+    variables = model.init(0)
+    watch_dir = args.watch_dir or tempfile.mkdtemp(prefix="deploy-bench-")
+    boot = publish_weights(watch_dir, variables, meta={"step": 0})
+
+    engines = {}
+
+    def factory(i):
+        def build():
+            v, prov = load_weights_file_with_provenance(
+                boot["path"], like=variables)
+            eng = ServingEngine(model, v, slots=args.slots,
+                                max_queue=args.max_queue,
+                                auditor=RecompileAuditor(),
+                                arm_auditor_after_warmup=True,
+                                weight_version=prov)
+            engines[i] = eng
+            return eng
+
+        return LocalReplica(build)
+
+    registry = MetricsRegistry()
+    cluster = ServingCluster(
+        factory, args.replicas, registry=registry,
+        supervisor_kwargs=dict(health_interval_s=0.1, base_delay_s=0.2))
+    rng = np.random.default_rng(args.seed)
+    completions: list[tuple[float, dict]] = []
+    client_errors: list[str] = []
+    publishes = {"good": 0, "bad": 0}
+    stop = asyncio.Event()
+
+    async with cluster:
+        port = cluster.port
+        controller = wire_controller(
+            cluster.router, watch_dir, model=model, vocab=args.vocab,
+            golden_count=args.golden, golden_len=6, seed=args.seed,
+            registry=registry, initial_weights=boot["path"],
+            poll_interval_s=0.2)
+        controller_task = asyncio.get_running_loop().create_task(
+            controller.run())
+
+        async def load_worker(k):
+            async with ServingClient("127.0.0.1", port) as c:
+                while not stop.is_set():
+                    p = rng.integers(0, args.vocab,
+                                     size=(3 + (k + len(completions)) % 5,)
+                                     ).tolist()
+                    try:
+                        done = await c.generate(p, args.new_tokens)
+                        completions.append(
+                            (time.monotonic(), done["weight_version"]))
+                    except Exception as e:
+                        client_errors.append(repr(e))
+                        return
+
+        workers = [asyncio.create_task(load_worker(k))
+                   for k in range(args.clients)]
+        while len(completions) < args.clients:
+            await asyncio.sleep(0.05)
+
+        # The churn loop: the "trainer". Every --publish-interval a
+        # fresh version lands; every --corrupt-every-th one is poisoned.
+        # Each publish waits for the controller to consume it before the
+        # next (a faster cadence would just coalesce at the manifest —
+        # the controller always deploys the NEWEST version — and the
+        # bench's per-deploy accounting wants 1:1).
+        deadline = time.monotonic() + 600
+        for k in range(1, args.publishes + 1):
+            await asyncio.sleep(args.publish_interval)
+            # Weight construction + serialization run OFF the loop: the
+            # load clients, the health probes, and the controller all
+            # share this one event loop, and a multi-second stall would
+            # measure the bench harness, not the fleet.
+            fresh = await asyncio.to_thread(model.init, k)
+            bad = args.corrupt_every and k % args.corrupt_every == 0
+            if bad:
+                fresh = jax.tree.map(lambda x: np.asarray(x) * np.nan,
+                                     fresh)
+                publishes["bad"] += 1
+            else:
+                publishes["good"] += 1
+            m = await asyncio.to_thread(
+                publish_weights, watch_dir, fresh,
+                meta={"step": k * 100, "loss": 1.0 / k})
+            while (controller._seen_version < m["version"]
+                   or controller.candidate is not None):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "controller never caught up with the published "
+                        "versions")
+                await asyncio.sleep(0.1)
+        # A few post-churn completions so the final version is observed.
+        n_after = len(completions) + args.clients
+        while len(completions) < n_after and not client_errors:
+            await asyncio.sleep(0.05)
+        stop.set()
+        await asyncio.gather(*workers)
+        controller.stop()
+        await controller_task
+
+        dz = controller.deployz()
+        compiles = {f"r{i}": eng.auditor.compiles("serving_decode")
+                    for i, eng in engines.items()}
+
+    # -- report ---------------------------------------------------------
+    versions = [wv.get("version") for _, wv in completions]
+    distinct = sorted(set(versions))
+    flips = sum(1 for a, b in zip(versions, versions[1:]) if a != b)
+    deploy_latencies = [e["latency_s"] for e in dz["history"]
+                       if e["status"] == "deployed"]
+    wall = completions[-1][0] - completions[0][0] if completions else 1.0
+    report["deploy"] = {
+        "publishes": publishes,
+        "deploys": dz["counters"]["deploys"],
+        "canary_failures": dz["counters"]["canary_failures"],
+        "validation_failures": dz["counters"]["validation_failures"],
+        "rollbacks": dz["counters"]["rollbacks"],
+        "canary_pass_rate": (
+            round(dz["counters"]["deploys"] / publishes["good"], 4)
+            if publishes["good"] else None),
+        "deploy_latency_p50_s": (
+            round(percentile(deploy_latencies, 50), 4)
+            if deploy_latencies else None),
+        "deploy_latency_p95_s": (
+            round(percentile(deploy_latencies, 95), 4)
+            if deploy_latencies else None),
+        "served_versions_observed": distinct,
+        "provenance_flips": flips,
+        "quarantined": len(dz["quarantined"]),
+    }
+    report["serving"] = {
+        "completed": len(completions),
+        "client_errors": len(client_errors),
+        "goodput_tokens_per_sec": round(
+            len(completions) * args.new_tokens / wall, 2),
+        "decode_compile_count": compiles,
+    }
+    report["deployz"] = dz
+
+    # -- the contract ----------------------------------------------------
+    assert not client_errors, (
+        f"{len(client_errors)} client-visible errors under weight churn: "
+        f"{client_errors[:3]}")
+    assert dz["counters"]["deploys"] == publishes["good"], (
+        "good publishes and completed deploys disagree: "
+        f"{publishes} vs {dz['counters']}")
+    assert dz["counters"]["canary_failures"] == publishes["bad"], (
+        "every poisoned publish must be canary-rejected: "
+        f"{publishes} vs {dz['counters']}")
+    # Completion ORDER may interleave by one roll window (a replica
+    # draining on the old version finishes alongside the first rolled
+    # replica's new-version completions); the hard contract is that
+    # every deployed version was actually served and the fleet ends on
+    # the newest.
+    assert len(distinct) == publishes["good"] + 1, (
+        f"expected every deployed version observed on done lines: "
+        f"{distinct}")
+    assert versions and versions[0] == 1 and versions[-1] == distinct[-1]
+    assert flips >= dz["counters"]["deploys"]
+    assert all(c == 1 for c in compiles.values()), (
+        f"decode retraced under weight churn: {compiles}")
+
+
+# History rows: staleness-shaped metrics regress UP, rates/goodput DOWN.
+_HISTORY_METRICS = (
+    ("deploy", "deploy_latency_p50_s"),
+    ("deploy", "deploy_latency_p95_s"),
+    ("deploy", "canary_pass_rate"),
+    ("serving", "goodput_tokens_per_sec"),
+)
+
+
+def _record_history(args, report):
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench  # stdlib-only parent module
+
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    base = (f"deploy/gpt_tiny/replicas{args.replicas}"
+            f"/every{args.publish_interval:g}s")
+    if args.corrupt_every:
+        base += f"/corrupt{args.corrupt_every}"
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    for section, metric in _HISTORY_METRICS:
+        v = report.get(section, {}).get(metric)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        key = f"{base}/{metric}"
+        hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--max-queue", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--clients", type=int, default=3,
+                    help="closed-loop concurrent clients through the "
+                         "router, running for the whole churn")
+    ap.add_argument("--new-tokens", type=int, default=4)
+    ap.add_argument("--publishes", type=int, default=3,
+                    help="weight versions published after boot")
+    ap.add_argument("--publish-interval", type=float, default=2.0,
+                    help="seconds between publishes (the trainer cadence)")
+    ap.add_argument("--corrupt-every", type=int, default=0,
+                    help="> 0: NaN-poison every K-th publish; the canary "
+                         "must reject each one without touching the fleet")
+    ap.add_argument("--golden", type=int, default=2,
+                    help="golden prompts per canary")
+    ap.add_argument("--watch-dir", default=None,
+                    help="publish directory (default: fresh temp dir)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--record-history", action="store_true",
+                    help="append deploy/* rows to bench_history.json for "
+                         "scripts/check_bench_regression.py")
+    args = ap.parse_args()
+
+    report = {"config": {
+        "replicas": args.replicas, "slots": args.slots,
+        "clients": args.clients, "publishes": args.publishes,
+        "publish_interval_s": args.publish_interval,
+        "corrupt_every": args.corrupt_every, "golden": args.golden,
+    }}
+    asyncio.run(_run(args, report))
+    if args.record_history:
+        _record_history(args, report)
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,9 @@ __all__ = [
     "load_weights_meta",
     "weights_provenance",
     "weights_digest",
+    "publish_weights",
+    "read_manifest",
+    "MANIFEST_NAME",
 ]
 
 # Zip member carrying the provenance stamp. The npz readers
@@ -190,6 +193,108 @@ def weights_provenance(path: str) -> dict:
             out = _provenance_from_bytes(f.read())
     out["path"] = os.path.abspath(path)
     return out
+
+
+# -- publish directory: the train -> serve handoff ---------------------------
+#
+# A *publish directory* is the contract between a trainer and the deploy
+# controller (distkeras_tpu.deploy): versioned, stamped weight files
+# (``weights-v<N>.npz``, immutable once published) plus ONE atomic
+# ``MANIFEST.json`` naming the newest version. Writers publish the weights
+# file FIRST, then replace the manifest — a watcher that reads the
+# manifest and then opens the file it names can never see a torn or
+# missing publish. Old versions are retained (bounded) so a canary
+# rollback or a replica restart can still load the last-good file.
+
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def publish_weights(directory: str, variables: Any,
+                    meta: dict | None = None, keep: int = 5) -> dict:
+    """Atomically publish ``variables`` into ``directory`` and point the
+    manifest at it.
+
+    The weights land as ``weights-v<N>.npz`` (``N`` = previous manifest
+    version + 1; stamped via :func:`save_weights_file`, so the file's own
+    provenance agrees with the manifest), then ``MANIFEST.json`` is
+    replaced (tmp + ``os.replace``) with ``{"version", "digest", "path",
+    "saved_at", **meta}`` — typically ``meta={"step": ..., "loss": ...}``
+    from the trainer. Returns the manifest dict (``path`` absolute).
+
+    ``keep`` bounds retention: older ``weights-v*.npz`` files beyond the
+    newest ``keep`` are deleted, except the one the manifest names (the
+    invariant a deploy controller's rollback path relies on is "last-good
+    still exists", which it guarantees by pinning within ``keep``).
+    """
+    if keep < 2:
+        raise ValueError(f"keep must be >= 2 (current + last-good), "
+                         f"got {keep}")
+    os.makedirs(directory, exist_ok=True)
+    prev = read_manifest(directory)
+    version = int(prev.get("version", 0)) + 1 if prev else 1
+    fname = f"weights-v{version:08d}.npz"
+    path = os.path.join(directory, fname)
+    save_weights_file(path, variables, version=version, meta=meta)
+    manifest = {
+        "version": version,
+        "digest": (load_weights_meta(path) or {}).get("digest"),
+        "path": fname,
+        "saved_at": time.time(),
+        **(meta or {}),
+    }
+    tmp = os.path.join(directory, f".{MANIFEST_NAME}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(directory, MANIFEST_NAME))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _prune_published(directory, keep, protect=fname)
+    return {**manifest, "path": path}
+
+
+def _prune_published(directory: str, keep: int, protect: str) -> None:
+    """Delete all but the newest ``keep`` published versions (never the
+    just-published ``protect`` file). Best-effort: a concurrent reader
+    holding an old file open on a platform where unlink fails must not
+    fail the publish."""
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith("weights-v") and n.endswith(".npz"))
+    except OSError:
+        return
+    for name in names[:-keep]:
+        if name == protect:
+            continue
+        try:
+            os.unlink(os.path.join(directory, name))
+        except OSError:
+            pass
+
+
+def read_manifest(directory: str) -> dict | None:
+    """The publish directory's current manifest, with ``path`` resolved
+    absolute, or None when the directory has no (readable) manifest.
+    Torn or garbage content returns None rather than raising — the
+    watcher polls this on a cadence and an external writer's mistake
+    must not kill the deploy loop."""
+    try:
+        with open(os.path.join(directory, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(manifest, dict) or "version" not in manifest:
+        return None
+    path = manifest.get("path")
+    if path and not os.path.isabs(path):
+        manifest["path"] = os.path.join(os.path.abspath(directory), path)
+    return manifest
 
 
 class CheckpointManager:

@@ -1,0 +1,91 @@
+"""Deploy-loop wiring: golden sets, golden-batch scoring, one-call setup.
+
+``run.py deploy``, ``benchmarks/deploy_bench.py``, and the e2e tests all
+need the same three pieces around a cluster: a deterministic golden
+prompt set, a host-side golden-batch loss for the canary's finite-loss
+check, and a :class:`~distkeras_tpu.deploy.controller.DeployController`
+registered on the router (which is what makes the ``deployz`` verb
+answer). This module is that shared wiring — import-light (jax loads
+only inside the score fn) so the CLI can parse args without paying for
+it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["make_golden_prompts", "make_score_fn", "wire_controller"]
+
+
+def make_golden_prompts(vocab: int, count: int = 4, length: int = 8,
+                        seed: int = 0) -> list[list[int]]:
+    """Deterministic golden prompt set: same seed -> same prompts, so a
+    canary score is comparable deploy over deploy."""
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=(length,)).tolist()
+            for _ in range(max(0, count))]
+
+
+def make_score_fn(model, vocab: int, seq_len: int = 16, batch: int = 4,
+                  seed: int = 0):
+    """Golden-batch next-token loss under candidate weights.
+
+    The canary's "finite loss" check: a fixed random token batch scored
+    with the candidate's forward pass — NaN/inf weights (or a head that
+    went numerically sideways) show up here as a non-finite loss before
+    the candidate ever serves a request. The batch is deterministic per
+    seed; the jitted program is cached across deploys (same shapes every
+    time, so repeated canaries cost one compile total).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.ops.losses import categorical_crossentropy
+
+    rng = np.random.default_rng(seed)
+    tokens = jnp.asarray(
+        rng.integers(0, vocab, size=(batch, seq_len)), jnp.int32)
+
+    @jax.jit
+    def _loss(variables):
+        logits, _ = model.apply(variables, tokens, train=False)
+        return categorical_crossentropy(logits[:, :-1], tokens[:, 1:])
+
+    def score(variables):
+        if isinstance(variables, dict) and "params" in variables:
+            return float(_loss(variables))
+        return float(_loss({"params": variables}))
+
+    return score
+
+
+def wire_controller(router, watch_dir: str, *, model=None,
+                    template=None, vocab: int | None = None,
+                    golden_count: int = 4, golden_len: int = 8,
+                    golden_new_tokens: int = 4, seed: int = 0,
+                    registry=None, **controller_kwargs):
+    """Build a :class:`DeployController` over ``router`` watching
+    ``watch_dir`` and register it for the ``deployz`` verb.
+
+    With ``model`` + ``vocab``, the golden prompt set and the
+    golden-batch ``score_fn`` are built automatically (pass
+    ``golden_count=0`` to skip replica-side scoring). ``template``
+    defaults to ``model.init(seed)`` when a model is given — the leaf
+    shape/dtype validation template.
+    """
+    from distkeras_tpu.deploy.controller import DeployController
+
+    golden = None
+    score_fn = None
+    if model is not None and vocab:
+        golden = make_golden_prompts(vocab, count=golden_count,
+                                     length=golden_len, seed=seed)
+        score_fn = make_score_fn(model, vocab, seed=seed)
+        if template is None:
+            template = model.init(seed)
+    controller = DeployController(
+        router, watch_dir, template=template, golden_prompts=golden,
+        golden_new_tokens=golden_new_tokens, score_fn=score_fn,
+        registry=registry, **controller_kwargs)
+    router.deploy_controller = controller
+    return controller

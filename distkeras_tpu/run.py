@@ -451,6 +451,204 @@ def cluster_main(args) -> int:
     return 0
 
 
+def deploy_main(argv=None) -> int:
+    """``deploy`` subcommand: the continuous-deployment loop — a
+    ProcessReplica serving fleet behind the supervised router, plus a
+    :class:`~distkeras_tpu.deploy.controller.DeployController` watching
+    a publish directory. Every version a trainer publishes there
+    (``run.py train --publish-dir``) is validated, canaried on one
+    drained replica against a golden prompt set, rolled through the
+    fleet with zero downtime, and rolled back + quarantined if anything
+    regresses. Inspect live state with ``run.py deployz``."""
+    ap = argparse.ArgumentParser(prog="distkeras_tpu.run deploy")
+    ap.add_argument("--watch-dir", required=True, metavar="DIR",
+                    help="publish directory to watch (the trainer's "
+                         "--publish-dir). With no manifest yet, the "
+                         "fleet bootstraps on (and publishes) seed-init "
+                         "weights as v1")
+    ap.add_argument("--model", default="gpt_tiny",
+                    help="causal LM from the zoo (gpt_tiny/gpt_small)")
+    ap.add_argument("--model-args", default="{}",
+                    help="JSON kwargs for the model fn")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8500,
+                    help="router front port (0 = ephemeral)")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--golden", type=int, default=4,
+                    help="golden prompt count the canary replica must "
+                         "serve (twice each, identical greedy output, "
+                         "within the latency budget); 0 disables "
+                         "replica-side scoring")
+    ap.add_argument("--golden-len", type=int, default=8,
+                    help="golden prompt length in tokens")
+    ap.add_argument("--golden-new-tokens", type=int, default=4,
+                    help="tokens decoded per golden prompt")
+    ap.add_argument("--canary-latency-ms", type=float, default=30000.0,
+                    help="per-golden-prompt canary latency budget")
+    ap.add_argument("--poll-ms", type=float, default=500.0,
+                    help="manifest poll interval")
+    ap.add_argument("--publish-keep", type=int, default=5,
+                    help="retention for the bootstrap publish")
+    ap.add_argument("--audit-recompiles", nargs="?", const="arm",
+                    choices=["report", "arm", "off"], default="arm",
+                    help="replica recompile auditing (default: arm — a "
+                         "decode retrace under weight churn fails "
+                         "loudly; 'off' disables)")
+    ap.add_argument("--replica-env", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="repeatable; extra env per replica child, {i} "
+                         "expands to the index (device partitioning)")
+    args = ap.parse_args(argv)
+
+    import asyncio
+    import signal
+
+    from distkeras_tpu.checkpoint import publish_weights, read_manifest
+    from distkeras_tpu.deploy.harness import wire_controller
+    from distkeras_tpu.serving.cluster import ProcessReplica, ServingCluster
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    model = load_model(args.model, json.loads(args.model_args))
+    manifest = read_manifest(args.watch_dir)
+    if manifest is None or not os.path.exists(manifest.get("path") or ""):
+        # Nothing (usable) published yet: bootstrap the directory with
+        # seed-init weights so the fleet boots on a FILE (the
+        # controller's last-good rollback target must exist from the
+        # first deploy). The exists-check also covers a restart whose
+        # manifest still names a file the controller quarantined or the
+        # publisher pruned — a fresh publish beats a crash-looping boot.
+        manifest = publish_weights(
+            args.watch_dir, model.init(args.seed),
+            meta={"bootstrap": True}, keep=args.publish_keep)
+        print(json.dumps({"bootstrap_published": manifest["path"],
+                          "version": manifest["version"]}), flush=True)
+    boot_weights = manifest["path"]
+
+    def replica_args(i: int) -> list[str]:
+        # No --weights: replicas boot random-init and the supervisor
+        # reloads the fleet's current_weights (a controller-STAGED
+        # file) before each becomes routable — initial start and every
+        # later restart converge on the deployed version through one
+        # path, immune to the watch dir's retention pruning the
+        # original boot file.
+        extra = [
+            "--model", args.model, "--model-args", args.model_args,
+            "--slots", str(args.slots),
+            "--max-queue", str(args.max_queue),
+            "--seed", str(args.seed),
+            "--request-trace", "512",
+            "--flight-recorder", "256",
+        ]
+        if args.audit_recompiles != "off":
+            extra += ["--audit-recompiles", args.audit_recompiles]
+        return extra
+
+    def replica_env(i: int) -> dict[str, str]:
+        env = {}
+        for item in args.replica_env:
+            key, sep, val = item.partition("=")
+            if not sep:
+                raise SystemExit(
+                    f"--replica-env needs KEY=VAL, got {item!r}")
+            env[key] = val.replace("{i}", str(i))
+        return env
+
+    registry = MetricsRegistry()
+    cluster = ServingCluster(
+        lambda i: ProcessReplica(replica_args(i), host=args.host,
+                                 env=replica_env(i)),
+        args.replicas, host=args.host, port=args.port, registry=registry)
+
+    async def go():
+        # Controller first: its ctor stages the boot weights, and the
+        # supervisor must know the fleet's current_weights BEFORE the
+        # replicas start (each is brought to it pre-READY).
+        controller = wire_controller(
+            cluster.router, args.watch_dir, model=model,
+            vocab=model.output_dim, golden_count=args.golden,
+            golden_len=args.golden_len,
+            golden_new_tokens=args.golden_new_tokens, seed=args.seed,
+            registry=registry,
+            canary_latency_s=args.canary_latency_ms / 1e3,
+            poll_interval_s=args.poll_ms / 1e3,
+            initial_weights=boot_weights)
+        cluster.supervisor.current_weights = (
+            (controller.last_good or {}).get("path") or boot_weights)
+        await cluster.start()
+        controller_task = asyncio.get_running_loop().create_task(
+            controller.run(), name="deploy-controller")
+        print(json.dumps({
+            "deploy": args.model, "host": args.host, "port": cluster.port,
+            "watch_dir": args.watch_dir,
+            "boot_weights": boot_weights,
+            "replicas": {rid: {"host": info.host, "port": info.port}
+                         for rid, info in cluster.replicas.items()},
+        }), flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+        try:
+            await stop.wait()
+        finally:
+            controller.stop()
+            try:
+                await asyncio.wait_for(controller_task, 10.0)
+            except asyncio.TimeoutError:
+                controller_task.cancel()
+            await cluster.stop()
+        print(json.dumps({"deployz": controller.deployz()}), flush=True)
+
+    try:
+        asyncio.run(go())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def deployz_main(argv=None) -> int:
+    """``deployz`` subcommand: fetch and pretty-print a live deploy
+    controller's state page (current/last-good/candidate versions,
+    deploy history ring, quarantine records) from a ``run.py deploy``
+    router. ``--json`` prints the raw payload for scripts."""
+    import asyncio
+
+    ap = argparse.ArgumentParser(prog="distkeras_tpu.run deployz")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8500,
+                    help="the deploy router's front port")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON payload instead of the pretty page")
+    args = ap.parse_args(argv)
+
+    from distkeras_tpu.serving import ServingClient, ServingError
+    from distkeras_tpu.serving.debugz import format_deployz
+
+    async def go():
+        async with ServingClient(args.host, args.port,
+                                 max_retries=0) as client:
+            return await client.deployz()
+
+    try:
+        payload = asyncio.run(go())
+    except (OSError, ConnectionError) as e:
+        print(f"deployz: cannot reach {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 1
+    except ServingError as e:
+        print(f"deployz: server refused: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(payload, indent=1) if args.json
+          else format_deployz(payload))
+    return 0
+
+
 def debugz_main(argv=None) -> int:
     """``debugz`` subcommand: fetch and pretty-print a live server's (or
     router's) introspection page — slot table, queue ages, prefix-cache
@@ -563,6 +761,10 @@ def main(argv=None) -> int:
         return serve_main(argv[1:], prog="cluster", default_replicas=2)
     if argv and argv[0] == "debugz":
         return debugz_main(argv[1:])
+    if argv and argv[0] == "deploy":
+        return deploy_main(argv[1:])
+    if argv and argv[0] == "deployz":
+        return deployz_main(argv[1:])
     if argv and argv[0] == "statusz":
         return statusz_main(argv[1:])
     if argv and argv[0] == "train":  # explicit alias for the default mode
@@ -590,6 +792,28 @@ def main(argv=None) -> int:
                          "--file PATH`")
     ap.add_argument("--statusz-interval", type=float, default=10.0,
                     help="seconds between --statusz-out rewrites")
+    ap.add_argument("--publish-dir", default=None, metavar="DIR",
+                    help="continuous deployment: atomically publish "
+                         "stamped weight files + MANIFEST.json into DIR "
+                         "on the --publish-every cadence (async trainers "
+                         "publish the PS center; step trainers the live "
+                         "params). A `run.py deploy` controller watching "
+                         "DIR canary-validates and rolls each version "
+                         "through the serving fleet")
+    ap.add_argument("--publish-every", default="10s", metavar="N|Ns",
+                    help="publish cadence: 'Ns' = every N seconds, bare "
+                         "N = every N steps (PS commits for the async "
+                         "family)")
+    ap.add_argument("--publish-keep", type=int, default=5,
+                    help="retained published versions (older files are "
+                         "pruned; the manifest's current one is always "
+                         "kept)")
+    ap.add_argument("--publish-min-improvement", type=float, default=None,
+                    metavar="DELTA",
+                    help="metric gate: only publish when the loss "
+                         "improved by at least DELTA over the best "
+                         "published loss (a plateaued run stops churning "
+                         "the fleet)")
     ap.add_argument("--audit-recompiles", action="store_true",
                     help="count train-step compiles (+ triggering shapes); "
                          "report appears in the summary line")
@@ -619,6 +843,16 @@ def main(argv=None) -> int:
             args.metrics_out, registry=trainer.registry)
     if args.audit_recompiles:
         trainer.auditor = RecompileAuditor(registry=trainer.registry)
+    if args.publish_dir:
+        from distkeras_tpu.deploy import (
+            WeightPublisher, parse_publish_every,
+        )
+
+        policy = parse_publish_every(args.publish_every)
+        policy.min_improvement = args.publish_min_improvement
+        trainer.publisher = WeightPublisher(
+            args.publish_dir, policy, keep=args.publish_keep,
+            registry=trainer.registry)
 
     import contextlib
     import threading
@@ -674,6 +908,8 @@ def main(argv=None) -> int:
             summary["staleness_p99"] = round(stale["p99"], 3)
         if health.goodput_ratio is not None:
             summary["goodput_ratio"] = round(health.goodput_ratio, 6)
+    if args.publish_dir and trainer.publisher is not None:
+        summary["published"] = trainer.publisher.stats()
     if args.out:
         if isinstance(trained, list):  # EnsembleTrainer
             for i, t in enumerate(trained):

@@ -229,6 +229,14 @@ class ServingClient:
             spec["trace_id"] = str(trace_id)
         return (await self._control(spec, retry=True))["tracez"]
 
+    async def deployz(self) -> dict:
+        """Continuous-deployment state (current / last-good / candidate
+        versions, deploy history ring, quarantine records) from a router
+        with an attached DeployController. Reconnects with backoff
+        (idempotent)."""
+        return (await self._control({"cmd": "deployz"},
+                                    retry=True))["deployz"]
+
     async def reload(self, weights: str, timeout: float = 60.0) -> dict:
         """Hot-swap weights: a rolling reload when pointed at a cluster
         router, a single-engine swap when pointed at one server. NOT

@@ -127,6 +127,10 @@ class Router:
         self.connect_timeout_s = float(connect_timeout_s)
         self.trace_store = (TraceStore(trace_capacity)
                             if trace_capacity else None)
+        # A DeployController (distkeras_tpu.deploy) registers itself
+        # here; the router then answers the ``deployz`` verb with its
+        # state page. None = verb replies bad_request.
+        self.deploy_controller = None
         self._server: asyncio.AbstractServer | None = None
         # Idle backend connections, keyed by (rid, port): a restarted
         # replica binds a fresh port, so its stale pool is simply never
@@ -581,6 +585,12 @@ class Router:
             return await self._tracez(spec)
         if cmd == "reload":
             return await self.rolling_reload(spec)
+        if cmd == "deployz":
+            if self.deploy_controller is None:
+                return {"error": "no deploy controller is attached to "
+                                 "this router (start one with `run.py "
+                                 "deploy`)", "code": "bad_request"}
+            return {"deployz": self.deploy_controller.deployz()}
         return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
 
     async def _tracez(self, spec: dict) -> dict:
@@ -638,12 +648,28 @@ class Router:
                     "code": "bad_request"}
         reloaded: list[str] = []
         failed: dict[str, str] = {}
+        replicas: dict[str, dict] = {}
         async with self._reload_lock:
             with span("rolling_reload", weights=path):
                 for rid, info in list(self.supervisor.replicas.items()):
                     if info.status != READY:
                         failed[rid] = f"skipped: status={info.status}"
                         continue
+                    # Provenance BEFORE the swap: callers (the deploy
+                    # controller, operators) verify the roll from this
+                    # one reply instead of a second healthz fan-out.
+                    # Probed while the replica is still READY — the
+                    # version can't change before its own swap, and the
+                    # probe's round trip must not widen the N-1 window.
+                    before = None
+                    try:
+                        h = await self._backend_control(
+                            info, {"cmd": "healthz"})
+                        before = h.get("healthz", {}).get(
+                            "weight_version")
+                    except (OSError, ValueError,
+                            asyncio.TimeoutError, _BackendLost):
+                        pass  # the reload itself is the gate
                     info.status = DRAINING
                     try:
                         with span("reload_replica", replica=rid):
@@ -661,6 +687,11 @@ class Router:
                                 timeout=swap_timeout + 10.0)
                             if "error" in rep:
                                 raise RuntimeError(rep["error"])
+                            replicas[rid] = {
+                                "before": before,
+                                "after": rep.get("reload", {}).get(
+                                    "weight_version"),
+                            }
                         reloaded.append(rid)
                         # From the first successful swap on, this is the
                         # fleet's current version: any replica that
@@ -681,7 +712,8 @@ class Router:
         if not failed and self._c_reloads is not None:
             self._c_reloads.inc()
         return {"reload": {"weights": path, "reloaded": reloaded,
-                           "failed": failed, "ok": not failed}}
+                           "failed": failed, "ok": not failed,
+                           "replicas": replicas}}
 
     @staticmethod
     async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:

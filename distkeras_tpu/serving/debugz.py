@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import time
 
-__all__ = ["format_debugz", "format_tracez", "format_statusz"]
+__all__ = ["format_debugz", "format_tracez", "format_statusz",
+           "format_deployz"]
 
 
 def _table(rows: list[dict], columns: list[tuple[str, str]]) -> list[str]:
@@ -248,6 +249,66 @@ def format_statusz(payload: dict) -> str:
     if payload.get("observe_errors"):
         lines.append(f"observe_errors: {payload['observe_errors']} "
                      f"(health hooks failing — see the training log)")
+    return "\n".join(lines)
+
+
+def _wv(prov) -> str:
+    if not isinstance(prov, dict):
+        return "-"
+    base = f"v{prov.get('version')} digest={prov.get('digest') or '-'}"
+    path = prov.get("path")
+    return f"{base} ({path})" if path else base
+
+
+def format_deployz(payload: dict) -> str:
+    """Pretty-print a ``deployz`` payload
+    (:meth:`distkeras_tpu.deploy.controller.DeployController.deployz`):
+    current/last-good/candidate versions, deploy counters, the history
+    ring (most recent last), and quarantine records — the page an
+    operator reads first when "the fleet is serving the wrong model"."""
+    lines: list[str] = []
+    lines.append(f"deploy: watching {payload.get('watch_dir')} "
+                 f"(poll {payload.get('poll_interval_s')}s, "
+                 f"{payload.get('golden_prompts', 0)} golden prompts)")
+    lines.append(f"current:   {_wv(payload.get('current'))}")
+    lines.append(f"last_good: {_wv(payload.get('last_good'))}")
+    if payload.get("candidate"):
+        lines.append(f"candidate: {_wv(payload['candidate'])} (in flight)")
+    c = payload.get("counters", {})
+    lines.append(f"counters: deploys={c.get('deploys')} "
+                 f"canary_failures={c.get('canary_failures')} "
+                 f"validation_failures={c.get('validation_failures')} "
+                 f"rollbacks={c.get('rollbacks')}")
+    history = payload.get("history", [])
+    if history:
+        lines.append("history (most recent last):")
+        rows = []
+        for e in history:
+            rows.append({
+                "when": time.strftime("%H:%M:%S",
+                                      time.localtime(e.get("t", 0))),
+                "version": f"v{e.get('version')}",
+                "status": e.get("status"),
+                "latency_s": e.get("latency_s"),
+                "step": e.get("step"),
+                "loss": e.get("loss"),
+                "canary": e.get("canary"),
+                "reason": (str(e.get("reason"))[:48]
+                           if e.get("reason") else None),
+            })
+        for ln in _table(rows, [("when", "when"), ("version", "version"),
+                                ("status", "status"),
+                                ("latency_s", "latency_s"),
+                                ("step", "step"), ("loss", "loss"),
+                                ("canary", "canary"),
+                                ("reason", "reason")]):
+            lines.append(f"  {ln}")
+    quarantined = payload.get("quarantined", [])
+    if quarantined:
+        lines.append("quarantined:")
+        for q in quarantined:
+            lines.append(f"  v{q.get('version')}: {q.get('reason')} -> "
+                         f"{q.get('quarantined_to', q.get('path'))}")
     return "\n".join(lines)
 
 

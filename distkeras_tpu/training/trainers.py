@@ -181,6 +181,12 @@ class Trainer:
         # (and, armed, compile-after-warmup violations) are tracked.
         self.registry = registry
         self.auditor = auditor
+        # Optional distkeras_tpu.deploy.WeightPublisher: the trainer
+        # side of the continuous-deployment loop. Step-loop trainers
+        # call _maybe_publish per step; the async family publishes the
+        # PS center from a dedicated thread. run.py wires it from
+        # --publish-dir/--publish-every.
+        self.publisher = None
         self.history: list[dict] = []
         self._training_start: float | None = None
         self._training_stop: float | None = None
@@ -236,6 +242,14 @@ class Trainer:
                     self.registry.gauge(
                         "train_last_" + sanitize_metric_name(k),
                         help="last-step train metric").set(v)
+
+    def _maybe_publish(self, step: int, variables_fn, loss_fn=None) -> None:
+        """Per-step publish hook (no-op without a publisher). Both
+        callables are lazy — an idle cadence costs two comparisons, no
+        device sync, no host copy."""
+        if self.publisher is not None:
+            self.publisher.maybe_publish(variables_fn, step=step,
+                                         loss_fn=loss_fn)
 
     def _audit(self, step_fn, name: str):
         """Wrap a jitted step with the attached recompile auditor (no-op
@@ -350,6 +364,10 @@ class SingleTrainer(Trainer):
                 with span("train_step"):
                     state, m = step_fn(state, batch)
                 self.history.append(m)
+                self._maybe_publish(
+                    len(self.history),
+                    lambda: jax.device_get(state.variables),
+                    loss_fn=lambda: float(m["loss"]))
             if self.validation_data is not None:
                 snapshot = TrainedModel(self.model, state.variables)
                 with span("validation", epoch=epoch):
@@ -677,6 +695,10 @@ class SynchronousDistributedTrainer(Trainer):
                 self.history.append(m)
                 step_no = i + 1
                 ck.maybe_save(step_no, state)
+                self._maybe_publish(
+                    step_no,
+                    lambda: jax.device_get(state.variables),
+                    loss_fn=lambda: float(m["loss"]))
             ck.finalize(step_no, state)
         finally:
             ck.close()
@@ -984,6 +1006,46 @@ class AsynchronousDistributedTrainer(Trainer):
                 f"> {len(devices)} attached devices"
             )
 
+        # Continuous deployment: a dedicated thread publishes the PS
+        # CENTER on the publisher's cadence — the serving fleet deploys
+        # from the same periodically-exchanged weights the async
+        # protocol maintains, while the workers' hot loops stay
+        # untouched (the only worker-side cost is keeping a reference to
+        # the latest already-materialized window loss). Started last so
+        # no pre-flight ValueError above can leak a running thread.
+        pub_stop = threading.Event()
+        pub_thread = None
+        self._publish_loss = None
+        if self.publisher is not None:
+            svc_ref = self.parameter_server
+
+            def _publish_loss_now():
+                arr = self._publish_loss
+                if arr is None:
+                    return None
+                return float(np.asarray(arr)[-1])
+
+            def _publish_loop():
+                import logging
+
+                while not pub_stop.wait(0.2):
+                    try:
+                        self.publisher.maybe_publish(
+                            lambda: {"params": svc_ref.get_model()},
+                            step=svc_ref.num_commits,
+                            loss_fn=_publish_loss_now)
+                    except Exception:
+                        # The publisher already swallows its own
+                        # failures; this guards the PS accessors — ONE
+                        # surprise must not silently kill the thread and
+                        # end publishing for the rest of a long run.
+                        logging.getLogger(__name__).exception(
+                            "weight-publisher tick failed")
+
+            pub_thread = threading.Thread(
+                target=_publish_loop, name="weight-publisher", daemon=True)
+            pub_thread.start()
+
         def worker_loop(widx: int):
             try:
                 if dpw > 1:
@@ -1074,6 +1136,12 @@ class AsynchronousDistributedTrainer(Trainer):
                             state, ms, wsize = exec_window(state, item)
                             jax.block_until_ready(ms["loss"])
                         win_histories[widx].append((ms, wsize, time.time()))
+                        if self.publisher is not None:
+                            # Already block_until_ready'd above: holding
+                            # the newest window's loss array costs no
+                            # extra device sync; the publisher thread
+                            # materializes ONE float from it lazily.
+                            self._publish_loss = ms["loss"]
                         if health is not None:
                             health.record_window(widx, wsize)
                         if pending is not None:
@@ -1180,6 +1248,18 @@ class AsynchronousDistributedTrainer(Trainer):
             t.join()
 
         center = ps.get_model()
+        if pub_thread is not None:
+            pub_stop.set()
+            pub_thread.join(timeout=10)
+            # Final snapshot: the publish directory always ends on the
+            # run's final center, even for runs shorter than one cadence
+            # interval.
+            final_loss = (float(np.asarray(self._publish_loss)[-1])
+                          if self._publish_loss is not None else None)
+            self.publisher.publish(
+                {"params": center},
+                step=int(self.parameter_server.num_commits),
+                loss=final_loss)
         if ckpt_mgr is not None:
             stop_ckpt.set()
             ckpt_thread.join(timeout=10)

@@ -8,7 +8,11 @@ serving rows under ``serving/...`` keys (TTFT/ITL percentiles, goodput,
 prefix-cache hit rate) — both keep a bounded trail of displaced entries
 under ``prev``. Training-health rows live under ``train/...`` keys
 (``train/<protocol>/workersN/staleness_p99``, ``.../goodput_ratio``)
-and stay warn-only like every training row. This script compares the
+and stay warn-only like every training row. Continuous-deployment rows
+from ``benchmarks/deploy_bench.py`` live under ``deploy/...`` keys:
+``deploy_latency_p50_s``/``p95_s`` regress by RISING (a slower deploy
+is a wider trained->serving staleness window), ``canary_pass_rate``
+and goodput by dropping. This script compares the
 latest entry of each config (by default only the most recently updated
 one) against its prior same-config entry and WARNS when it drifted by
 more than ``--threshold`` (default 10%) **in the bad direction**:
@@ -48,12 +52,17 @@ def load_history(path: str) -> dict:
 # Metrics where a RISE is the regression. Matched against the key's
 # final path segment (serving rows look like
 # ``serving/<model>/slots4/closed/ttft_p99_s``; training-health rows
-# like ``train/<protocol>/workers4/staleness_p99``). Throughput rows —
-# including ``goodput_*`` and the training-health ``goodput_ratio``,
-# where a DROP means the protocol is damping away more of the fleet's
-# work — never end in these names, so they keep higher-is-better.
+# like ``train/<protocol>/workers4/staleness_p99``; continuous-
+# deployment rows like ``deploy/gpt_tiny/replicas2/every2s/
+# deploy_latency_p50_s``, where deploy latency is the trained->serving
+# staleness window and regresses UP while ``canary_pass_rate`` — good
+# publishes that actually deployed — regresses DOWN). Throughput rows —
+# including ``goodput_*``, the training-health ``goodput_ratio``, and
+# ``canary_pass_rate`` — never end in these names, so they keep
+# higher-is-better.
 _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
-                    "queue_wait", "latency", "staleness")
+                    "queue_wait", "latency", "staleness",
+                    "deploy_latency")
 
 
 def lower_is_better(key: str) -> bool:

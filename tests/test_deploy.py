@@ -1,0 +1,522 @@
+"""Continuous deployment (distkeras_tpu.deploy): publish -> canary -> roll.
+
+The loop under test closes ROADMAP open item 4: a trainer publishes
+stamped weight files + an atomic manifest; a DeployController watching
+the directory validates each candidate, canaries it on one drained
+replica (golden prompts + finite golden-batch loss), rolls it through
+the router's zero-downtime reload, and rolls back + quarantines on any
+failure. Invariants asserted here:
+
+- served provenance flips with each deploy: done lines carry the NEW
+  ``(version, digest)`` after a roll and the OLD one before it, with no
+  client-visible error at any point (>= N-1 replicas serving);
+- a corrupted publish (NaN weights, wrong shapes, a canary latency
+  breach) never reaches the fleet: it is rejected at the right stage,
+  quarantined with a reason record served by ``deployz``, and the
+  canary replica is restored to last-good;
+- the armed RecompileAuditor is silent across every reload — weight
+  churn costs ZERO decode retraces;
+- the rolling reload's reply names each replica's before/after
+  ``(version, digest)`` so a roll is verifiable from one reply;
+- trainers actually publish: the step-loop family per step, the async
+  family from the PS-center thread, both leaving a readable manifest.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.checkpoint import (
+    load_weights_file_with_provenance,
+    publish_weights,
+    read_manifest,
+    weights_provenance,
+)
+from distkeras_tpu.deploy import (
+    PublishPolicy,
+    WeightPublisher,
+    parse_publish_every,
+)
+from distkeras_tpu.deploy.harness import wire_controller
+from distkeras_tpu.models.bert import gpt_tiny
+from distkeras_tpu.serving import (
+    LocalReplica,
+    ServingClient,
+    ServingCluster,
+    ServingEngine,
+)
+from distkeras_tpu.telemetry import MetricsRegistry, RecompileAuditor
+
+VOCAB = 64
+
+# Fast probing but contention-tolerant death detection: the full tier-1
+# suite can stall this one event loop for seconds at a time (jax
+# compiles in neighboring tests), and a spurious probe timeout must not
+# kill a healthy replica mid-deploy.
+SUP = dict(health_interval_s=0.05, health_timeout_s=5.0, fail_after=4,
+           base_delay_s=0.05, max_delay_s=1.0, stable_after_s=0.5)
+
+
+async def _publish(d, variables, **meta):
+    """Publish OFF the event loop: model.init + serialization can stall
+    a shared loop long enough to time out health probes."""
+    return await asyncio.to_thread(
+        publish_weights, d, variables, meta=meta or None)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = gpt_tiny(seq_len=32, vocab_size=VOCAB)
+    return model, model.init(0)
+
+
+def _cluster(lm_pair, boot_path, engines=None, n=2):
+    """2-replica LocalReplica cluster booted from a published weights
+    FILE (each engine carries the file's provenance stamp), every engine
+    under its own armed RecompileAuditor."""
+    model, variables = lm_pair
+
+    def factory(i):
+        def build():
+            v, prov = load_weights_file_with_provenance(
+                boot_path, like=variables)
+            eng = ServingEngine(model, v, slots=2, max_queue=16,
+                                auditor=RecompileAuditor(),
+                                arm_auditor_after_warmup=True,
+                                weight_version=prov)
+            if engines is not None:
+                engines[i] = eng
+            return eng
+
+        return LocalReplica(build)
+
+    return ServingCluster(factory, n, supervisor_kwargs=SUP,
+                          registry=MetricsRegistry())
+
+
+# -- publish-directory contract (no cluster, fast) ----------------------------
+
+def test_publish_dir_contract(tmp_path):
+    d = str(tmp_path / "pub")
+    tree = {"params": {"w": np.ones((3, 2), np.float32)}}
+    m1 = publish_weights(d, tree, meta={"step": 10, "loss": 0.5})
+    assert m1["version"] == 1 and m1["digest"]
+    # The manifest points at a stamped file whose own provenance agrees.
+    got = read_manifest(d)
+    assert got["version"] == 1 and got["step"] == 10 and got["loss"] == 0.5
+    assert os.path.isabs(got["path"]) and os.path.exists(got["path"])
+    prov = weights_provenance(got["path"])
+    assert prov["version"] == 1 and prov["digest"] == m1["digest"]
+    # Versions are monotonic, files immutable-per-version, retention
+    # bounded with the manifest's file always kept.
+    for _ in range(5):
+        publish_weights(d, tree, keep=3)
+    names = sorted(n for n in os.listdir(d) if n.startswith("weights-v"))
+    assert len(names) == 3
+    assert os.path.basename(read_manifest(d)["path"]) == names[-1]
+    # A torn/garbage manifest reads as None, not an exception.
+    (tmp_path / "pub" / "MANIFEST.json").write_text("{not json")
+    assert read_manifest(d) is None
+
+    # Cadence parsing + the loss gate.
+    assert parse_publish_every("2.5s").every_seconds == 2.5
+    assert parse_publish_every("40").every_steps == 40
+    with pytest.raises(ValueError):
+        parse_publish_every("0")
+    pub = WeightPublisher(str(tmp_path / "gated"),
+                          PublishPolicy(every_steps=1,
+                                        min_improvement=0.1))
+    assert pub.maybe_publish(lambda: tree, step=0, loss_fn=lambda: 1.0)
+    assert pub.maybe_publish(lambda: tree, step=1,
+                             loss_fn=lambda: 0.99) is None  # not enough
+    assert pub.maybe_publish(lambda: tree, step=2, loss_fn=lambda: 0.8)
+    assert pub.published == 2
+
+
+# -- the loop: served provenance flips under load -----------------------------
+
+def test_deploy_loop_flips_served_versions_under_load(lm, rng, tmp_path,
+                                                      artifact_dir):
+    """Publish two successive good versions while a cluster serves
+    continuous load: each deploy canary-validates and rolls with zero
+    client-visible errors, every done line names the version that served
+    it (boot v1 -> v2 -> v3 in completion order), the rolling reply
+    carries per-replica before/after stamps, and the armed auditor
+    proves zero decode retraces across both rolls."""
+    model, variables = lm
+    d = str(tmp_path / "pub")
+    boot = publish_weights(d, variables, meta={"step": 0})
+    pool = [rng.integers(0, VOCAB, size=(n,)).tolist() for n in (4, 6, 5)]
+
+    async def go():
+        engines = {}
+        cluster = _cluster(lm, boot["path"], engines)
+        completions = []
+        errors = []
+        stop = asyncio.Event()
+
+        async def worker(k):
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                while not stop.is_set():
+                    p = pool[(k + len(completions)) % len(pool)]
+                    try:
+                        done = await c.generate(p, 4)
+                        completions.append(
+                            (time.monotonic(), done["weight_version"]))
+                    except Exception as e:  # any client-visible failure
+                        errors.append(repr(e))
+                        return
+
+        cluster_ctx = cluster
+        async with cluster_ctx:
+            registry = cluster.router.registry
+            ctrl = wire_controller(
+                cluster.router, d, model=model, vocab=VOCAB,
+                golden_count=2, golden_len=6, seed=0, registry=registry,
+                initial_weights=boot["path"])
+            workers = [asyncio.create_task(worker(k)) for k in range(3)]
+            while len(completions) < 3:
+                await asyncio.sleep(0.02)
+            outcomes = []
+            for seed in (1, 2):
+                fresh = await asyncio.to_thread(model.init, seed)
+                await _publish(d, fresh, step=seed * 100, loss=1.0 / seed)
+                outcomes.append(await ctrl.poll_once())
+                n_after = len(completions) + 3
+                while len(completions) < n_after:
+                    await asyncio.sleep(0.02)
+            stop.set()
+            await asyncio.gather(*workers)
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                dz = await c.deployz()
+            audits = {
+                i: (eng.auditor.compiles("serving_decode"),
+                    eng.auditor.report()["serving_decode"]["armed"])
+                for i, eng in engines.items()}
+            # Post-deploy restarts rejoin on the DEPLOYED version: the
+            # roll moved current_weights to the controller's staged v3.
+            assert (cluster.supervisor.current_weights
+                    == dz["current"]["path"])
+        return outcomes, completions, errors, dz, audits
+
+    outcomes, completions, errors, dz, audits = asyncio.run(go())
+    assert errors == []
+    assert [o["status"] for o in outcomes] == ["deployed", "deployed"]
+    # Provenance flips in completion order: boot v1 first, every
+    # deployed version observed, newest version at the end. (Strict
+    # global monotonicity is NOT asserted: during a roll the draining
+    # replica's old-version completions legitimately interleave with
+    # the first rolled replica's new-version ones.)
+    versions = [wv["version"] for _, wv in completions]
+    assert sorted(set(versions)) == [1, 2, 3]
+    assert versions[0] == 1 and versions[-1] == 3
+    for _, wv in completions:
+        assert set(wv) == {"version", "digest"} and wv["digest"]
+    # The rolling reply's per-replica before/after stamps, recorded in
+    # each deploy's history entry: the v3 roll moved every replica
+    # v2 -> v3 (the canary replica's "before" may already read v3 —
+    # its swap happened in the canary stage).
+    assert dz["counters"]["deploys"] == 2
+    assert dz["current"]["version"] == 3
+    assert [e["status"] for e in dz["history"]] == ["deployed", "deployed"]
+    moved = dz["history"][-1]["replicas_moved"]
+    assert set(moved) == {"r0", "r1"}
+    canary_rid = dz["history"][-1]["canary"]
+    for rid, mv in moved.items():
+        want_before = 3 if rid == canary_rid else 2
+        assert mv["before"]["version"] == want_before, (rid, mv)
+        assert mv["after"]["version"] == 3, (rid, mv)
+    # Zero retraces across boot + two canaries + two rolls + one direct
+    # roll, with the auditor armed the whole time.
+    assert audits and all(c == 1 and armed
+                          for c, armed in audits.values()), audits
+    # The human page renders the same state (run.py deployz's formatter).
+    from distkeras_tpu.serving.debugz import format_deployz
+
+    page = format_deployz(dz)
+    assert "current:   v3" in page and "deploys=2" in page
+    assert "history (most recent last):" in page
+    with open(os.path.join(str(artifact_dir), "deployz_snapshot.json"),
+              "w") as f:
+        json.dump(dz, f, indent=1)
+
+
+# -- bad candidates: rejected at the right stage, fleet protected -------------
+
+def test_bad_publishes_rejected_and_fleet_protected(lm, rng, tmp_path):
+    """Three failure modes through one live cluster: wrong-shaped
+    weights fail host-side validation (no replica touched), NaN weights
+    fail the canary's finite golden loss, and a latency-budget breach
+    fails the replica-side canary and RESTORES the canary replica — the
+    fleet serves the last-good version untouched throughout, every
+    reject is quarantined with a reason, and a subsequent good publish
+    deploys cleanly (the loop is not wedged by failures)."""
+    model, variables = lm
+    d = str(tmp_path / "pub")
+    boot = publish_weights(d, variables)
+    import jax
+
+    async def go():
+        engines = {}
+        cluster = _cluster(lm, boot["path"], engines)
+        async with cluster:
+            ctrl = wire_controller(
+                cluster.router, d, model=model, vocab=VOCAB,
+                golden_count=1, golden_len=5, seed=0,
+                registry=cluster.router.registry,
+                initial_weights=boot["path"])
+
+            # (a) shape mismatch -> validation_failed, before any canary.
+            wrong = await asyncio.to_thread(
+                lambda: gpt_tiny(seq_len=32, vocab_size=32).init(0))
+            await _publish(d, wrong)
+            out_a = await ctrl.poll_once()
+
+            # (b) NaN weights -> canary rejects on non-finite golden
+            # loss (shape/dtype validation passes by construction).
+            bad = await asyncio.to_thread(
+                lambda: jax.tree.map(lambda x: np.asarray(x) * np.nan,
+                                     model.init(3)))
+            await _publish(d, bad)
+            out_b = await ctrl.poll_once()
+
+            # (c) impossible latency budget -> replica-side canary
+            # fails AFTER the canary replica swapped; it must be
+            # restored to last-good and readmitted.
+            ctrl.canary_latency_s = 1e-6
+            await _publish(d, await asyncio.to_thread(model.init, 4),
+                           step=400)
+            out_c = await ctrl.poll_once()
+            ctrl.canary_latency_s = 30.0
+
+            # Fleet still serves the BOOT version after all three.
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                done = await c.generate(
+                    rng.integers(0, VOCAB, size=(5,)).tolist(), 4)
+                health = await c.healthz()
+            # (d) the loop recovers: the next good publish deploys.
+            await _publish(d, await asyncio.to_thread(model.init, 5),
+                           step=500)
+            out_d = await ctrl.poll_once()
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                dz = await c.deployz()
+            audits = {i: eng.auditor.compiles("serving_decode")
+                      for i, eng in engines.items()}
+        return out_a, out_b, out_c, done, health, out_d, dz, audits
+
+    out_a, out_b, out_c, done, health, out_d, dz, audits = asyncio.run(go())
+    assert out_a["status"] == "validation_failed"
+    assert "leaf" in out_a["reason"] or "leaves" in out_a["reason"]
+    assert "canary" not in out_a  # no replica was drained for it
+    assert out_b["status"] == "canary_rejected"
+    assert "not finite" in out_b["reason"]
+    assert out_c["status"] == "canary_rejected"
+    assert "latency budget" in out_c["reason"]
+    # After the three rejects the fleet is whole, single-version, on
+    # the boot stamp.
+    assert done["weight_version"]["version"] == 1
+    assert health["router"]["replicas_ready"] == 2
+    assert health["router"]["mixed_weight_versions"] is False
+    assert list(health["router"]["weight_versions"].values()) == [2]
+    # Recovery deploy landed.
+    assert out_d["status"] == "deployed"
+    assert dz["current"]["version"] == 5
+    # Every reject left a quarantine record (file moved + reason).
+    assert {q["version"] for q in dz["quarantined"]} == {2, 3, 4}
+    for q in dz["quarantined"]:
+        assert q.get("quarantined_to") and os.path.exists(
+            q["quarantined_to"])
+        assert os.path.exists(q["quarantined_to"] + ".reason.json")
+    assert dz["counters"] == {"deploys": 1, "canary_failures": 2,
+                              "validation_failures": 1, "rollbacks": 0}
+    # Zero retraces through every reject/restore/deploy.
+    assert all(c == 1 for c in audits.values()), audits
+
+
+# -- trainer-side publishing --------------------------------------------------
+
+def test_step_trainer_publishes_on_cadence(tmp_path, rng):
+    """SingleTrainer + WeightPublisher: per-step cadence publishes land
+    with step/loss metadata and the manifest tracks the newest."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.mlp import higgs_mlp
+    from distkeras_tpu.training.trainers import SingleTrainer
+
+    x = rng.normal(size=(96, 28)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = Dataset.from_arrays(features=x, label=y)
+    trainer = SingleTrainer(higgs_mlp(), worker_optimizer="adam",
+                            learning_rate=0.01, batch_size=32, num_epoch=2)
+    d = str(tmp_path / "pub")
+    trainer.publisher = WeightPublisher(d, PublishPolicy(every_steps=2))
+    trainer.train(ds)
+    # 6 steps, cadence 2, first always due: steps 1, 3, 5.
+    manifest = read_manifest(d)
+    assert manifest["version"] == 3
+    assert manifest["step"] == 5
+    assert np.isfinite(manifest["loss"])
+    # The published file is a servable, stamped weights file.
+    v, prov = load_weights_file_with_provenance(manifest["path"])
+    assert prov["version"] == 3 and prov["digest"] == manifest["digest"]
+    assert "params" in v
+
+
+@pytest.mark.slow
+def test_train_publish_deploy_e2e_real_processes(tmp_path, rng,
+                                                 artifact_dir):
+    """THE loop on real child processes: a `run.py deploy` child (2
+    ProcessReplica serving children + router + controller) watches a
+    publish directory; a `run.py train` child (DOWNPOUR, gpt_tiny on
+    token data) publishes the PS center on a wall-clock cadence. The
+    served weight version flips under client load as deploys land; a
+    deliberately corrupted publish is canary-rejected, quarantined, and
+    the fleet keeps serving the last-good version; every replica's
+    decode step compiled exactly once through all of it."""
+    import subprocess
+    import sys
+
+    SEQ = 32
+    d = str(tmp_path / "pub")
+
+    # Token LM data (the char_lm shape: next-token targets).
+    ids = rng.integers(0, VOCAB, size=(3000,)).astype(np.int32)
+    starts = np.arange(0, len(ids) - SEQ - 1, 4)
+    data = tmp_path / "tokens.npz"
+    np.savez(data,
+             features=np.stack([ids[s:s + SEQ] for s in starts]),
+             label=np.stack([ids[s + 1:s + SEQ + 1] for s in starts]))
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({
+        "trainer": "DOWNPOUR", "worker_optimizer": "adam",
+        "learning_rate": 1e-3, "num_workers": 2, "batch_size": 8,
+        "num_epoch": 2, "communication_window": 4,
+    }))
+    model_args = json.dumps({"seq_len": SEQ, "vocab_size": VOCAB})
+
+    deploy_child = subprocess.Popen(
+        [sys.executable, "-m", "distkeras_tpu.run", "deploy",
+         "--watch-dir", d, "--model", "gpt_tiny",
+         "--model-args", model_args, "--replicas", "2", "--port", "0",
+         "--poll-ms", "200", "--golden", "2", "--golden-len", "6",
+         "--canary-latency-ms", "60000"],
+        stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    train_child = None
+    try:
+        # Banner lines: bootstrap publish, then the fleet banner (after
+        # both replica children answered healthz).
+        port = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = deploy_child.stdout.readline()
+            assert line, "deploy child exited before its banner"
+            rec = json.loads(line)
+            if "deploy" in rec:
+                port = rec["port"]
+                break
+        assert port, "no deploy banner within 300s"
+        assert read_manifest(d)["version"] == 1  # bootstrap publish
+
+        train_child = subprocess.Popen(
+            [sys.executable, "-m", "distkeras_tpu.run", "train",
+             "--config", str(cfg), "--data", str(data),
+             "--model", "gpt_tiny", "--model-args", model_args,
+             "--publish-dir", d, "--publish-every", "3s"],
+            stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+        seen: list = []
+
+        async def drive():
+            async with ServingClient("127.0.0.1", port) as c:
+                # Load until the trainer has exited AND its final
+                # publish has been deployed (or 300s passes).
+                stop_at = time.monotonic() + 300
+                while time.monotonic() < stop_at:
+                    done = await c.generate(
+                        rng.integers(0, VOCAB, size=(5,)).tolist(), 3)
+                    seen.append(done["weight_version"])
+                    if train_child.poll() is not None:
+                        dz = await c.deployz()
+                        final = read_manifest(d)["version"]
+                        if (dz["counters"]["deploys"] >= 1
+                                and dz["seen_version"] >= final):
+                            break
+                    await asyncio.sleep(0.1)
+                # Corrupt publish AFTER training: NaN weights must be
+                # canary-rejected without disturbing the fleet.
+                model = gpt_tiny(seq_len=SEQ, vocab_size=VOCAB)
+                import jax
+
+                publish_weights(d, jax.tree.map(
+                    lambda x: np.asarray(x) * np.nan, model.init(9)))
+                stop_at = time.monotonic() + 120
+                while time.monotonic() < stop_at:
+                    dz = await c.deployz()
+                    if dz["counters"]["canary_failures"] >= 1:
+                        break
+                    await asyncio.sleep(0.2)
+                done = await c.generate([1, 2, 3], 3)
+                health = await c.healthz()
+                return dz, done, health
+
+        dz, done, health = asyncio.run(drive())
+    finally:
+        for child in (train_child, deploy_child):
+            if child is not None and child.poll() is None:
+                child.terminate()
+                try:
+                    child.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+
+    assert train_child.wait() == 0
+    # The served version flipped under load: boot v1 plus >= 1 deployed
+    # trainer publish observed on done lines.
+    versions = sorted({wv["version"] for wv in seen})
+    assert versions[0] == 1 and len(versions) >= 2, versions
+    assert dz["counters"]["deploys"] >= 1
+    # The corrupted publish was rejected + quarantined; the fleet still
+    # serves the last-good (deployed) version.
+    assert dz["counters"]["canary_failures"] >= 1
+    assert dz["quarantined"] and "finite" in dz["quarantined"][-1]["reason"]
+    assert done["weight_version"]["version"] == dz["current"]["version"]
+    # Fleet whole, single-version, and ZERO decode retraces per replica
+    # across boot + every canary + every roll.
+    assert health["router"]["replicas_ready"] == 2
+    assert health["router"]["mixed_weight_versions"] is False
+    for rid, entry in health["replicas"].items():
+        assert entry["healthz"]["decode_compile_count"] == 1, (rid, entry)
+    with open(os.path.join(str(artifact_dir), "deploy_e2e_deployz.json"),
+              "w") as f:
+        json.dump(dz, f, indent=1)
+
+
+def test_async_trainer_publishes_ps_center(tmp_path, rng):
+    """DOWNPOUR + publisher thread: the PS center is published on a
+    wall-clock cadence during training plus a final snapshot, stamped
+    with the commit counter as the step."""
+    from distkeras_tpu.data.dataset import Dataset
+    from distkeras_tpu.models.mlp import higgs_mlp
+    from distkeras_tpu.training.trainers import DOWNPOUR
+
+    x = rng.normal(size=(256, 28)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    ds = Dataset.from_arrays(features=x, label=y)
+    trainer = DOWNPOUR(higgs_mlp(), worker_optimizer="adam",
+                       learning_rate=0.01, num_workers=2, batch_size=16,
+                       num_epoch=3, communication_window=4)
+    d = str(tmp_path / "pub")
+    trainer.publisher = WeightPublisher(d, PublishPolicy(every_seconds=0.3))
+    trainer.train(ds)
+    manifest = read_manifest(d)
+    # At least the thread's first publish + the final center snapshot.
+    assert manifest["version"] >= 2
+    assert manifest["step"] == trainer.parameter_server.num_commits
+    assert trainer.publisher.failures == 0
+    v, _ = load_weights_file_with_provenance(manifest["path"])
+    assert "params" in v
