@@ -46,6 +46,19 @@ keys (``serving/paged_*`` for paged runs);
 ``scripts/check_bench_regression.py`` diffs them against the prior
 same-config run (direction-aware: latency up = bad).
 
+``--speculate`` (optionally ``--draft-model``/``--spec-k``) turns on
+**speculative decoding**: a draft model proposes K tokens per engine
+tick and ONE batched target call verifies them, so a tick commits up to
+K tokens per greedy slot. The default draft is the target itself — the
+sanity config where acceptance is ~100% and the measured speedup
+isolates speculation's GEMV→GEMM/dispatch restructuring. The run arms
+the ``RecompileAuditor`` and asserts draft, verify, and the fallback
+decode each compiled exactly once; parity against ``generate()`` is
+checked as always (committed tokens are always draft tokens, so the
+sanity config's chain is bitwise the sequential one). Reports the
+accept rate, per-mode ``spec_*`` counters, and — with
+``--record-history`` — ``serving/spec_*`` history rows.
+
 ``--replicas N`` (N >= 2) swaps the single engine for an **in-process
 cluster**: N engines behind the supervised router
 (:mod:`distkeras_tpu.serving.cluster`), with the load driven through TCP
@@ -87,11 +100,48 @@ def _model(args):
     return model, model.init(0)
 
 
+def _speculating(args) -> bool:
+    return bool(args.speculate or args.draft_model)
+
+
+def _draft(args, model, variables):
+    """The draft pair for --speculate. Default (no --draft-model, or the
+    same name as --model) is the **draft==target sanity config**: the
+    draft IS the target — acceptance ~100%, so the measured speedup
+    isolates what speculation's restructuring buys (K scanned draft
+    steps + ONE K-wide verify dispatch vs K one-token dispatches)
+    from draft-model quality. A different name builds that zoo model at
+    the target's vocab with seed-init weights."""
+    if not _speculating(args):
+        return None, None
+    name = args.draft_model or args.model
+    if name == args.model:
+        return model, variables
+    from distkeras_tpu.models.bert import gpt_small, gpt_tiny
+
+    # Always at the TARGET's vocab: proposals are target token ids.
+    draft = (gpt_tiny(seq_len=args.seq_len, vocab_size=model.output_dim)
+             if name == "gpt_tiny"
+             else gpt_small(seq_len=args.seq_len,
+                            vocab_size=model.output_dim))
+    return draft, draft.init(args.seed)
+
+
 def _make_engine(args, model, variables, metrics=None, trace_store=None,
                  slots=None):
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
     paged = args.paged or args.kv_pool_mb > 0
+    draft_model, draft_variables = _draft(args, model, variables)
+    auditor = None
+    if draft_model is not None:
+        # Speculative runs arm the auditor: the acceptance bar is not
+        # just ">2x" but ">2x while draft, verify, and fallback decode
+        # each stay at ONE executable" — a retrace raises mid-run
+        # instead of silently eating the win.
+        from distkeras_tpu.telemetry import RecompileAuditor
+
+        auditor = RecompileAuditor()
     return ServingEngine(
         model, variables, slots=slots or args.slots,
         max_queue=args.max_queue,
@@ -103,6 +153,9 @@ def _make_engine(args, model, variables, metrics=None, trace_store=None,
         kv_pool_mb=args.kv_pool_mb or (8.0 if paged else 0.0),
         kv_block_tokens=args.kv_block,
         max_context=args.max_context,
+        draft_model=draft_model, draft_variables=draft_variables,
+        spec_k=args.spec_k,
+        auditor=auditor, arm_auditor_after_warmup=auditor is not None,
         trace_store=trace_store,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
 
@@ -470,9 +523,13 @@ async def _run_slot_sweep(args, model, variables, report):
 
 
 # Headline metrics worth a drift gate, per mode section of the report.
+# ``spec_accept_rate`` (speculative runs only) is higher-is-better like
+# the throughput rows — the regression checker's direction heuristic
+# keys off the latency-shaped name prefixes, which it does not match.
 _HISTORY_METRICS = (
     "ttft_p50_s", "ttft_p99_s", "inter_token_p50_s", "inter_token_p99_s",
     "prefill_device_p50_s", "goodput_tokens_per_sec", "prefix_hit_rate",
+    "spec_accept_rate",
 )
 
 # Sweep-level rows: concurrency-at-fixed-bytes and tokens-per-byte (both
@@ -502,7 +559,16 @@ def _record_history(args, report):
     hist = bench.load_history(path)
     paged = args.paged or args.kv_pool_mb > 0
     model_tag = f"paged_{args.model}" if paged else args.model
+    if _speculating(args):
+        # serving/spec_* rows: accept rate, goodput, ITL percentiles of
+        # speculative runs diff against their own prior — never against
+        # the one-token baseline series.
+        model_tag = f"spec_{model_tag}"
     base = f"serving/{model_tag}/slots{args.slots}"
+    if _speculating(args):
+        base += f"/k{args.spec_k}"
+        if args.draft_model and args.draft_model != args.model:
+            base += f"/draft_{args.draft_model}"
     if paged:
         base += (f"/pool{args.kv_pool_mb or 8:g}mb"
                  f"/block{args.kv_block}")
@@ -517,9 +583,21 @@ def _record_history(args, report):
         sec = report.get(mode)
         if not isinstance(sec, dict):
             continue
+        from scripts.check_bench_regression import lower_is_better
+
         for metric in _HISTORY_METRICS:
             v = sec.get(metric)
             if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if v <= 0 and lower_is_better(metric):
+                # A zero LATENCY headline (speculative ITL p50 is
+                # exactly 0.0 — tokens of one tick share a timestamp)
+                # is degenerate and can never serve as a prior for the
+                # drift gate (check_bench_regression skips zero
+                # priors), so recording it would only LOOK gated. A
+                # zero throughput/accept-rate value is the opposite: a
+                # collapse the gate MUST see against its positive
+                # prior — never drop those.
                 continue
             key = f"{base}/{mode}/{metric}"
             hist[key] = bench.history_entry(hist.get(key), float(v), when)
@@ -580,6 +658,20 @@ def main():
                          "the pre-reserved per-slot cache length — the "
                          "knob that fixes the dense side of a "
                          "slots-at-fixed-bytes comparison")
+    ap.add_argument("--speculate", action="store_true",
+                    help="speculative decoding: a draft model proposes "
+                         "--spec-k tokens per tick, ONE batched target "
+                         "call verifies. Default draft is the target "
+                         "itself (the sanity config: ~100%% acceptance, "
+                         "speedup = pure dispatch amortization); the "
+                         "armed auditor asserts draft/verify/fallback "
+                         "each compile exactly once")
+    ap.add_argument("--draft-model", default=None,
+                    choices=["gpt_tiny", "gpt_small"],
+                    help="draft model (implies --speculate; default: "
+                         "same as --model)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per speculative tick")
     ap.add_argument("--slot-sweep", default=None, metavar="N1,N2,...",
                     help="max-concurrent-slots-at-fixed-bytes sweep: "
                          "re-run the closed-loop phase at each slot "
@@ -637,6 +729,10 @@ def main():
         "kv_block": args.kv_block,
         "max_context": args.max_context,
         "replicas": args.replicas,
+        "speculate": _speculating(args),
+        "draft_model": (args.draft_model or args.model
+                        if _speculating(args) else None),
+        "spec_k": args.spec_k if _speculating(args) else 0,
     }}
 
     if args.replicas >= 2:
@@ -711,7 +807,8 @@ def main():
                    for k, v in summary.items()
                    if k.startswith(("ttft", "inter_token", "queue", "slot",
                                     "tokens_per_sec", "requests",
-                                    "prefill", "prefix", "slo", "kv_"))},
+                                    "prefill", "prefix", "slo", "kv_",
+                                    "spec_"))},
             }
             engine.reopen()
         return all_results
@@ -735,6 +832,19 @@ def main():
         assert compiles in (1, -1), (
             f"continuous batching retraced the decode step: {compiles} "
             "compiled executables (expected exactly 1)")
+        if engine.auditor is not None:
+            # Speculative run: the armed auditor stayed silent (or we
+            # would not be here) — record and assert the per-callable
+            # counts: draft, verify, AND the fallback decode each
+            # compiled exactly once across the whole run.
+            spec_compiles = {
+                name: engine.auditor.compiles(name)
+                for name in ("serving_decode", "serving_draft",
+                             "serving_verify")}
+            report["spec_compiles"] = spec_compiles
+            assert all(c == 1 for c in spec_compiles.values()), (
+                f"speculation broke the compile-once contract: "
+                f"{spec_compiles}")
         if not args.skip_parity:
             mism = _check_parity(model, variables, all_results,
                                  args.new_tokens)

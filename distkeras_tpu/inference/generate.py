@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["generate", "beam_search", "Generator", "cache_with_index"]
+__all__ = ["generate", "beam_search", "Generator", "accept_prefix_length",
+           "cache_with_index", "greedy_accept_length", "greedy_ids"]
 
 
 def _decode_module(model, slots: bool = False, **overrides):
@@ -136,20 +137,62 @@ def cache_with_index(cache, index):
         lambda a: jnp.full_like(a, index) if a.ndim == 1 else a, cache)
 
 
+def greedy_ids(logits):
+    """THE greedy token selection, shared by every decode path: argmax
+    over the logits quantized to bfloat16 (the model's compute dtype),
+    lowest index winning ties.
+
+    Why quantize: the float32 logits are accumulations of bfloat16
+    products, so their sub-bf16-ULP structure is reduction-order noise —
+    and different (all individually correct) lowerings of the same
+    forward REORDER those reductions: a one-token decode step, a
+    multi-token prefill/verify window, and a batched row of either can
+    disagree by ~1 ULP of f32. On a near-tie that flips the raw argmax,
+    which would let a speculative verify window "disagree" with the
+    sequential decode it is provably equivalent to over the reals.
+    Quantizing to the compute dtype before argmax makes greedy selection
+    invariant to sub-bf16 noise, so every lowering picks the same token
+    (ties resolve to the lowest id in all of them)."""
+    return jnp.argmax(logits.astype(jnp.bfloat16), axis=-1).astype(jnp.int32)
+
+
 def sample_rows(logits, temps, key, top_k):
     """Per-row sampling over ``[B, V]`` logits: rows with ``temps <= 0``
-    take argmax (greedy), the rest sample at their own temperature with
-    optional top-k filtering. The ONE sampling implementation — shared by
-    :func:`generate` and the serving engine's per-slot decode step so the
-    two inference paths stay provably token-identical."""
+    take argmax (greedy, at bf16 resolution — :func:`greedy_ids`), the
+    rest sample at their own temperature with optional top-k filtering.
+    The ONE sampling implementation — shared by :func:`generate` and the
+    serving engine's per-slot decode step so the two inference paths
+    stay provably token-identical."""
     logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy = greedy_ids(logits)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
     if top_k is not None:
         kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temps > 0, sampled, greedy)
+
+
+def accept_prefix_length(match):
+    """Length of each row's all-True prefix: ``match`` is bool
+    ``[B, K]`` per-position accept verdicts; returns int32 ``[B]`` in
+    ``[0, K]`` — acceptance stops at the FIRST False. The speculative-
+    decoding commit rule's core: only a *prefix* of the drafts may
+    commit, because draft ``j+1`` was generated conditioned on draft
+    ``j`` being part of the sequence."""
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+
+
+def greedy_accept_length(drafts, target_greedy):
+    """Longest greedy-consistent prefix length per row: the strict
+    token-equality form of the speculative accept rule (``drafts`` and
+    ``target_greedy`` int32 ``[B, K]``). The serving engine's verify
+    uses the ε-relaxed logit-gap form instead (see
+    ``engine._spec_accept`` for why exact equality is too brittle
+    across lowering widths); this exact form remains the reference
+    semantics and the right tool when both sides come from the same
+    lowering."""
+    return accept_prefix_length(drafts == target_greedy)
 
 
 @functools.partial(
@@ -166,7 +209,7 @@ def _generate_jit(module, params, prompt, rng, max_new_tokens, temperature,
         if greedy:
             # Static greedy skips the categorical entirely (no dead
             # sampling branch in the compiled program).
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return greedy_ids(logits)
         temps = jnp.broadcast_to(temperature, logits.shape[:1])
         return sample_rows(logits, temps, key, top_k)
 

@@ -103,6 +103,21 @@ class BertConfig:
     page_table_blocks: int = 0
 
 
+def _pos_window(pos_embed, starts, S: int, max_seq_len: int):
+    """Per-row positional-embedding window ``[B, S, H]``: row ``b`` gets
+    the embeddings for positions ``starts[b] .. starts[b] + S - 1``,
+    each position clipped to the table INDEPENDENTLY. A windowed
+    ``dynamic_slice`` would instead clamp the whole window's start
+    backward near the table end, assigning position ``starts[b]`` — a
+    position whose output IS committed — a wrong embedding. With
+    per-position clipping only the overhanging tail positions (past the
+    trained context) read a clamped row, and those are exactly the
+    speculative-verify overshoot positions whose output is rejected or
+    rolled back, never committed."""
+    pos_ids = starts[:, None] + jnp.arange(S, dtype=starts.dtype)[None, :]
+    return pos_embed[0][jnp.clip(pos_ids, 0, max_seq_len - 1)]
+
+
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
     return nn.Dense(
         features,
@@ -371,16 +386,10 @@ class Bert(nn.Module):
             if self.is_initializing():
                 pos = pos_embed[:, :S]
             else:
-                import jax
-                import jax.lax as lax
-
                 if positions is None:
                     raise ValueError("paged decode needs positions [B]")
-                pos = jax.vmap(
-                    lambda i: lax.dynamic_slice(
-                        pos_embed[0], (i, 0), (S, cfg.hidden_size)
-                    )
-                )(positions)  # [B, S, H]
+                pos = _pos_window(pos_embed, positions, S,
+                                  cfg.max_seq_len)  # [B, S, H]
             x = embed(token_ids) + pos.astype(cfg.dtype)
         elif cfg.decode:
             # Positions advance with the KV caches: a cache-collection
@@ -395,15 +404,11 @@ class Bert(nn.Module):
             if self.is_initializing():
                 pos = pos_embed[:, :S]
             else:
-                import jax
                 import jax.lax as lax
 
                 if cfg.decode_slots:
-                    pos = jax.vmap(
-                        lambda i: lax.dynamic_slice(
-                            pos_embed[0], (i, 0), (S, cfg.hidden_size)
-                        )
-                    )(pi.value)  # [B, S, H]
+                    pos = _pos_window(pos_embed, pi.value, S,
+                                      cfg.max_seq_len)  # [B, S, H]
                     pi.value = jnp.minimum(pi.value + S, cfg.max_seq_len)
                 else:
                     pos = lax.dynamic_slice(

@@ -87,6 +87,15 @@ def paged_kv_update(pool, new, tables, positions, page_tokens: int):
     row can never scribble on a block it does not own — the paged
     equivalent of the dense cache's "garbage stays in your own row"
     discipline.
+
+    Multi-token windows (``S > 1``) serve chunked prefill AND the
+    speculative verify step: a ``K``-token draft window scatters all
+    its K/V in one call, rejected-draft positions are "rolled back" by
+    the host simply not advancing ``positions`` past the accepted
+    prefix (the next write overwrites them), and draft positions
+    overhanging the row's allocated blocks drop — which is why the
+    engine clamps the per-row commit length to the allocated span
+    rather than requiring lookahead blocks to exist.
     """
     C = pool.shape[0]
     T = tables.shape[1]
@@ -117,6 +126,13 @@ def paged_attention(q, pool_k, pool_v, tables, positions):
     any masked-out tail the softmax contributions are exactly zero and
     the output is bitwise identical to dense attention over the same
     resident K/V. One compiled program for every table layout.
+
+    With an ``S > 1`` query window (speculative verify), query ``j``
+    attends to positions ``<= positions[b] + j`` — including the
+    window's own earlier K/V written by :func:`paged_kv_update` in the
+    same apply — which makes the logits at each window offset identical
+    to what one-token-at-a-time decode would have produced given the
+    same prefix, the property speculative acceptance depends on.
     """
     B, S = q.shape[0], q.shape[1]
     bt = pool_k.shape[1]

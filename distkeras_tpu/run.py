@@ -117,6 +117,27 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "length; in dense mode also shrinks the "
                          "pre-reserved per-slot KV cache to this many "
                          "positions")
+    ap.add_argument("--draft-model", default=None,
+                    help="speculative decoding: a small causal LM from "
+                         "the zoo drafts --spec-k tokens per tick and "
+                         "ONE batched target call verifies them — "
+                         "greedy output stays token-identical (exactly "
+                         "with draft==target; a different draft can "
+                         "differ only where the target scores two "
+                         "tokens as numerically tied at its own "
+                         "compute precision — see docs/serving.md "
+                         "'Speculative decoding') while decode "
+                         "throughput rises with the accept rate. Same "
+                         "model+args as --model shares the target's "
+                         "weights (the accept-rate sanity config); "
+                         "otherwise the draft runs its own seed-init "
+                         "weights unless --draft-weights")
+    ap.add_argument("--draft-args", default="{}",
+                    help="JSON kwargs for the draft model fn")
+    ap.add_argument("--draft-weights", default=None,
+                    help="serialized-pytree weights for the draft model")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per speculative tick")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=default_replicas,
                     help="> 1: start this many replica processes behind a "
@@ -234,6 +255,37 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     # --paged with no explicit budget gets a sane default pool; an
     # explicit --kv-pool-mb implies --paged.
     kv_pool_mb = args.kv_pool_mb or (64.0 if args.paged else 0.0)
+    draft_model = draft_variables = None
+    if args.draft_model:
+        draft_kwargs = json.loads(args.draft_args)
+        if "vocab_size" not in draft_kwargs:
+            # Draft proposals are TARGET token ids, so the draft must
+            # share the target's vocab — default it so the documented
+            # zoo pairing (`--model gpt_small --draft-model gpt_tiny`)
+            # works without hand-passing 50257 through --draft-args.
+            try:
+                draft_model = load_model(
+                    args.draft_model,
+                    {**draft_kwargs, "vocab_size": model.output_dim})
+            except TypeError:  # model fn without a vocab_size kwarg
+                draft_model = None
+        if draft_model is None:
+            draft_model = load_model(args.draft_model, draft_kwargs)
+        if (args.draft_model == args.model
+                and json.loads(args.draft_args) == json.loads(
+                    args.model_args)
+                and not args.draft_weights):
+            # Identical spec with no weights of its own: the draft IS
+            # the target (the draft==target sanity config — acceptance
+            # ~100%, the speedup is pure dispatch amortization).
+            draft_variables = variables
+        else:
+            draft_variables = draft_model.init(args.seed)
+            if args.draft_weights:
+                from distkeras_tpu.checkpoint import load_weights_file
+
+                draft_variables = load_weights_file(
+                    args.draft_weights, like=draft_variables)
     engine = ServingEngine(
         model, variables, slots=args.slots, max_queue=args.max_queue,
         top_k=args.top_k, metrics=metrics, seed=args.seed,
@@ -245,6 +297,8 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         kv_pool_mb=kv_pool_mb,
         kv_block_tokens=args.kv_block_tokens,
         max_context=args.max_context,
+        draft_model=draft_model, draft_variables=draft_variables,
+        spec_k=args.spec_k,
         trace_store=trace_store, flight_recorder=recorder,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None,
         weight_version=weight_version)
@@ -262,6 +316,8 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
             "kv_pool_mb": kv_pool_mb,
             "kv_pool_blocks": (engine.kv_pool.capacity
                                if engine.kv_pool is not None else 0),
+            "draft_model": args.draft_model,
+            "spec_k": args.spec_k if args.draft_model else 0,
         }), flush=True)
         # Signal-driven shutdown INSIDE the loop: a raw KeyboardInterrupt
         # out of asyncio.run would cancel the engine task before the
@@ -316,6 +372,38 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     return 0
 
 
+def _serving_config_flags(args) -> list[str]:
+    """Serving-engine configuration flags a parent process forwards to
+    every replica child — ONE builder shared by ``cluster`` and
+    ``deploy``, so the whole fleet (and, in deploy's case, the canary
+    replica, which is a drained member of that same fleet) runs the
+    configuration the operator asked for. Before deploy used this, its
+    canary always validated candidates on the dense one-token default —
+    a paged or speculative production config shipped unvetted."""
+    extra = [
+        "--prefix-cache-mb", str(args.prefix_cache_mb),
+        "--prefix-block", str(args.prefix_block),
+    ]
+    if args.top_k is not None:
+        extra += ["--top-k", str(args.top_k)]
+    if args.prefill_chunk is not None:
+        extra += ["--prefill-chunk", str(args.prefill_chunk)]
+    if args.paged or args.kv_pool_mb:
+        if args.paged:
+            extra += ["--paged"]
+        extra += ["--kv-pool-mb", str(args.kv_pool_mb),
+                  "--kv-block-tokens", str(args.kv_block_tokens)]
+    if args.max_context is not None:
+        extra += ["--max-context", str(args.max_context)]
+    if args.draft_model:
+        extra += ["--draft-model", args.draft_model,
+                  "--draft-args", args.draft_args,
+                  "--spec-k", str(args.spec_k)]
+        if args.draft_weights:
+            extra += ["--draft-weights", args.draft_weights]
+    return extra
+
+
 def cluster_main(args) -> int:
     """Multi-replica serving: N child processes (each a full ``serve``
     on an ephemeral port) behind a supervised router on ``--port``.
@@ -347,8 +435,7 @@ def cluster_main(args) -> int:
             "--slots", str(args.slots),
             "--max-queue", str(args.max_queue),
             "--seed", str(args.seed),
-            "--prefix-cache-mb", str(args.prefix_cache_mb),
-            "--prefix-block", str(args.prefix_block),
+            *_serving_config_flags(args),
             "--request-trace",
             str(512 if args.request_trace is None else args.request_trace),
             "--flight-recorder",
@@ -357,17 +444,6 @@ def cluster_main(args) -> int:
         ]
         if args.weights:
             extra += ["--weights", args.weights]
-        if args.top_k is not None:
-            extra += ["--top-k", str(args.top_k)]
-        if args.prefill_chunk is not None:
-            extra += ["--prefill-chunk", str(args.prefill_chunk)]
-        if args.paged or args.kv_pool_mb:
-            if args.paged:
-                extra += ["--paged"]
-            extra += ["--kv-pool-mb", str(args.kv_pool_mb),
-                      "--kv-block-tokens", str(args.kv_block_tokens)]
-        if args.max_context is not None:
-            extra += ["--max-context", str(args.max_context)]
         if args.audit_recompiles:
             extra += ["--audit-recompiles", args.audit_recompiles]
         if args.slo_ms is not None:
@@ -477,6 +553,31 @@ def deploy_main(argv=None) -> int:
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--max-queue", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
+    # The fleet's REAL serving configuration, forwarded to every
+    # replica (the canary is a drained member of this same fleet, so a
+    # candidate is validated under the configuration production
+    # actually runs — paged KV, chunked prefill, speculation and all —
+    # not the dense one-token default).
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="replica chunked-prefill size (tokens)")
+    ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="replica prefix-cache byte budget (MB)")
+    ap.add_argument("--prefix-block", type=int, default=16,
+                    help="prefix-cache block granularity (tokens)")
+    ap.add_argument("--paged", action="store_true",
+                    help="replicas serve with paged KV")
+    ap.add_argument("--kv-pool-mb", type=float, default=0.0,
+                    help="replica paged-KV pool budget (MB); > 0 "
+                         "implies --paged")
+    ap.add_argument("--kv-block-tokens", type=int, default=16)
+    ap.add_argument("--max-context", type=int, default=None)
+    ap.add_argument("--draft-model", default=None,
+                    help="replicas serve with speculative decoding "
+                         "(this zoo model drafts --spec-k tokens/tick)")
+    ap.add_argument("--draft-args", default="{}")
+    ap.add_argument("--draft-weights", default=None)
+    ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--golden", type=int, default=4,
                     help="golden prompt count the canary replica must "
                          "serve (twice each, identical greedy output, "
@@ -539,6 +640,7 @@ def deploy_main(argv=None) -> int:
             "--slots", str(args.slots),
             "--max-queue", str(args.max_queue),
             "--seed", str(args.seed),
+            *_serving_config_flags(args),
             "--request-trace", "512",
             "--flight-recorder", "256",
         ]

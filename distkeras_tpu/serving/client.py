@@ -106,6 +106,7 @@ class ServingClient:
         priority: int = 0,
         timeout: float | None = None,
         trace_id: str | None = None,
+        speculate: bool = True,
     ) -> AsyncIterator[int]:
         """Yield token ids as the server streams them; raises the typed
         :class:`ServingError` subclass matching the server's error code.
@@ -127,6 +128,7 @@ class ServingClient:
             "priority": int(priority),
             "timeout": timeout,
             "trace_id": self.last_trace_id,
+            "speculate": bool(speculate),
         }
         self._writer.write((json.dumps(spec) + "\n").encode())
         await self._writer.drain()

@@ -48,6 +48,15 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
                  + (" SWAP-PENDING" if dz.get("pending_swap") else ""))
     if dz.get("slo_s") is not None:
         lines.append(f"{indent}slo={dz['slo_s']}s")
+    sp = dz.get("speculative")
+    if sp:
+        rate = sp.get("accept_rate")
+        lines.append(
+            f"{indent}speculative: draft={sp.get('draft_model')} "
+            f"k={sp.get('spec_k')} "
+            f"accepted={sp.get('accepted_tokens')}/"
+            f"{sp.get('draft_tokens')} drafts"
+            + (f" (rate {rate})" if rate is not None else ""))
     wv = dz.get("weight_version")
     if isinstance(wv, dict):
         lines.append(f"{indent}weights: v{wv.get('version')} "
@@ -63,6 +72,11 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
             # Paged engine: per-slot block-table depth (total blocks the
             # slot addresses / how many are shared prefix blocks).
             cols += [("blocks", "blocks"), ("shared", "shared_blocks")]
+        if any("accept_rate" in s for s in slots):
+            # Speculating engine: this request's committed-draft ratio —
+            # the column that answers "which stream is the draft model
+            # failing to predict" when the fleet accept rate sags.
+            cols += [("accept", "accept_rate")]
         for ln in _table(slots, cols):
             lines.append(f"{indent}  {ln}")
     queued = q.get("queued", [])

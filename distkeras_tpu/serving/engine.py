@@ -56,6 +56,27 @@ prefix cache (:class:`~distkeras_tpu.serving.prefix_cache.KVBlockPool`):
   round trip. Requests whose full context can never fit are rejected
   with the typed ``kv_oom`` error at submit.
 
+**Speculative decoding** (``draft_model``/``spec_k``) breaks the
+one-full-model-dispatch-per-token latency floor: a small draft model
+proposes K tokens per tick in ONE scanned dispatch, ONE batched target
+call scores all K window positions per slot, and a masked accept
+commits the longest verify-consistent DRAFT prefix — up to K tokens per
+greedy row per tick, while ``temperature > 0`` rows ride the same tick
+committing one sampled token from the verify's position-0 logits.
+Committed values are always draft tokens (one-token-apply-computed,
+the same lowering shape as ``generate()``'s scan), never tokens read
+off the wide window's logits — the property that makes speculation
+token-identical to ``generate()`` instead of almost-identical (see
+``_spec_accept``). A row that accepts zero drafts is owed a one-token
+fallback tick, so progress is unconditional. Everything is
+shape-stable in K: rejected drafts roll back by rewinding the per-row
+cache index leaves (dense) or by simply not advancing the host
+``_lens`` watermark (paged, where the OOB-drop scatter already
+guarantees an unallocated overhang cannot scribble) — so draft,
+verify, and the one-token fallback each stay at exactly one compiled
+executable under the armed ``RecompileAuditor``, variable acceptance
+lengths and all.
+
 Per-request sampling: ``temperature <= 0`` rows take the argmax branch
 inside the same compiled step (a ``jnp.where`` select, not a retrace), so
 greedy and sampled requests coexist in one batch. ``top_k`` is
@@ -81,7 +102,9 @@ from distkeras_tpu.inference.generate import (
     _context_limit,
     _decode_module,
     _empty_cache,
+    accept_prefix_length,
     cache_with_index,
+    greedy_ids,
     sample_rows,
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
@@ -210,6 +233,214 @@ def _paged_decode_fn(module, top_k, params, pools, tokens, temps, positions,
     return mut["cache"], nxt
 
 
+def _spec_draft_fn(module, K, params, cache, prev, tokens, start):
+    """Fixed-K greedy draft scan: ONE dispatch proposes K tokens per row.
+
+    ``start`` is the per-row fed-token count (int32 ``[B]``, the target's
+    truth); setting the index leaves on entry is the draft-cache
+    rollback — rejected draft K/V from the previous tick is simply
+    overwritten as the new chain is fed, so no separate rewind pass
+    exists. K is static (one compiled program per engine); the scan
+    keeps the whole proposal at one device dispatch.
+
+    The pass begins one position EARLY: a heal apply re-feeds ``prev``
+    (the token at position ``start - 1``) before the K-step scan feeds
+    ``tokens`` onward. Normally that rewrites K/V the draft already
+    holds with the same values — but when the previous tick was a
+    one-token FALLBACK (zero-accept row or speculate=False row in the
+    batch), the target advanced past a position the draft never fed,
+    and without the heal that hole would sit behind every later scan's
+    attention forever, silently degrading the accept rate (measured:
+    1.0 → ~0.79 in mixed traffic with draft == target). The run loop
+    interleaves at most one fallback tick between spec ticks while an
+    eligible row exists, so one healed position is always enough.
+
+    Every step is a one-token apply — the SAME lowering shape as the
+    offline ``generate()`` scan and the engine's fallback decode step.
+    That is a correctness property, not an implementation detail: the
+    engine commits DRAFT tokens (never tokens read off the wide verify
+    window's logits), so with draft == target every committed token is
+    bit-for-bit the sequential chain. Different-width lowerings of a
+    bfloat16 trunk reorder its internal roundings and can flip argmax
+    on near-ties; keeping all committed values one-token-shaped is what
+    makes speculative output token-identical to ``generate()`` instead
+    of merely almost-always-identical."""
+    cache = cache_with_index(cache, jnp.maximum(start - 1, 0))
+    _, mut = module.apply(
+        {"params": params, "cache": cache}, prev[:, None], train=False,
+        mutable=["cache"],
+    )
+    # The heal apply advanced the index leaves back to ``start`` (for
+    # start == 0 rows the clamp makes it 1 — free/garbage rows only,
+    # whose cache is rebuilt at admission).
+    cache = cache_with_index(mut["cache"], start)
+
+    def step(carry, _):
+        cache, tok = carry
+        logits, mut = module.apply(
+            {"params": params, "cache": cache}, tok[:, None], train=False,
+            mutable=["cache"],
+        )
+        nxt = greedy_ids(logits[:, -1].astype(jnp.float32))
+        return (mut["cache"], nxt), nxt
+
+    (cache, _), drafts = lax.scan(step, (cache, tokens), None, length=K)
+    return cache, drafts.T  # [B, K]: d_1..d_K
+
+
+def _spec_accept(logits, drafts, tokens, temps, spec_ok, remaining, key,
+                 top_k):
+    """Shared accept epilogue of both verify twins: from the target's
+    ``[B, K, V]`` logits over the window ``[last_tok, d_1..d_{K-1}]``,
+    decide how many DRAFT tokens each row commits.
+
+    Two deliberate choices make this token-identical to ``generate()``
+    instead of almost-identical:
+
+    1. **Committed values are always the drafts themselves** — never
+       tokens read off the window's logits. Drafts come from one-token
+       applies (the same lowering shape as the sequential chain), while
+       a K-wide window reorders the trunk's bfloat16 roundings: its
+       argmax can flip on near-ties, so committing a window-derived
+       "bonus" token (the textbook formulation) measurably diverged
+       ~1/10^3 tokens on the random-init CI models.
+    2. **The gate is ε-greedy at the model's compute precision**: draft
+       ``d_{j+1}`` is accepted while its verify logit sits within ~2
+       bfloat16 ULPs of the window's max — NOT on exact argmax
+       equality, which the same cross-width noise spuriously breaks at
+       ties (and a spurious reject routes the token through a fallback
+       read of wide-written K/V, a coin toss at a tie site). Within the
+       ε band the candidates are numerically indistinguishable at the
+       precision the model itself computes in, so accepting the draft's
+       choice IS greedy decoding. With draft == target this makes the
+       committed chain bitwise the sequential chain, spurious-reject
+       free; a genuinely wrong draft sits far below the band and is
+       rejected as before. The relaxation's one caveat: a DIFFERENT
+       draft that proposes the runner-up of an ε-tied pair commits it
+       where sequential decode would pick the other member — output can
+       then differ from ``generate()`` exactly at (and only at)
+       positions the target itself scores as ties at its compute
+       precision.
+
+    A row that accepts zero drafts commits nothing this tick; the
+    engine interleaves a one-token fallback tick so it always
+    progresses. ``temperature > 0`` rows ride the same tick committing
+    exactly one token sampled (shared :func:`sample_rows`) from offset
+    0's logits — the distribution after ``last_tok``, i.e. what a plain
+    decode tick sampled from. Greedy rows that OPTED OUT of speculation
+    commit 0 here and are served by the fallback ticks their presence
+    forces — their strict-parity promise must not route through wide
+    logits. ``remaining`` clamps every row so a near-done request never
+    overshoots ``max_new_tokens``.
+
+    Returns ``(out, commit)``: ``out[b, :commit[b]]`` are row ``b``'s
+    committed stream tokens."""
+    logits = logits.astype(jnp.float32)
+    tok0 = sample_rows(logits[:, 0], temps, key, top_k)
+    eligible = spec_ok & (temps <= 0)
+    top = jnp.max(logits, axis=-1)  # [B, K]
+    drafted = jnp.take_along_axis(
+        logits, drafts[..., None], axis=-1)[..., 0]  # [B, K]
+    # ~2 bf16 ULPs of the max (floored for near-zero logits): far above
+    # cross-width reduction noise (~1e-4 here), far below any decided
+    # argmax gap.
+    eps = jnp.float32(2**-7) * jnp.maximum(jnp.abs(top), 1.0)
+    accepted = accept_prefix_length(drafted >= top - eps)
+    commit = jnp.where(
+        eligible, jnp.minimum(accepted, remaining),
+        jnp.where(temps > 0, jnp.minimum(1, remaining), 0))
+    out = jnp.concatenate(
+        [jnp.where(eligible, drafts[:, 0], tok0)[:, None], drafts[:, 1:]],
+        axis=1)
+    return out, commit
+
+
+def _spec_verify_fn(module, top_k, params, cache, tokens, drafts, temps,
+                    spec_ok, remaining, positions, key):
+    """Dense speculative verify: ONE target-model call scores all K
+    window positions (``[last_tok, d_1..d_{K-1}]``) per slot, a masked
+    accept commits the longest verify-consistent draft prefix, and the
+    rejected tail is rolled back by rewinding the per-row cache index
+    leaves (``cache_with_index`` with a per-row vector — the same
+    offset-rewind contract chunked prefill uses). Everything is
+    shape-stable in K, so variable acceptance lengths never retrace.
+
+    Returns ``(cache, new_tokens, out, commit)``: ``out[b, :commit[b]]``
+    are row ``b``'s committed stream tokens and ``new_tokens[b]`` its
+    next feed token (the last committed one; unchanged on a zero
+    commit, so re-running the tick is idempotent)."""
+    window = jnp.concatenate([tokens[:, None], drafts[:, :-1]], axis=1)
+    cache = cache_with_index(cache, positions)
+    logits, mut = module.apply(
+        {"params": params, "cache": cache}, window, train=False,
+        mutable=["cache"],
+    )
+    out, commit = _spec_accept(logits, drafts, tokens, temps, spec_ok,
+                               remaining, key, top_k)
+    # Rollback: fed tokens end at positions + commit; the garbage K/V at
+    # [positions + commit, positions + K) stays masked (k_pos <= q_pos)
+    # until real tokens overwrite it — prefill's right-pad rule.
+    cache = cache_with_index(mut["cache"], positions + commit)
+    new_tok = jnp.where(
+        commit > 0,
+        jnp.take_along_axis(
+            out, jnp.maximum(commit - 1, 0)[:, None], axis=1)[:, 0],
+        tokens)
+    return cache, new_tok, out, commit
+
+
+def _paged_spec_verify_fn(module, top_k, params, pools, tokens, drafts,
+                          temps, spec_ok, remaining, room, positions,
+                          tables, key):
+    """Paged twin of :func:`_spec_verify_fn`: the window's K/V scatters
+    through the block tables (writes past a row's allocated blocks are
+    dropped by ``paged_kv_update``), so ``room`` — the contiguous
+    allocated positions from each row's write offset, computed host-side
+    — additionally clamps the commit: a row whose lookahead blocks could
+    not be allocated under pool pressure commits fewer tokens instead of
+    committing tokens whose K/V was dropped. Rollback is the caller NOT
+    advancing ``_lens`` past the commit; no device state to rewind."""
+    window = jnp.concatenate([tokens[:, None], drafts[:, :-1]], axis=1)
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, window, train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+    )
+    out, commit = _spec_accept(logits, drafts, tokens, temps, spec_ok,
+                               remaining, key, top_k)
+    commit = jnp.minimum(commit, room)
+    new_tok = jnp.where(
+        commit > 0,
+        jnp.take_along_axis(
+            out, jnp.maximum(commit - 1, 0)[:, None], axis=1)[:, 0],
+        tokens)
+    return mut["cache"], new_tok, out, commit
+
+
+def _draft_prefill_fn(module, params, cache, padded, start, true_len):
+    """Draft twin of :func:`_prefill_fn` minus the sampling epilogue:
+    extend the draft's single-row cache with a right-padded prompt chunk
+    at offset ``start`` and rewind the index leaves to the true end. The
+    draft never samples — its proposals come from the decode-time scan —
+    so prefill only has to materialize the prompt's K/V."""
+    cache = cache_with_index(cache, start)
+    _, mut = module.apply(
+        {"params": params, "cache": cache}, padded, train=False,
+        mutable=["cache"],
+    )
+    return cache_with_index(mut["cache"], start + true_len)
+
+
+def _draft_admit_fn(cache, slot, pre_cache):
+    """Splice a prefilled single-row draft cache into batch row ``slot``
+    (the draft half of :func:`_admit_fn`; no sampling state to set)."""
+    return jax.tree.map(
+        lambda big, small: lax.dynamic_update_slice(
+            big, small.astype(big.dtype), (slot,) + (0,) * (small.ndim - 1)
+        ),
+        cache, pre_cache,
+    )
+
+
 @dataclasses.dataclass
 class _PrefillJob:
     """Partial-prefill progress for a slot still being admitted: the
@@ -255,6 +486,11 @@ class _SlotState:
     blocks: list = dataclasses.field(default_factory=list)
     first_block: int = 0
     match: object | None = None
+    # Speculative decoding: lifetime draft/accept counters for this
+    # slot's request (the debugz accept-rate column and per-request
+    # trace stamps).
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class ServingEngine:
@@ -301,6 +537,19 @@ class ServingEngine:
     knob that makes a fixed KV byte budget an explicit trade between
     slots and padded max length (the trade paged mode removes).
 
+    ``draft_model``/``draft_variables``/``spec_k``: speculative decoding
+    (see the module docstring). The draft must share the target's vocab
+    (proposals are target token ids) and keeps its own dense per-slot
+    cache whatever the target's paging; the zoo pairs gpt_tiny (draft)
+    with gpt_small (target). K trades draft work against acceptance:
+    each tick costs one scanned draft dispatch (a heal apply + K
+    proposal steps) + one K-wide verify and commits up to K tokens per
+    greedy row. A request can opt out per-call
+    (``submit(..., speculate=False)``); ``temperature > 0`` rows never
+    speculate. Rolling weight reloads swap the TARGET's params only —
+    the draft is engine-lifetime config, and a stale draft can only
+    lower the accept rate, never change committed output.
+
     Observability (all default-off; see :mod:`distkeras_tpu.telemetry`):
     ``trace_store`` keeps per-request timeline records queryable by
     trace_id (the ``tracez`` verb); ``flight_recorder`` keeps a bounded
@@ -338,6 +587,9 @@ class ServingEngine:
         kv_block_tokens: int = 16,
         kv_pool_blocks: int | None = None,
         max_context: int | None = None,
+        draft_model=None,
+        draft_variables=None,
+        spec_k: int = 4,
         trace_store: TraceStore | None = None,
         flight_recorder: FlightRecorder | None = None,
         slo_s: float | None = None,
@@ -349,6 +601,14 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
         self.model = model
+        self._spec = draft_model is not None
+        self.draft_model = draft_model
+        self.spec_k = int(spec_k)
+        if self._spec and self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if self._spec and draft_variables is None:
+            raise ValueError("draft_model needs draft_variables (the draft's "
+                             "trained weights)")
         self._paged = bool(paged or kv_pool_mb > 0 or kv_pool_blocks)
         # Geometry probe: the plain decode-slots config, for the trained
         # context limit and (paged) the per-token KV byte cost.
@@ -411,12 +671,27 @@ class ServingEngine:
             # the reads.
             self._sentinel = capacity
         else:
-            overrides = ({"decode_cache_len": int(max_context)}
-                         if max_context is not None else {})
+            dense_len = (int(max_context) if max_context is not None
+                         else base_cfg.max_seq_len)
+            overrides = {}
+            if max_context is not None or self._spec:
+                # Speculative headroom: a verify window writes K+1 K/V
+                # vectors starting at the row's fed count, which for a
+                # request using its whole context reaches past the
+                # request limit. Extending the CACHE (never the
+                # positional table — params stay layout-identical) by
+                # spec_k rows keeps those overhang writes from clamping
+                # backward over real prefix K/V; the overhang itself is
+                # rejected-draft garbage, rolled back by the index
+                # rewind and masked until overwritten.
+                overrides["decode_cache_len"] = dense_len + (
+                    self.spec_k if self._spec else 0)
             self._module, self._cfg = _decode_module(
                 model, slots=True, **overrides)
-            self._cache_len = (int(max_context) if max_context is not None
-                               else self._cfg.max_seq_len)
+            # Prefill pad-width bound: the REQUEST context, not the
+            # spec-extended cache — prefill programs stay identical to a
+            # non-speculating engine's.
+            self._cache_len = dense_len
         if top_k is not None and not 1 <= top_k <= self._cfg.vocab_size:
             # Same bound generate() enforces: out-of-range top_k would
             # silently disable (or invert) the filtering via clamped
@@ -470,12 +745,17 @@ class ServingEngine:
             # iteration would only burn host time and skew hit stats.
             self._parked_at_version: int | None = None
             self._parked_req: Request | None = None
-            # Device-side masked table cache: tables only change on
-            # admission/growth/preemption/teardown, so the per-tick
-            # upload is skipped while the masked view is byte-identical
-            # to the last tick's (positions still upload every tick —
-            # they advance with each decoded token).
-            self._tables_host: np.ndarray | None = None
+            # Device-side masked table cache with a DIRTY flag: the
+            # masked view only changes when a table row mutates
+            # (admission reserve / growth / preemption / teardown) or a
+            # slot's decodable status flips (prefill completion) — each
+            # of those sites sets the flag, and the per-tick upload is
+            # skipped while it is clear. The flag replaces an
+            # O(slots × blocks) np.array_equal compare that ran on
+            # EVERY tick just to conclude "unchanged" bt-1 times out of
+            # bt. (Positions still upload every tick — they advance
+            # with each decoded token.)
+            self._tables_dirty = True
             self._tables_dev = None
             self.prefix_cache = None
             self.scheduler.cache_probe = self.kv_pool.probe
@@ -516,6 +796,43 @@ class ServingEngine:
                 # whose prefix is already resident — see Scheduler.pop.
                 self.scheduler.cache_probe = self.prefix_cache.probe
 
+        # Speculative decoding: a small draft model proposes spec_k
+        # tokens per tick (one scanned dispatch), ONE batched target
+        # call verifies all K+1 positions, and a masked accept commits
+        # the longest greedy-consistent prefix — token-identical to
+        # generate() by construction. The draft keeps its own DENSE
+        # per-slot cache regardless of the target's paging (it is small
+        # by definition — gpt_tiny drafting for gpt_small — so paying
+        # worst-case length for it is noise next to the target pool).
+        if self._spec:
+            self._draft_module, self._draft_cfg = _decode_module(
+                draft_model, slots=True,
+                decode_cache_len=self.limit + self.spec_k)
+            if self._draft_cfg.vocab_size != self._cfg.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {self._draft_cfg.vocab_size} != "
+                    f"target vocab {self._cfg.vocab_size}: draft proposals "
+                    "must be target token ids")
+            self._draft_params = jax.device_put(draft_variables["params"])
+            self._draft_cache = _empty_cache(self._draft_module, self.slots)
+            self._draft_row_shapes = jax.eval_shape(
+                lambda r: self._draft_module.init(
+                    r, jnp.zeros((1, 1), jnp.int32), train=False),
+                jax.random.PRNGKey(0),
+            )["cache"]
+            self._fresh_draft_row = jax.jit(lambda: jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                self._draft_row_shapes))
+            # Host-side fed-token counts (int32 [slots], DENSE mode):
+            # the per-row position the draft's entry rewind and the
+            # dense verify's index rewind both derive from. Paged mode
+            # already tracks the same quantity as ``_lens`` and uses
+            # that instead.
+            self._spec_pos = np.zeros((self.slots,), np.int32)
+            # Set when a live row accepted zero drafts: the next tick
+            # runs the one-token fallback step (progress guarantee).
+            self._spec_owe_fallback = False
+
         # One jit wrapper per engine so compile counts are per-instance:
         # the decode step must stay at exactly one executable for the
         # server's lifetime (see decode_compile_count()). The live batch
@@ -543,6 +860,24 @@ class ServingEngine:
             self._decode_step = jax.jit(
                 functools.partial(_decode_fn, self._module, top_k),
                 donate_argnums=(1, 2))
+        if self._spec:
+            # Draft cache donated; tokens are NOT (the verify consumes
+            # them right after). Verify donates cache + tokens exactly
+            # like the fallback decode step it substitutes for.
+            self._draft_step = jax.jit(
+                functools.partial(_spec_draft_fn, self._draft_module,
+                                  self.spec_k),
+                donate_argnums=(1,))
+            verify = (_paged_spec_verify_fn if self._paged
+                      else _spec_verify_fn)
+            self._verify_step = jax.jit(
+                functools.partial(verify, self._module, top_k),
+                donate_argnums=(1, 2))
+            self._draft_prefill = jax.jit(
+                functools.partial(_draft_prefill_fn, self._draft_module),
+                donate_argnums=(1,))
+            self._draft_admit = jax.jit(_draft_admit_fn,
+                                        donate_argnums=(0,))
 
         # Recompile auditing: the compile-count==1 decode invariant as a
         # RUNTIME check, not just a benchmark assertion. The auditor wraps
@@ -557,6 +892,15 @@ class ServingEngine:
             self._admit_jit = auditor.wrap(self._admit_jit, "serving_admit")
             self._decode_step = auditor.wrap(
                 self._decode_step, "serving_decode")
+            if self._spec:
+                self._draft_step = auditor.wrap(
+                    self._draft_step, "serving_draft")
+                self._verify_step = auditor.wrap(
+                    self._verify_step, "serving_verify")
+                self._draft_prefill = auditor.wrap(
+                    self._draft_prefill, "serving_draft_prefill")
+                self._draft_admit = auditor.wrap(
+                    self._draft_admit, "serving_draft_admit")
 
         # Request tracing + flight recording. Timelines are built only
         # when at least one sink exists — with both off the per-request
@@ -597,6 +941,17 @@ class ServingEngine:
         # request_param_swap(), consumed by the run loop at the first
         # iteration with no slot in flight.
         self._pending_swap: tuple | None = None
+
+        if self._spec:
+            # Warm ALL THREE spec-mode executables (fallback decode,
+            # draft scan, verify) on the pristine all-free batch NOW:
+            # the run loop arms the auditor after the first real tick,
+            # and which path that tick takes depends on traffic — a
+            # lazily-compiled fallback (or verify) would then count as a
+            # post-arm retrace. Garbage-in, garbage-out is safe here for
+            # the same reason free rows may decode garbage every tick.
+            self._decode_sync()
+            self._spec_sync()
 
     # -- introspection ------------------------------------------------------
     def decode_compile_count(self) -> int:
@@ -681,6 +1036,12 @@ class ServingEngine:
                 # fixed [L] rows could never show.
                 entry["blocks"] = st.first_block + len(st.blocks)
                 entry["shared_blocks"] = st.first_block
+            if self._spec and st.spec_drafted:
+                # Accept-rate column: this request's committed drafts
+                # over its proposed drafts — the per-slot view of how
+                # well the draft model is predicting THIS stream.
+                entry["accept_rate"] = round(
+                    st.spec_accepted / st.spec_drafted, 3)
             if st.prefill is not None:
                 entry["prefill"] = {
                     "pos": st.prefill.pos,
@@ -697,6 +1058,18 @@ class ServingEngine:
             "decode_compile_count": self.decode_compile_count(),
             "weight_version": self.weight_version,
         }
+        if self._spec:
+            drafted = self.metrics.spec_draft_tokens
+            out["speculative"] = {
+                "spec_k": self.spec_k,
+                "draft_model": getattr(self.draft_model, "name",
+                                       str(self.draft_model)),
+                "draft_tokens": drafted,
+                "accepted_tokens": self.metrics.spec_accepted_tokens,
+                "accept_rate": (round(
+                    self.metrics.spec_accepted_tokens / drafted, 4)
+                    if drafted else None),
+            }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.debugz()
         if self.kv_pool is not None:
@@ -724,6 +1097,7 @@ class ServingEngine:
         priority: int = 0,
         timeout: float | None = None,
         trace_id: str | None = None,
+        speculate: bool = True,
     ) -> Request:
         """Validate and enqueue a request; returns the streaming handle.
 
@@ -764,6 +1138,7 @@ class ServingEngine:
         req = Request(
             prompt_arr.tolist(), max_new_tokens, temperature=temperature,
             priority=priority, timeout=timeout, trace_id=trace_id,
+            speculate=speculate,
         )
         if self._trace_requests:
             req.trace = TimelineRecord(req.trace_id, "engine",
@@ -1133,17 +1508,59 @@ class ServingEngine:
                 # 6. One decode iteration for the whole batch — skipped
                 # while EVERY active slot is still mid-prefill (the whole
                 # tick's output would be discarded; the chunk in 4b was
-                # this iteration's useful device work).
-                if any(st is not None and st.prefill is None
-                       for st in self._slot_state):
-                    with span("decode_tick", active=self.active_slots):
-                        nxt = await self._in_executor(loop, self._decode_sync)
+                # this iteration's useful device work). With a draft
+                # model, the tick is SPECULATIVE whenever any live row is
+                # eligible (greedy + not opted out): draft K, verify
+                # once, commit per-row accept prefixes — sampled rows in
+                # the same batch commit their usual one token from the
+                # verify's position-0 logits. All-sampled batches (and
+                # the swap rewarm) take the one-token fallback step.
+                decodable = self._decodable()
+                if decodable:
+                    # A zero-accept row (every draft rejected last spec
+                    # tick) committed nothing; one interleaved fallback
+                    # tick guarantees it a token before speculation
+                    # resumes — re-speculating immediately would redraft
+                    # the same rejected proposal forever.
+                    spec_tick = (self._spec
+                                 and not self._spec_owe_fallback
+                                 and any(
+                                     self._slot_state[i].request.temperature
+                                     <= 0
+                                     and self._slot_state[i].request.speculate
+                                     for i in decodable))
+                    if spec_tick:
+                        if self._paged:
+                            for i in decodable:
+                                req = self._slot_state[i].request
+                                # Lookahead only for rows that will
+                                # actually speculate — a sampled or
+                                # opted-out row writes one real token
+                                # per tick and needs no window blocks.
+                                if req.temperature <= 0 and req.speculate:
+                                    self._alloc_lookahead(i)
+                        with span("spec_tick", active=self.active_slots,
+                                  k=self.spec_k):
+                            out, commit, caps = await self._in_executor(
+                                loop, self._spec_sync)
+                        self._spec_owe_fallback = any(
+                            int(commit[i]) == 0 for i in decodable
+                            if self._slot_state[i] is not None)
+                    else:
+                        with span("decode_tick", active=self.active_slots):
+                            nxt = await self._in_executor(
+                                loop, self._decode_sync)
+                        self._spec_owe_fallback = False
                     if self._arm_after_warmup and self.auditor is not None:
-                        # First decode iteration IS the warmup: the one
-                        # executable exists now, so every later compile is
-                        # a violated invariant.
+                        # First decode iteration IS the warmup: every
+                        # executable exists now (the ctor pre-compiled
+                        # the spec trio), so every later compile is a
+                        # violated invariant.
                         self._arm_after_warmup = False
-                        self.auditor.arm("serving_decode")
+                        self.auditor.arm(*(
+                            ("serving_decode", "serving_draft",
+                             "serving_verify") if self._spec
+                            else ("serving_decode",)))
                     t = time.monotonic()
                     with span("stream", active=self.active_slots):
                         for i, st in enumerate(self._slot_state):
@@ -1151,7 +1568,12 @@ class ServingEngine:
                                 # Mid-prefill rows decode garbage until
                                 # their finished cache is spliced in.
                                 continue
-                            self._push_token(st, int(nxt[i]), t)
+                            if spec_tick:
+                                self._stream_spec(st, out[i],
+                                                  int(commit[i]),
+                                                  int(caps[i]), t)
+                            else:
+                                self._push_token(st, int(nxt[i]), t)
                             if st.remaining == 0:
                                 self._finish_ok(st.request)
                                 self._free_slot_paged(i, st)
@@ -1205,6 +1627,19 @@ class ServingEngine:
         them. copy_context() is copy-on-write — negligible per-call."""
         ctx = contextvars.copy_context()
         return loop.run_in_executor(None, lambda: ctx.run(fn, *args))
+
+    @staticmethod
+    def _pow2_fit(P: int, room: int) -> int:
+        """Shrink a pad width to the largest power of two that fits the
+        remaining cache room (the prefill overshoot guard — see
+        :meth:`_prefill_step` for why overshooting would clamp the KV
+        write backward over real rows). Shared by the target and draft
+        prefill chunkers so the bound can never drift between them."""
+        if P > room:
+            P = 1
+            while P * 2 <= room:
+                P *= 2
+        return P
 
     def _bucket(self, n: int, cap: int | None = None) -> int:
         """Prefill pad length: next power of two >= n (>= min bucket),
@@ -1301,9 +1736,7 @@ class ServingEngine:
         # sub-chunk or two.)
         room = self._cache_len - job.pos
         if P > room:
-            P = 1
-            while P * 2 <= room:
-                P *= 2
+            P = self._pow2_fit(P, room)
             c = min(c, P)  # room >= rem >= 1, so P >= 1 and c >= 1
         padded = np.zeros((1, P), np.int32)
         padded[0, :c] = tokens[job.pos:job.pos + c]
@@ -1339,6 +1772,9 @@ class ServingEngine:
             with span("cache_admit", slot=slot):
                 self._tokens, self._temps = self._admit_jit(
                     self._tokens, self._temps, jnp.int32(slot), tok, temp)
+            # The slot joins the decodable set: the masked table view
+            # gains its row, so the next tick must re-upload.
+            self._tables_dirty = True
         else:
             # Store the complete blocks this prefill computed (future
             # requests sharing the prefix hit them), then splice the row
@@ -1356,6 +1792,14 @@ class ServingEngine:
             job.matched_tokens if (self._paged or
                                    self.prefix_cache is not None) else None,
             s0)
+        if self._spec:
+            # The draft's prompt K/V, built once the target prefill
+            # finished (executor thread — the loop stays responsive).
+            # After this the slot's fed-token truth is s0 for BOTH
+            # models; the first spec tick picks it up from here.
+            with span("draft_prefill", slot=slot, prompt_len=s0):
+                self._draft_prefill_slot(slot, tokens)
+            self._spec_pos[slot] = s0
         if req.trace is not None:
             req.trace.data.update(
                 prefill_device_s=round(job.device_s, 9),
@@ -1364,41 +1808,189 @@ class ServingEngine:
         st.prefill = None
         return tok0
 
+    def _decodable(self) -> list[int]:
+        """Slots whose row is live and past prefill — the rows whose
+        tick output is streamed (everyone else decodes garbage)."""
+        return [i for i in range(self.slots)
+                if self._slot_state[i] is not None
+                and self._slot_state[i].prefill is None]
+
+    def _upload_tables(self, decodable):
+        """Device view of the block tables, MASKED to the sentinel for
+        rows that must not write (free slots, mid-prefill slots — their
+        garbage output is discarded, and the dropped scatter guarantees
+        it cannot scribble on live blocks the way the dense path lets a
+        free row scribble on its own). Rebuilt + re-uploaded only when
+        the dirty flag says the masked view could have changed — set at
+        the sites that mutate a table row (admission reserve, growth,
+        preemption, teardown) and at prefill completion (the decodable
+        set grew) — NOT by an O(slots × blocks) compare every tick.
+        (Safe to hold across ticks: the decode jits donate cache/tokens
+        only.)"""
+        if self._tables_dirty or self._tables_dev is None:
+            tables = np.full_like(self._tables, self._sentinel)
+            for i in decodable:
+                tables[i] = self._tables[i]
+            self._tables_dev = jnp.asarray(tables)
+            self._tables_dirty = False
+        return self._tables_dev
+
     def _decode_sync(self) -> np.ndarray:
         self._key, sub = jax.random.split(self._key)
         if self._paged:
-            # Device views of the host paging state: per-row write
-            # positions, and block tables MASKED to the sentinel for
-            # rows that must not write (free slots, mid-prefill slots —
-            # their garbage decode output is discarded, and the dropped
-            # scatter guarantees it cannot scribble on live blocks the
-            # way the dense path lets a free row scribble on its own).
-            decodable = [i for i in range(self.slots)
-                         if self._slot_state[i] is not None
-                         and self._slot_state[i].prefill is None]
+            decodable = self._decodable()
             positions = np.zeros((self.slots,), np.int32)
-            tables = np.full_like(self._tables, self._sentinel)
             for i in decodable:
                 positions[i] = self._lens[i]
-                tables[i] = self._tables[i]
-            # Tables only change on admission/growth/preemption/
-            # teardown — bt-1 of every bt steady-state ticks reuse the
-            # cached device copy instead of re-uploading. (Safe to hold
-            # across ticks: the decode jit donates cache/tokens only.)
-            if (self._tables_dev is None
-                    or not np.array_equal(tables, self._tables_host)):
-                self._tables_host = tables
-                self._tables_dev = jnp.asarray(tables)
+            tables_dev = self._upload_tables(decodable)
             self._cache, self._tokens = self._decode_step(
                 self._params, self._cache, self._tokens, self._temps,
-                jnp.asarray(positions), self._tables_dev, sub)
+                jnp.asarray(positions), tables_dev, sub)
             # Each decodable row appended exactly one K/V vector.
             for i in decodable:
                 self._lens[i] += 1
         else:
             self._cache, self._tokens = self._decode_step(
                 self._params, self._cache, self._tokens, self._temps, sub)
+            if self._spec:
+                for i in self._decodable():
+                    self._spec_pos[i] += 1
         return np.asarray(self._tokens)
+
+    # -- speculative decoding (draft/verify) --------------------------------
+    def _spec_sync(self):
+        """One speculative tick (executor thread; device work only):
+        fixed-K greedy draft scan, ONE batched K-position verify, masked
+        accept. Returns ``(out, commit, caps)`` — ``out[i, :commit[i]]``
+        are slot ``i``'s committed tokens this tick (0..K for live
+        greedy rows — 0 means every draft was rejected and the run loop
+        owes the batch a fallback tick — exactly 1 for temperature>0
+        rows riding the same batch, 0 for garbage rows) and ``caps[i]``
+        is the draft budget the row REALLY had (spec_k, minus paged
+        allocation pressure) for honest accept accounting. All shapes
+        are static in ``spec_k``, so the armed compile-count==1
+        contract holds per callable no matter how acceptance varies."""
+        self._key, sub = jax.random.split(self._key)
+        decodable = self._decodable()
+        spec_ok = np.zeros((self.slots,), bool)
+        remaining = np.zeros((self.slots,), np.int32)
+        positions = np.zeros((self.slots,), np.int32)
+        prev = np.zeros((self.slots,), np.int32)
+        for i in decodable:
+            st = self._slot_state[i]
+            spec_ok[i] = (st.request.temperature <= 0
+                          and st.request.speculate)
+            remaining[i] = st.remaining
+            positions[i] = (self._lens[i] if self._paged
+                            else self._spec_pos[i])
+            # The token at position fed-1, for the draft's heal apply:
+            # the resident sequence's second-to-last element (the last
+            # one is the unfed feed token) — read directly rather than
+            # materializing prompt+out (O(context) per tick). Admission
+            # streams at least one token before the first tick, so the
+            # element always exists.
+            out_t = st.request.out_tokens
+            if len(out_t) >= 2:
+                prev[i] = out_t[-2]
+            elif out_t:
+                prev[i] = st.request.prompt[-1]
+            else:
+                prev[i] = st.request.prompt[-2 if len(
+                    st.request.prompt) >= 2 else -1]
+        start = jnp.asarray(positions)
+        self._draft_cache, drafts = self._draft_step(
+            self._draft_params, self._draft_cache, jnp.asarray(prev),
+            self._tokens, start)
+        if self._paged:
+            # ``room`` doubles as the accounting cap: a commit clamped
+            # by allocation pressure must not read as draft rejection
+            # in the accept-rate metric.
+            caps = np.zeros((self.slots,), np.int32)
+            for i in decodable:
+                caps[i] = self._spec_room(i)
+            tables_dev = self._upload_tables(decodable)
+            self._cache, self._tokens, out, commit = self._verify_step(
+                self._params, self._cache, self._tokens, drafts,
+                self._temps, jnp.asarray(spec_ok), jnp.asarray(remaining),
+                jnp.asarray(caps), start, tables_dev, sub)
+        else:
+            caps = np.full((self.slots,), self.spec_k, np.int32)
+            self._cache, self._tokens, out, commit = self._verify_step(
+                self._params, self._cache, self._tokens, drafts,
+                self._temps, jnp.asarray(spec_ok), jnp.asarray(remaining),
+                start, sub)
+        out = np.asarray(out)
+        commit = np.asarray(commit)
+        for i in decodable:
+            if self._paged:
+                self._lens[i] += int(commit[i])
+            else:
+                self._spec_pos[i] += int(commit[i])
+        return out, commit, caps
+
+    def _spec_room(self, i: int) -> int:
+        """Contiguous allocated K/V positions from slot ``i``'s write
+        offset (capped at the window size): how many of this tick's
+        window writes will actually land. The verify clamps the row's
+        commit to this, so speculation under pool pressure degrades to
+        fewer tokens per tick instead of committing tokens whose K/V
+        the OOB-drop scatter discarded."""
+        bt = self.kv_block_tokens
+        lens = int(self._lens[i])
+        blk = lens // bt
+        allocated = 0
+        while (blk < self._table_blocks
+               and self._tables[i, blk] != self._sentinel):
+            allocated += bt
+            blk += 1
+        return min(allocated - lens % bt, self.spec_k)
+
+    def _alloc_lookahead(self, i: int) -> None:
+        """Opportunistic pre-tick growth for a speculating slot: chain
+        blocks so the whole K-wide verify window can land. Unlike
+        :meth:`_ensure_tail_block` this NEVER preempts — a dry pool just
+        shrinks the row's ``_spec_room`` (fewer tokens per tick), which
+        beats evicting a peer for lookahead capacity that rejected
+        drafts may never use. Extra blocks are reclaimed at teardown /
+        preemption by the adopt watermark like any tail block."""
+        st = self._slot_state[i]
+        bt = self.kv_block_tokens
+        last = (int(self._lens[i]) + self.spec_k - 1) // bt
+        for blk in range(int(self._lens[i]) // bt,
+                         min(last, self._table_blocks - 1) + 1):
+            if self._tables[i, blk] != self._sentinel:
+                continue
+            ids = self.kv_pool.alloc(1)
+            if ids is None:
+                return
+            self._tables[i, blk] = ids[0]
+            st.blocks.extend(ids)
+            self._tables_dirty = True
+
+    def _draft_prefill_slot(self, slot: int, tokens) -> None:
+        """Build the draft's prompt K/V for a freshly admitted slot
+        (executor thread): pow2-bucketed chunks through the draft
+        prefill program into a scratch row, then one splice into the
+        batched draft cache. Runs once per admission, after the TARGET
+        prefill completed — the draft is small, so this costs a fraction
+        of the prefill the admission already paid; on a prefix-cache hit
+        the draft recomputes its prompt K/V (the cache pools hold target
+        K/V only; caching draft K/V would double trie bookkeeping to
+        save work that is cheap by the draft's definition)."""
+        row = self._fresh_draft_row()
+        pos, s0 = 0, len(tokens)
+        while pos < s0:
+            c = s0 - pos
+            P = self._pow2_fit(self._bucket(c), self._cache_len - pos)
+            c = min(c, P)
+            padded = np.zeros((1, P), np.int32)
+            padded[0, :c] = tokens[pos:pos + c]
+            row = self._draft_prefill(
+                self._draft_params, row, jnp.asarray(padded),
+                jnp.int32(pos), jnp.int32(c))
+            pos += c
+        self._draft_cache = self._draft_admit(
+            self._draft_cache, jnp.int32(slot), row)
 
     # -- paged-KV internals (host bookkeeping; no device work) --------------
     @staticmethod
@@ -1454,6 +2046,7 @@ class ServingEngine:
         row[:] = self._sentinel
         row[:first_block] = match.ids
         row[first_block:first_block + needed] = ids
+        self._tables_dirty = True
         self._lens[slot] = m
         if req.trace is not None and m:
             req.trace.event("prefix_splice", tokens=m, blocks=first_block)
@@ -1482,6 +2075,7 @@ class ServingEngine:
             ids = self.kv_pool.alloc(1)
         self._tables[i, blk] = ids[0]
         st.blocks.extend(ids)
+        self._tables_dirty = True
         return True
 
     def _preempt_slot(self, i: int) -> None:
@@ -1502,6 +2096,7 @@ class ServingEngine:
         st.match = None
         st.prefill = None
         self._tables[i, :] = self._sentinel
+        self._tables_dirty = True
         self._lens[i] = 0
         self._slot_state[i] = None
         self.metrics.record_preemption()
@@ -1520,6 +2115,8 @@ class ServingEngine:
         (zero-copy insert — a follow-up prompt sharing the prefix, or a
         multi-turn continuation sharing prompt+output, re-matches them),
         free the rest, and unpin the shared chain."""
+        if self._spec:
+            self._spec_pos[i] = 0
         if not self._paged:
             return
         req = st.request
@@ -1533,7 +2130,39 @@ class ServingEngine:
         st.blocks = []
         st.match = None
         self._tables[i, :] = self._sentinel
+        self._tables_dirty = True
         self._lens[i] = 0
+
+    def _stream_spec(self, st: _SlotState, row_out, commit: int,
+                     cap: int, t: float) -> None:
+        """Stream one slot's committed tokens from a speculative tick
+        and book the accept accounting. ``commit`` was clamped in-kernel
+        to the row's remaining budget (and, paged, its allocated room —
+        ``cap``), so the push loop can never overshoot
+        ``max_new_tokens``. The tokens of one tick share a timestamp —
+        they really did arrive together, which is what the inter-token
+        histogram should say."""
+        req = st.request
+        if req.temperature <= 0 and req.speculate:
+            # Drafts the row could actually have used: spec_k clamped
+            # by BOTH its remaining budget and (paged) the allocated
+            # room the commit was clamped to. Counting the clamped-away
+            # drafts would dilute the accept rate with "request
+            # finished" / "pool pressure" — neither is draft quality,
+            # and the metric's whole job is to isolate draft quality.
+            # Every committed token IS an accepted draft in this
+            # design, so accepted == commit.
+            usable = min(self.spec_k, st.remaining, cap)
+            if usable > 0:
+                st.spec_drafted += usable
+                st.spec_accepted += commit
+                self.metrics.record_spec(usable, commit,
+                                         trace_id=req.trace_id)
+                if req.trace is not None:
+                    req.trace.data["spec_drafted"] = st.spec_drafted
+                    req.trace.data["spec_accepted"] = st.spec_accepted
+        for j in range(commit):
+            self._push_token(st, int(row_out[j]), t)
 
     def _push_token(self, st: _SlotState, tok: int, t: float,
                     first: bool = False) -> None:

@@ -126,6 +126,24 @@ class ServingMetrics:
                 "serving_request_latency_seconds",
                 help="submit-to-done latency", buckets=_LATENCY_BUCKETS),
         }
+        # Speculative decoding: proposed vs committed draft tokens (the
+        # ratio is the accept rate — THE health signal for a draft
+        # model: it falling means the draft stopped predicting the
+        # target and speculation is burning draft compute for nothing),
+        # plus the per-row-per-tick accept-length histogram whose
+        # exemplars name the request behind an accept-rate collapse.
+        self._c_spec_draft = reg.counter(
+            "spec_draft_tokens_total",
+            help="draft tokens proposed by the speculative decoder")
+        self._c_spec_accepted = reg.counter(
+            "spec_accepted_tokens_total",
+            help="draft tokens accepted (committed) by the target "
+                 "verify step")
+        self._h["spec_accept_len"] = reg.histogram(
+            "serving_spec_accept_len",
+            help="accepted drafts per speculating row per tick "
+                 "(0..spec_k)",
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
         self._c_slo_violations = reg.counter(
             "serving_slo_violations_total",
             help="requests that finished slower than the configured "
@@ -265,6 +283,25 @@ class ServingMetrics:
         info.set(1)
         self._last_weight_info = info
 
+    def record_spec(self, drafted: int, accepted: int,
+                    trace_id: str | None = None) -> None:
+        """One speculating row's tick: ``drafted`` tokens proposed that
+        the row could actually use (spec_k, clamped by its remaining
+        budget), ``accepted`` of them committed. ``trace_id`` pins the
+        bucket's worst-sample exemplar so an accept-len p~0 bucket
+        names a request whose stream the draft model cannot predict."""
+        self._c_spec_draft.inc(drafted)
+        self._c_spec_accepted.inc(accepted)
+        self._h["spec_accept_len"].observe(accepted, exemplar=trace_id)
+
+    @property
+    def spec_draft_tokens(self) -> int:
+        return int(self._c_spec_draft.value)
+
+    @property
+    def spec_accepted_tokens(self) -> int:
+        return int(self._c_spec_accepted.value)
+
     def record_slo_violation(self) -> None:
         self._c_slo_violations.inc()
 
@@ -354,6 +391,11 @@ class ServingMetrics:
         if self._c_prompt_tokens.value:
             out["prefix_hit_rate"] = (
                 self._c_prefix_hit_tokens.value / self._c_prompt_tokens.value)
+        if self._c_spec_draft.value:
+            out["spec_draft_tokens"] = float(self.spec_draft_tokens)
+            out["spec_accepted_tokens"] = float(self.spec_accepted_tokens)
+            out["spec_accept_rate"] = (
+                self.spec_accepted_tokens / self.spec_draft_tokens)
         if self._occupancy:
             out["slot_occupancy_mean"] = (
                 sum(self._occupancy) / len(self._occupancy)

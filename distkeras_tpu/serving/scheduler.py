@@ -93,10 +93,18 @@ class Request:
         priority: int = 0,
         timeout: float | None = None,
         trace_id: str | None = None,
+        speculate: bool = True,
     ):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)  # <= 0 means greedy
+        # Per-request speculation opt-out: on an engine with a draft
+        # model, a greedy request with speculate=False still takes the
+        # one-token fallback path (A/B measurement, or a caller that
+        # wants strictly minimal per-token latency jitter). Requests
+        # with temperature > 0 never speculate regardless — acceptance
+        # is a greedy-consistency rule.
+        self.speculate = bool(speculate)
         self.priority = int(priority)
         # Every request carries a trace id: the client's (propagated over
         # the wire, sanitized against junk) or a fresh mint — so

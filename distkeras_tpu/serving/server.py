@@ -123,6 +123,7 @@ class ServingServer:
                         priority=int(spec.get("priority", 0)),
                         timeout=spec.get("timeout"),
                         trace_id=spec.get("trace_id"),
+                        speculate=bool(spec.get("speculate", True)),
                     )
                 except ServingError as e:
                     await self._send(writer, self._error(e, spec))

@@ -6,7 +6,11 @@
 forced), and ``benchmarks/serving_bench.py --record-history`` records
 serving rows under ``serving/...`` keys (TTFT/ITL percentiles, goodput,
 prefix-cache hit rate) — both keep a bounded trail of displaced entries
-under ``prev``. Training-health rows live under ``train/...`` keys
+under ``prev``. Speculative-decoding runs record under
+``serving/spec_<model>/...`` keys: their ITL/TTFT rows regress by
+RISING like every latency row, while ``spec_accept_rate`` and the
+goodput rows regress by DROPPING (a falling accept rate means the
+draft stopped predicting the target — throughput follows it down). Training-health rows live under ``train/...`` keys
 (``train/<protocol>/workersN/staleness_p99``, ``.../goodput_ratio``)
 and stay warn-only like every training row. Continuous-deployment rows
 from ``benchmarks/deploy_bench.py`` live under ``deploy/...`` keys:
@@ -57,9 +61,11 @@ def load_history(path: str) -> dict:
 # deploy_latency_p50_s``, where deploy latency is the trained->serving
 # staleness window and regresses UP while ``canary_pass_rate`` — good
 # publishes that actually deployed — regresses DOWN). Throughput rows —
-# including ``goodput_*``, the training-health ``goodput_ratio``, and
-# ``canary_pass_rate`` — never end in these names, so they keep
-# higher-is-better.
+# including ``goodput_*``, the training-health ``goodput_ratio``,
+# ``canary_pass_rate``, and the speculative-decoding
+# ``spec_accept_rate`` (an accept-rate drop IS the regression: the
+# draft stopped predicting the target) — never end in these names, so
+# they keep higher-is-better.
 _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     "queue_wait", "latency", "staleness",
                     "deploy_latency")

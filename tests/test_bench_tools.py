@@ -194,6 +194,46 @@ def test_check_bench_regression_serving_rows_are_direction_aware(
     assert not cbr.lower_is_better("train/dynsgd/workers4/goodput_ratio")
 
 
+def test_check_bench_regression_speculative_rows_direction(
+        tmp_path, capsys):
+    """serving/spec_* rows (serving_bench --speculate --record-history):
+    accept rate and goodput regress by DROPPING, the ITL percentiles by
+    RISING — the strict `--only serving/` CI gate must fire on an
+    accept-rate collapse, not on an accept-rate improvement."""
+    from scripts import check_bench_regression as cbr
+
+    path = tmp_path / "bench_history.json"
+    path.write_text(json.dumps({
+        # Accept rate collapsed 0.9 -> 0.4: the draft stopped predicting
+        # the target — a regression even though latency may look fine.
+        "serving/spec_gpt_tiny/slots4/k4/closed/spec_accept_rate":
+            {"value": 0.4, "when": "2026-08-04T00:00:01Z",
+             "prev": [{"value": 0.9, "when": "2026-08-01T00:00:00Z"}]},
+        # Speculative goodput doubled: an improvement, must NOT warn.
+        "serving/spec_gpt_tiny/slots4/k4/closed/goodput_tokens_per_sec":
+            {"value": 400.0, "when": "2026-08-04T00:00:02Z",
+             "prev": [{"value": 200.0, "when": "2026-08-01T00:00:00Z"}]},
+        # Speculative ITL doubled: latency-shaped, regresses UP.
+        "serving/spec_gpt_tiny/slots4/k4/closed/inter_token_p99_s":
+            {"value": 0.004, "when": "2026-08-04T00:00:03Z",
+             "prev": [{"value": 0.002, "when": "2026-08-01T00:00:00Z"}]},
+    }))
+    rc = cbr.main(["--history", str(path), "--all", "--only", "serving/"])
+    out = capsys.readouterr().out
+    assert rc == 0  # warn-only without --strict
+    assert ("[REGRESSION] serving/spec_gpt_tiny/slots4/k4/closed/"
+            "spec_accept_rate") in out
+    assert ("[ok] serving/spec_gpt_tiny/slots4/k4/closed/"
+            "goodput_tokens_per_sec") in out
+    assert ("[REGRESSION] serving/spec_gpt_tiny/slots4/k4/closed/"
+            "inter_token_p99_s") in out
+    # The strict serving gate (the CI lane) fails on the collapse.
+    assert cbr.main(["--history", str(path), "--all", "--strict",
+                     "--only", "serving/"]) == 1
+    assert not cbr.lower_is_better(
+        "serving/spec_gpt_tiny/slots4/k4/closed/spec_accept_rate")
+
+
 def test_check_bench_regression_skips_unusable_rows(tmp_path):
     from scripts import check_bench_regression as cbr
 

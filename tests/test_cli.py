@@ -78,6 +78,62 @@ def test_cli_train_statusz_and_stamped_out(job, capsys):
     assert "workers:" in page and "staleness:" in page
 
 
+def test_serving_config_flags_forwarded_to_replicas():
+    """The ONE replica-flag builder shared by `cluster` and `deploy`:
+    paged/chunk/speculation configuration reaches every replica child —
+    which is what makes deploy's canary validate candidates under the
+    fleet's REAL serving config instead of the dense one-token
+    default."""
+    import argparse
+
+    from distkeras_tpu.run import _serving_config_flags
+
+    args = argparse.Namespace(
+        top_k=8, prefill_chunk=32, prefix_cache_mb=0.0, prefix_block=16,
+        paged=True, kv_pool_mb=64.0, kv_block_tokens=8, max_context=48,
+        draft_model="gpt_tiny", draft_args='{"seq_len": 64}',
+        draft_weights=None, spec_k=6)
+    flags = _serving_config_flags(args)
+    for pair in (["--paged"], ["--kv-pool-mb", "64.0"],
+                 ["--kv-block-tokens", "8"], ["--prefill-chunk", "32"],
+                 ["--max-context", "48"], ["--top-k", "8"],
+                 ["--draft-model", "gpt_tiny"],
+                 ["--draft-args", '{"seq_len": 64}'], ["--spec-k", "6"]):
+        joined = " ".join(flags)
+        assert " ".join(pair) in joined, (pair, flags)
+    # Dense default: no paged/spec flags leak into the children.
+    plain = argparse.Namespace(
+        top_k=None, prefill_chunk=None, prefix_cache_mb=0.0,
+        prefix_block=16, paged=False, kv_pool_mb=0.0, kv_block_tokens=16,
+        max_context=None, draft_model=None, draft_args="{}",
+        draft_weights=None, spec_k=4)
+    flags = _serving_config_flags(plain)
+    assert "--paged" not in flags and "--draft-model" not in flags
+
+    assert all(isinstance(f, str) for f in _serving_config_flags(args))
+
+
+def test_deploy_and_serve_parsers_accept_serving_config(capsys):
+    """Every flag the replica builder emits must exist on BOTH parent
+    parsers — a flag deploy's parser rejects could never reach its
+    canary replicas."""
+    import pytest as _pytest
+
+    from distkeras_tpu.run import deploy_main, serve_main
+
+    for main_fn, argv in ((deploy_main, ["--help"]),
+                          (serve_main, ["--help"])):
+        with _pytest.raises(SystemExit) as e:
+            main_fn(argv)
+        assert e.value.code == 0
+        text = capsys.readouterr().out
+        for flag in ("--draft-model", "--draft-args", "--spec-k",
+                     "--paged", "--kv-pool-mb", "--kv-block-tokens",
+                     "--prefill-chunk", "--prefix-cache-mb",
+                     "--max-context"):
+            assert flag in text, (main_fn.__name__, flag)
+
+
 def test_cli_unknown_model(job):
     data, cfg, _ = job
     r = subprocess.run(
