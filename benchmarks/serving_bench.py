@@ -59,6 +59,19 @@ sanity config's chain is bitwise the sequential one). Reports the
 accept rate, per-mode ``spec_*`` counters, and — with
 ``--record-history`` — ``serving/spec_*`` history rows.
 
+``--mesh`` / ``--mesh-shape tp=N`` (with ``--force-host-devices N`` on a
+CPU host) runs the engine **GSPMD tensor-parallel**: params laid out by
+their logical axes, KV heads-sharded, every callable pinned to explicit
+in/out shardings. The run arms the ``RecompileAuditor`` (compile-once
+per callable, sharded layouts and all) and the standard parity check
+against the UNSHARDED ``generate()`` reference becomes the
+sharded-vs-unsharded token-identity proof. ``--record-history`` writes
+``serving/sharded_<model>_tpN/...`` rows under the same strict
+``--only serving/`` CI gate:
+
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mode closed \
+        --mesh-shape tp=2 --force-host-devices 2 --requests 24
+
 ``--replicas N`` (N >= 2) swaps the single engine for an **in-process
 cluster**: N engines behind the supervised router
 (:mod:`distkeras_tpu.serving.cluster`), with the load driven through TCP
@@ -90,6 +103,45 @@ import json
 import time
 
 import numpy as np
+
+
+def _force_host_devices(n):
+    """Set the XLA forced-device-count flag BEFORE anything imports jax
+    (stdlib-only on purpose: importing distkeras_tpu would initialize
+    jax first and make the flag a no-op). Single-threaded Eigen rides
+    along: virtual devices share one intra-op pool and the sharded
+    engine's per-layer all-reduces can deadlock the rendezvous without
+    it (see utils.platform.ensure_virtual_cpu_flags)."""
+    if not n:
+        return
+    import os
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    flags += f" --xla_force_host_platform_device_count={int(n)}"
+    if "--xla_cpu_multi_thread_eigen" not in flags:
+        flags += " --xla_cpu_multi_thread_eigen=false"
+    os.environ["XLA_FLAGS"] = flags.strip()
+    # Forced HOST devices only exist on the CPU platform (same pin as
+    # run.py's --force-host-devices).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _mesh(args):
+    """The serving mesh --mesh/--mesh-shape ask for (cached on args so
+    sweep/cluster paths building fresh engines reuse ONE mesh)."""
+    if not (args.mesh or args.mesh_shape):
+        return None
+    if getattr(args, "_mesh", None) is None:
+        from distkeras_tpu.parallel.mesh import (
+            parse_mesh_shape, serving_mesh,
+        )
+
+        shape = (parse_mesh_shape(args.mesh_shape)
+                 if args.mesh_shape else None)
+        args._mesh = serving_mesh(shape)
+    return args._mesh
 
 
 def _model(args):
@@ -133,11 +185,13 @@ def _make_engine(args, model, variables, metrics=None, trace_store=None,
 
     paged = args.paged or args.kv_pool_mb > 0
     draft_model, draft_variables = _draft(args, model, variables)
+    mesh = _mesh(args)
     auditor = None
-    if draft_model is not None:
-        # Speculative runs arm the auditor: the acceptance bar is not
-        # just ">2x" but ">2x while draft, verify, and fallback decode
-        # each stay at ONE executable" — a retrace raises mid-run
+    if draft_model is not None or mesh is not None:
+        # Speculative AND sharded runs arm the auditor: the acceptance
+        # bar is not just the throughput/parity number but "while every
+        # callable (draft/verify/fallback decode, sharded layouts
+        # pinned) stays at ONE executable" — a retrace raises mid-run
         # instead of silently eating the win.
         from distkeras_tpu.telemetry import RecompileAuditor
 
@@ -154,7 +208,7 @@ def _make_engine(args, model, variables, metrics=None, trace_store=None,
         kv_block_tokens=args.kv_block,
         max_context=args.max_context,
         draft_model=draft_model, draft_variables=draft_variables,
-        spec_k=args.spec_k,
+        spec_k=args.spec_k, mesh=mesh,
         auditor=auditor, arm_auditor_after_warmup=auditor is not None,
         trace_store=trace_store,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
@@ -559,6 +613,12 @@ def _record_history(args, report):
     hist = bench.load_history(path)
     paged = args.paged or args.kv_pool_mb > 0
     model_tag = f"paged_{args.model}" if paged else args.model
+    if args.mesh or args.mesh_shape:
+        # serving/sharded_* rows: the GSPMD tensor-parallel engine's
+        # numbers diff against their own prior, never the single-device
+        # series — and ride the same strict --only serving/ CI gate.
+        tp = dict(getattr(args, "_mesh").shape).get("tp", 0)
+        model_tag = f"sharded_{model_tag}_tp{tp}"
     if _speculating(args):
         # serving/spec_* rows: accept rate, goodput, ITL percentiles of
         # speculative runs diff against their own prior — never against
@@ -672,6 +732,19 @@ def main():
                          "same as --model)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens per speculative tick")
+    ap.add_argument("--mesh", action="store_true",
+                    help="GSPMD tensor-parallel engine: shard params + "
+                         "KV over every visible device's tp axis; arms "
+                         "the auditor and asserts token-identical "
+                         "greedy parity vs the unsharded generate() "
+                         "reference")
+    ap.add_argument("--mesh-shape", default=None, metavar="AXIS=N[,..]",
+                    help="explicit serving mesh shape (implies --mesh), "
+                         "e.g. 'tp=2'")
+    ap.add_argument("--force-host-devices", type=int, default=None,
+                    metavar="N",
+                    help="force N virtual CPU devices (set before jax "
+                         "loads) — how a CPU host runs --mesh")
     ap.add_argument("--slot-sweep", default=None, metavar="N1,N2,...",
                     help="max-concurrent-slots-at-fixed-bytes sweep: "
                          "re-run the closed-loop phase at each slot "
@@ -708,6 +781,8 @@ def main():
     ap.add_argument("--skip-parity", action="store_true",
                     help="skip the generate() cross-check (pure load run)")
     args = ap.parse_args()
+    _force_host_devices(args.force_host_devices)
+    args._mesh = None
 
     from distkeras_tpu.serving import ServingMetrics
 
@@ -733,6 +808,8 @@ def main():
         "draft_model": (args.draft_model or args.model
                         if _speculating(args) else None),
         "spec_k": args.spec_k if _speculating(args) else 0,
+        "mesh": (dict(_mesh(args).shape)
+                 if (args.mesh or args.mesh_shape) else None),
     }}
 
     if args.replicas >= 2:
@@ -832,11 +909,13 @@ def main():
         assert compiles in (1, -1), (
             f"continuous batching retraced the decode step: {compiles} "
             "compiled executables (expected exactly 1)")
-        if engine.auditor is not None:
+        if engine.auditor is not None and _speculating(args):
             # Speculative run: the armed auditor stayed silent (or we
             # would not be here) — record and assert the per-callable
             # counts: draft, verify, AND the fallback decode each
-            # compiled exactly once across the whole run.
+            # compiled exactly once. (Sharded-only runs need no extra
+            # block: their single decode callable is the
+            # decode_compile_count assertion right above.)
             spec_compiles = {
                 name: engine.auditor.compiles(name)
                 for name in ("serving_decode", "serving_draft",

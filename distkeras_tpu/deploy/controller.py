@@ -595,7 +595,14 @@ class DeployController:
         replica is the supervisor's problem — it gets restarted onto
         ``current_weights``, which the roll just moved to the candidate
         — whereas a CONFLICTING version is a failed roll and always
-        fails verify."""
+        fails verify.
+
+        Sharded fleets: each replica's healthz carries its ``mesh``
+        (axis sizes); a fleet whose routable replicas disagree on mesh
+        shape is failed like a version conflict — a restart that came
+        back unsharded (or on a different tp) would serve the same
+        weights with a different memory/latency envelope than the
+        canary vetted, silently."""
         want = f"{manifest.get('version')}:{manifest.get('digest')}"
         detail: dict = {"want": want}
         for attempt in range(attempts):
@@ -605,10 +612,22 @@ class DeployController:
             versions = router_h.get("weight_versions", {})
             routable = sum(1 for r in health.get("replicas", {}).values()
                            if r.get("status") in (READY, DRAINING))
+            meshes: dict[str, str] = {}
+            for rid, r in health.get("replicas", {}).items():
+                if r.get("status") not in (READY, DRAINING):
+                    continue
+                sub = r.get("healthz")
+                if isinstance(sub, dict):
+                    axes = (sub.get("mesh") or {}).get("axes")
+                    meshes[rid] = (json.dumps(axes, sort_keys=True)
+                                   if axes else "unsharded")
             detail = {"weight_versions": versions,
                       "replicas_ready": router_h.get("replicas_ready"),
                       "want": want}
-            conflict = any(k != want for k in versions)
+            if meshes:
+                detail["meshes"] = meshes
+            mesh_conflict = len(set(meshes.values())) > 1
+            conflict = any(k != want for k in versions) or mesh_conflict
             confirmed = versions.get(want, 0)
             if not conflict and confirmed >= routable and routable >= 1:
                 return True, detail

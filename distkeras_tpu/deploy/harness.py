@@ -27,7 +27,7 @@ def make_golden_prompts(vocab: int, count: int = 4, length: int = 8,
 
 
 def make_score_fn(model, vocab: int, seq_len: int = 16, batch: int = 4,
-                  seed: int = 0):
+                  seed: int = 0, mesh=None, warmup=None):
     """Golden-batch next-token loss under candidate weights.
 
     The canary's "finite loss" check: a fixed random token batch scored
@@ -36,6 +36,21 @@ def make_score_fn(model, vocab: int, seq_len: int = 16, batch: int = 4,
     the candidate ever serves a request. The batch is deterministic per
     seed; the jitted program is cached across deploys (same shapes every
     time, so repeated canaries cost one compile total).
+
+    ``warmup``: a variables pytree (typically the validation template)
+    to score once AT BUILD TIME, off the deploy clock. Without it the
+    jit's one compile (~2.3s for gpt_tiny on CPU) lands inside the
+    FIRST deploy's manifest-seen→fleet-verified window — in short
+    benches (2-3 deploys) that one compile was most of the recorded
+    ``deploy/`` p50/p95 drift (bisected: staging hard-links and verify
+    retries measure ~0; the canary's score_fn compile measured 2.3s of
+    the first deploy's 2.5s).
+
+    ``mesh``: a serving mesh — candidate leaves are then device_put
+    **shard-then-place** into their logical-axis layout before the
+    forward (each device gets only its slice, the arXiv:2004.13336
+    rollout move), so the controller scores a model bigger than one
+    chip the same way the sharded fleet serves it.
     """
     import jax
     import jax.numpy as jnp
@@ -46,16 +61,49 @@ def make_score_fn(model, vocab: int, seq_len: int = 16, batch: int = 4,
     tokens = jnp.asarray(
         rng.integers(0, vocab, size=(batch, seq_len)), jnp.int32)
 
+    param_shardings = None
+    if mesh is not None and getattr(model, "boxed_init", None) is not None:
+        from distkeras_tpu.parallel.sharding import (
+            infer_variable_shardings,
+        )
+
+        abstract = jax.eval_shape(model.boxed_init, jax.random.PRNGKey(0))
+        param_shardings = infer_variable_shardings(
+            mesh, abstract)["params"]
+
     @jax.jit
     def _loss(variables):
         logits, _ = model.apply(variables, tokens, train=False)
         return categorical_crossentropy(logits[:, :-1], tokens[:, 1:])
 
     def score(variables):
-        if isinstance(variables, dict) and "params" in variables:
-            return float(_loss(variables))
-        return float(_loss({"params": variables}))
+        if not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": variables}
+        if param_shardings is not None:
+            from distkeras_tpu.parallel.gspmd import place_sharded
 
+            variables = {
+                **variables,
+                "params": place_sharded(variables["params"],
+                                        param_shardings),
+            }
+        return float(_loss(variables))
+
+    if warmup is not None:
+        try:
+            score(warmup)
+        except Exception as e:
+            # A warmup failure must never block wiring — the real
+            # candidate's score reports its own error — but it must be
+            # VISIBLE: a silently-skipped warmup puts the jit compile
+            # back inside the first deploy's latency window, the exact
+            # drift this warmup exists to prevent.
+            import warnings
+
+            warnings.warn(
+                f"golden score_fn warmup failed ({e!r}); the first "
+                f"deploy will pay the score compile on its clock",
+                RuntimeWarning, stacklevel=2)
     return score
 
 
@@ -63,15 +111,19 @@ def wire_controller(router, watch_dir: str, *, model=None,
                     template=None, vocab: int | None = None,
                     golden_count: int = 4, golden_len: int = 8,
                     golden_new_tokens: int = 4, seed: int = 0,
-                    registry=None, **controller_kwargs):
+                    registry=None, mesh=None, **controller_kwargs):
     """Build a :class:`DeployController` over ``router`` watching
     ``watch_dir`` and register it for the ``deployz`` verb.
 
     With ``model`` + ``vocab``, the golden prompt set and the
     golden-batch ``score_fn`` are built automatically (pass
-    ``golden_count=0`` to skip replica-side scoring). ``template``
-    defaults to ``model.init(seed)`` when a model is given — the leaf
-    shape/dtype validation template.
+    ``golden_count=0`` to skip replica-side scoring); the score fn is
+    WARMED here against the template, so its one jit compile happens at
+    wiring time — never inside the first deploy's latency window.
+    ``template`` defaults to ``model.init(seed)`` when a model is given
+    — the leaf shape/dtype validation template. ``mesh``: sharded-fleet
+    deployments — golden scoring places candidates shard-then-place
+    into the mesh layout (see :func:`make_score_fn`).
     """
     from distkeras_tpu.deploy.controller import DeployController
 
@@ -80,9 +132,10 @@ def wire_controller(router, watch_dir: str, *, model=None,
     if model is not None and vocab:
         golden = make_golden_prompts(vocab, count=golden_count,
                                      length=golden_len, seed=seed)
-        score_fn = make_score_fn(model, vocab, seed=seed)
         if template is None:
             template = model.init(seed)
+        score_fn = make_score_fn(model, vocab, seed=seed, mesh=mesh,
+                                 warmup=template)
     controller = DeployController(
         router, watch_dir, template=template, golden_prompts=golden,
         golden_new_tokens=golden_new_tokens, score_fn=score_fn,

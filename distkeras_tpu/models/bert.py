@@ -101,6 +101,15 @@ class BertConfig:
     # Block-table length per row: virtual context = page_table_blocks *
     # page_tokens. Required (> 0) when paged_blocks > 0.
     page_table_blocks: int = 0
+    # Tensor-parallel serving: a jax.sharding.Mesh (hashable — the same
+    # static-config stance as ring_mesh) whose "tp" axis the serving
+    # engine shards params and KV over. Decode attention then pins its
+    # cache/pool updates and attention outputs to the head-sharded
+    # layout (ops/attention.constrain_heads) so the SPMD partitioner
+    # can never resolve the mixed sharded-KV/replicated-index evidence
+    # by moving KV bytes. Params stay layout-identical; None (the
+    # default) changes nothing.
+    tp_mesh: object = None
 
 
 def _pos_window(pos_embed, starts, S: int, max_seq_len: int):
@@ -128,6 +137,61 @@ def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True)
         ),
         name=name,
     )
+
+
+class _F32AccumDense(nn.Module):
+    """``nn.Dense`` twin whose matmul keeps float32 partial sums until
+    after any cross-device reduction — the projection used at the two
+    tensor-parallel **psum sites** of the decode path (attention ``out``
+    and ``mlp_out``, whose contraction dimension is the one GSPMD splits
+    over ``tp``).
+
+    Why it exists: a bfloat16 ``Dense`` rounds its output to bf16, so
+    under tensor parallelism each device would round its *partial* sum
+    to bf16 before the all-reduce adds them — ~several bf16 ULPs of
+    layout-dependent noise per layer, enough to flip greedy argmax on a
+    near-tie and break the sharded-vs-unsharded token-identity the
+    serving engine promises. Asking the dot for a float32 result
+    (``preferred_element_type``) moves the psum BEFORE the one rounding:
+    the partials cross the interconnect in f32, and the only remaining
+    divergence is f32 reduction-order noise (~1e-7 relative), far below
+    the bf16 resolution :func:`...generate.greedy_ids` quantizes to.
+    Unsharded this lowering is bit-identical to ``nn.Dense`` — bf16
+    matmuls accumulate in f32 on CPU, GPU, and the TPU MXU alike, so the
+    explicit form only writes down what the backends already do (the
+    sharded parity suite asserts it). Param names/shapes/init match
+    ``nn.Dense`` exactly: trained weights drop in either way."""
+
+    features: int
+    logical_axes: tuple
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), self.logical_axes),
+            (x.shape[-1], self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        import jax.lax as lax
+
+        y = lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return y.astype(self.dtype) + bias.astype(self.dtype)
+
+
+def _reduce_dense(cfg, features, logical_axes, name):
+    """The projection for a contraction GSPMD may split: the f32-accum
+    twin in decode mode (where sharded/unsharded token identity is a
+    contract), plain ``nn.Dense`` otherwise (training's numerics and
+    HLO stay exactly as they were)."""
+    if cfg.decode:
+        return _F32AccumDense(features, logical_axes, cfg.dtype, name=name)
+    return _dense(features, logical_axes, name, cfg.dtype)
 
 
 class SelfAttention(nn.Module):
@@ -208,7 +272,8 @@ class SelfAttention(nn.Module):
                 mask=mask, causal=cfg.causal,
             )
         out = out.reshape(B, S, cfg.hidden_size)
-        return _dense(cfg.hidden_size, ("heads", "embed"), "out", cfg.dtype)(out)
+        return _reduce_dense(cfg, cfg.hidden_size, ("heads", "embed"),
+                             "out")(out)
 
     def _decode_attention(self, q, k, v, positions=None, block_tables=None):
         """KV-cache attention for incremental decoding. One generic path
@@ -244,6 +309,7 @@ class SelfAttention(nn.Module):
         B, S, H, D = q.shape
         if cfg.paged_blocks > 0:
             from distkeras_tpu.ops.attention import (
+                constrain_heads,
                 paged_attention,
                 paged_kv_update,
             )
@@ -259,12 +325,21 @@ class SelfAttention(nn.Module):
                 raise ValueError(
                     "paged decode needs positions [B] and block_tables "
                     "[B, T] passed to every apply")
-            pk.value = paged_kv_update(pk.value, k, block_tables,
-                                       positions, bt)
-            pv.value = paged_kv_update(pv.value, v, block_tables,
-                                       positions, bt)
-            return paged_attention(q, pk.value, pv.value, block_tables,
-                                   positions)
+            # Tensor-parallel serving: pin the pools (and the per-head
+            # attention output below) to the head-sharded layout at the
+            # scatter/gather sites, so the replicated table/position
+            # indices can never argue the partitioner into moving KV
+            # bytes. No-ops when tp_mesh is None.
+            pk.value = constrain_heads(
+                paged_kv_update(pk.value, k, block_tables, positions, bt),
+                cfg.tp_mesh)
+            pv.value = constrain_heads(
+                paged_kv_update(pv.value, v, block_tables, positions, bt),
+                cfg.tp_mesh)
+            return constrain_heads(
+                paged_attention(q, pk.value, pv.value, block_tables,
+                                positions),
+                cfg.tp_mesh)
         L = cfg.decode_cache_len or cfg.max_seq_len
         ck = self.variable("cache", "cached_key", jnp.zeros, (B, L, H, D), cfg.dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros, (B, L, H, D), cfg.dtype)
@@ -275,6 +350,8 @@ class SelfAttention(nn.Module):
             return dot_product_attention(q, k, v, causal=True)
         idx = ci.value
         if cfg.decode_slots:
+            from distkeras_tpu.ops.attention import constrain_heads
+
             # Per-slot positions: each row writes its K/V at its OWN cache
             # index and masks against its own position — slots at different
             # sequence depths coexist in one compiled step. A freed slot's
@@ -283,8 +360,10 @@ class SelfAttention(nn.Module):
             write = jax.vmap(
                 lambda c, u, i: lax.dynamic_update_slice(c, u, (i, 0, 0))
             )
-            ck.value = write(ck.value, k.astype(ck.value.dtype), idx)
-            cv.value = write(cv.value, v.astype(cv.value.dtype), idx)
+            ck.value = constrain_heads(
+                write(ck.value, k.astype(ck.value.dtype), idx), cfg.tp_mesh)
+            cv.value = constrain_heads(
+                write(cv.value, v.astype(cv.value.dtype), idx), cfg.tp_mesh)
             ci.value = jnp.minimum(idx + S, L)
             q_pos = idx[:, None] + jnp.arange(S)[None, :]  # [B, S]
             k_pos = jnp.arange(L)
@@ -338,7 +417,8 @@ class EncoderLayer(nn.Module):
         else:
             y = _dense(cfg.mlp_dim, ("embed", "mlp"), "mlp_in", cfg.dtype)(y)
             y = nn.gelu(y)
-            y = _dense(cfg.hidden_size, ("mlp", "embed"), "mlp_out", cfg.dtype)(y)
+            y = _reduce_dense(cfg, cfg.hidden_size, ("mlp", "embed"),
+                              "mlp_out")(y)
         y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
         # Keep the residual stream in the compute dtype: the MoE block takes
         # the float32 LayerNorm output and would otherwise promote the whole

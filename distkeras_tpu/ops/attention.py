@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = [
+    "constrain_heads",
     "dot_product_attention",
     "paged_attention",
     "paged_kv_update",
@@ -31,6 +32,33 @@ __all__ = [
     "ring_self_attention",
     "sp_batch_spec",
 ]
+
+
+def constrain_heads(x, mesh, axis: str = "tp", dim: int = -2):
+    """Pin ``x``'s heads dimension to the mesh's tensor-parallel axis
+    with ``with_sharding_constraint`` (no-op outside a sharded context).
+
+    The serving engine's paged decode threads ``[C, bt, H, D]`` block
+    pools and ``[B, S, H, D]`` activations through gather/scatter ops
+    whose index operands (block tables, positions) are replicated; left
+    to propagation alone, the SPMD partitioner may resolve that mixed
+    evidence by resharding — or worse, all-gathering — the multi-MB
+    pool around every scatter. Constraining the heads dim at the
+    update/read sites makes the head-parallel layout an explicit fact
+    of the program: K/V bytes never move between devices, only the
+    (tiny, replicated) indices do. Leaves whose head count does not
+    divide the axis pass through unconstrained (replicated layouts stay
+    legal)."""
+    if mesh is None or axis not in mesh.axis_names:
+        return x
+    n = mesh.shape[axis]
+    if n <= 1 or x.shape[dim] % n != 0:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = [None] * x.ndim
+    spec[dim] = axis
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
 def sp_batch_spec(mesh, seq_axis: str, batch_size: int):

@@ -29,8 +29,28 @@ __all__ = [
     "sharded_train_state",
     "make_sharded_train_step",
     "batch_sharding",
+    "place_sharded",
     "shard_batch",
 ]
+
+
+def place_sharded(tree, shardings):
+    """Shard-then-place: ``device_put`` every host leaf **directly into
+    its mesh layout** — each device receives only its own slice of each
+    leaf, never a full replicated copy that is then resharded.
+
+    This is the cross-replica-sharding move of *Automatic Cross-Replica
+    Sharding of Weight Update* (arXiv:2004.13336) applied to weight
+    placement: for a rollout of new weights onto a ``tp``-sharded
+    serving replica the host→device traffic is ``bytes/tp`` per device
+    (bandwidth-optimal) instead of ``bytes`` per device, and no device
+    ever has to hold a whole-model replica it immediately throws away.
+    The serving engine's boot and hot-swap paths and the deploy
+    harness's canary scoring all place through this one seam.
+    ``shardings=None`` keeps the unsharded single-device behavior."""
+    if shardings is None:
+        return jax.device_put(tree)
+    return jax.device_put(tree, shardings)
 
 
 def batch_sharding(mesh: Mesh, batch_rank: int = 2, seq_dim: int | None = 1):

@@ -30,6 +30,8 @@ __all__ = [
     "make_mesh",
     "best_mesh",
     "data_parallel_shardings",
+    "parse_mesh_shape",
+    "serving_mesh",
     "shard_batch_spec",
 ]
 
@@ -72,6 +74,82 @@ def best_mesh(num_devices: int | None = None) -> Mesh:
             )
         devices = devices[:num_devices]
     return make_mesh({"dp": len(devices)}, devices=devices)
+
+
+def parse_mesh_shape(spec: str) -> dict[str, int]:
+    """Parse a CLI mesh-shape spec: ``"tp=2"``, ``"tp=2,dp=1"``, or a
+    bare integer ``"4"`` (shorthand for ``tp=4``). Raises ``ValueError``
+    on junk — the caller (``run.py serve --mesh-shape``) turns that into
+    a typed CLI error instead of a deep jax traceback."""
+    spec = (spec or "").strip()
+    if not spec:
+        raise ValueError("empty mesh shape; expected e.g. 'tp=2'")
+    if spec.isdigit():
+        return {"tp": int(spec)}
+    shape: dict[str, int] = {}
+    for part in spec.split(","):
+        name, sep, size = part.partition("=")
+        name = name.strip()
+        if not sep or not name or not size.strip().isdigit():
+            raise ValueError(
+                f"bad mesh shape {spec!r}: each comma-separated entry "
+                f"must be AXIS=N (e.g. 'tp=2'), got {part!r}")
+        if name in shape:
+            raise ValueError(f"bad mesh shape {spec!r}: axis {name!r} "
+                             f"given twice")
+        shape[name] = int(size.strip())
+    for name, size in shape.items():
+        if size < 1:
+            raise ValueError(
+                f"bad mesh shape {spec!r}: axis {name}={size} must be "
+                f">= 1")
+    return shape
+
+
+def serving_mesh(
+    shape: dict[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh for ONE GSPMD-sharded serving replica.
+
+    ``shape`` defaults to ``{"tp": <all visible devices>}`` — one big
+    tensor-parallel replica. An explicit shape must **divide the visible
+    device count** (the remainder hosts other replicas); a shape that
+    does not raises ``ValueError`` with the counts spelled out, which
+    ``run.py serve`` surfaces as a typed CLI error. Exactly the shape's
+    device-product devices are used (the first ones, in ``jax.devices()``
+    order) — a serving mesh never folds leftover devices into a hidden
+    axis the way :func:`make_mesh` folds them into ``dp``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n < 1:
+        raise ValueError("no visible devices to build a serving mesh on")
+    sizes = dict(shape) if shape else {"tp": n}
+    if "tp" not in sizes:
+        raise ValueError(
+            f"serving mesh shape {sizes} has no 'tp' axis; tensor "
+            f"parallelism is what a sharded serving replica shards over")
+    extra = {a: s for a, s in sizes.items() if a != "tp" and s > 1}
+    if extra:
+        # Rejected HERE so the CLI layer fails one typed line before a
+        # model loads (or a cluster spawns N children that would all
+        # crash-loop in the engine ctor's identical check).
+        raise ValueError(
+            f"serving mesh has non-trivial non-tp axes {extra}: data "
+            f"parallelism in serving is N replicas (run.py cluster "
+            f"--replicas), not a dp mesh axis inside one engine")
+    need = math.prod(sizes.values())
+    if need > n or n % need != 0:
+        raise ValueError(
+            f"mesh shape {sizes} needs {need} devices but {n} are "
+            f"visible ({need} must divide {n}); adjust --mesh-shape or "
+            f"force more host devices")
+    names = [a for a in AXES if a in sizes] + [
+        a for a in sizes if a not in AXES]
+    dims = [sizes[a] for a in names]
+    arr = np.array(devices[:need]).reshape(dims)
+    return Mesh(arr, axis_names=tuple(names))
 
 
 def shard_batch_spec(mesh: Mesh) -> P:

@@ -17,6 +17,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "DEFAULT_RULES",
+    "kv_pytree_shardings",
     "logical_axis_rules",
     "infer_variable_shardings",
     "replicated",
@@ -66,6 +67,35 @@ def infer_variable_shardings(mesh: Mesh, abstract_variables, overrides=None):
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def kv_pytree_shardings(mesh: Mesh, tree, axis: str = "tp"):
+    """Shardings for a decode KV cache/pool pytree: every K/V leaf is
+    sharded over its **heads** dimension, everything else replicated.
+
+    The rule is shape-driven because cache variables carry no logical-
+    axis annotations (they are created with plain ``self.variable``):
+    K/V leaves are the ``ndim >= 3`` arrays — dense per-slot caches
+    ``[B, L, H, D]``, single prefill rows ``[1, L, H, D]``, and paged
+    block pools ``[C, block_tokens, H, D]`` all keep heads at axis
+    ``-2`` — and shard only when the head count divides the mesh axis.
+    1-D index leaves (cache/pos counters) and anything else stay
+    replicated host-ish metadata, mirroring the serving engine's stance
+    that block tables and slot state are replicated while only the KV
+    bytes shard. ``tree`` may hold concrete arrays or ``eval_shape``
+    structs."""
+    n = mesh.shape.get(axis, 1)
+
+    def rule(leaf):
+        shape = getattr(leaf, "shape", ())
+        if (axis in mesh.axis_names and n > 1 and len(shape) >= 3
+                and shape[-2] % n == 0):
+            spec = [None] * len(shape)
+            spec[-2] = axis
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(rule, tree)
 
 
 def unbox(variables):

@@ -67,6 +67,71 @@ def load_data(path: str, features_col: str, label_col: str):
     )
 
 
+def _apply_force_host_devices(n: int | None) -> None:
+    """``--force-host-devices N``: expose N virtual CPU devices by
+    setting the XLA host-platform flag BEFORE jax initializes (it is
+    read once at backend init). Single-threaded Eigen rides along —
+    the virtual devices share ONE intra-op pool, and the tp all-reduces
+    a sharded engine runs every layer can deadlock the rendezvous when
+    pool-parallel kernels hold the pool (the
+    ``utils.platform.ensure_virtual_cpu_flags`` failure mode; this
+    helper replaces rather than raises the count, so it keeps its own
+    env writer). If jax already initialized at a different count — an
+    embedder imported it first — fail typed instead of silently
+    serving on the wrong device count."""
+    if not n:
+        return
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   flags)
+    flags += f" --xla_force_host_platform_device_count={int(n)}"
+    if "--xla_cpu_multi_thread_eigen" not in flags:
+        flags += " --xla_cpu_multi_thread_eigen=false"
+    os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "jax" in sys.modules:
+        import jax
+
+        # Forced HOST devices only exist on the CPU platform; pin it
+        # via jax.config (the reliable knob — an accelerator-container
+        # sitecustomize may override the JAX_PLATFORMS env var and hang
+        # in remote-backend init instead).
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backends already initialized: the count check decides
+        if len(jax.devices()) != int(n):
+            raise SystemExit(
+                f"--force-host-devices {n}: jax already initialized "
+                f"with {len(jax.devices())} device(s); set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n} in the "
+                f"environment instead")
+
+
+def _resolve_mesh(args):
+    """Build the serving mesh ``--mesh``/``--mesh-shape`` ask for, or
+    None. Bad specs and shapes that don't divide the visible device
+    count become typed CLI errors (SystemExit) here — never a deep jax
+    traceback out of the engine."""
+    if not (getattr(args, "mesh", False)
+            or getattr(args, "mesh_shape", None)):
+        return None
+    from distkeras_tpu.parallel.mesh import parse_mesh_shape, serving_mesh
+
+    shape = None
+    if args.mesh_shape:
+        try:
+            shape = parse_mesh_shape(args.mesh_shape)
+        except ValueError as e:
+            raise SystemExit(f"--mesh-shape: {e}")
+    try:
+        return serving_mesh(shape)
+    except ValueError as e:
+        raise SystemExit(f"--mesh: {e}")
+
+
 def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     """``serve`` subcommand: continuous-batching TCP server over a causal
     LM from the zoo (random-init demo weights unless --weights given).
@@ -138,6 +203,23 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                     help="serialized-pytree weights for the draft model")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per speculative tick")
+    ap.add_argument("--mesh", action="store_true",
+                    help="GSPMD tensor-parallel serving: shard the model "
+                         "and its KV (dense caches or the paged pool "
+                         "alike) over a device mesh — ONE replica spread "
+                         "over every visible device (tp=<all>), greedy "
+                         "output token-identical to the unsharded "
+                         "engine. See docs/serving.md 'Sharded serving'")
+    ap.add_argument("--mesh-shape", default=None, metavar="AXIS=N[,..]",
+                    help="explicit serving mesh shape (implies --mesh), "
+                         "e.g. 'tp=2'; the device product must divide "
+                         "the visible device count. Bare N means tp=N")
+    ap.add_argument("--force-host-devices", type=int, default=None,
+                    metavar="N",
+                    help="force the CPU host platform to expose N "
+                         "virtual devices (sets XLA_FLAGS before jax "
+                         "loads) — how a laptop/CI host runs --mesh "
+                         "without real accelerators")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=default_replicas,
                     help="> 1: start this many replica processes behind a "
@@ -204,6 +286,9 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "supervisor collects a dead replica's file into "
                          "its restart log")
     args = ap.parse_args(argv)
+    # BEFORE anything imports jax: the forced-device-count XLA flag is
+    # read once at backend init, so it must hit the environment first.
+    _apply_force_host_devices(args.force_host_devices)
     if args.replicas > 1:
         return cluster_main(args)
 
@@ -218,6 +303,7 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     from distkeras_tpu.telemetry import MetricsRegistry
 
     tracer = enable_tracing() if args.trace_out else None
+    mesh = _resolve_mesh(args)
     model = load_model(args.model, json.loads(args.model_args))
     variables = model.init(args.seed)
     weight_version = None
@@ -298,7 +384,7 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         kv_block_tokens=args.kv_block_tokens,
         max_context=args.max_context,
         draft_model=draft_model, draft_variables=draft_variables,
-        spec_k=args.spec_k,
+        spec_k=args.spec_k, mesh=mesh,
         trace_store=trace_store, flight_recorder=recorder,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None,
         weight_version=weight_version)
@@ -318,6 +404,7 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                                if engine.kv_pool is not None else 0),
             "draft_model": args.draft_model,
             "spec_k": args.spec_k if args.draft_model else 0,
+            "mesh": engine.mesh_info(),
         }), flush=True)
         # Signal-driven shutdown INSIDE the loop: a raw KeyboardInterrupt
         # out of asyncio.run would cancel the engine task before the
@@ -401,6 +488,17 @@ def _serving_config_flags(args) -> list[str]:
                   "--spec-k", str(args.spec_k)]
         if args.draft_weights:
             extra += ["--draft-weights", args.draft_weights]
+    # Sharded serving: every replica child builds the same mesh. The
+    # forced-device-count flag rides along so a child process sees the
+    # same virtual device world its parent validated against (parent
+    # XLA_FLAGS inherit anyway; the explicit flag keeps a copied command
+    # line self-contained).
+    if getattr(args, "mesh_shape", None):
+        extra += ["--mesh-shape", str(args.mesh_shape)]
+    elif getattr(args, "mesh", False):
+        extra += ["--mesh"]
+    if getattr(args, "force_host_devices", None):
+        extra += ["--force-host-devices", str(args.force_host_devices)]
     return extra
 
 
@@ -413,6 +511,11 @@ def cluster_main(args) -> int:
     import asyncio
     import signal
     import tempfile
+
+    # Typed mesh validation in the PARENT: a bad --mesh-shape must fail
+    # the cluster command with one clear line, not N crash-looping
+    # replica children. (The children re-validate on their own devices.)
+    _resolve_mesh(args)
 
     from distkeras_tpu.serving.cluster import ProcessReplica, ServingCluster
     from distkeras_tpu.telemetry import MetricsRegistry
@@ -578,6 +681,18 @@ def deploy_main(argv=None) -> int:
     ap.add_argument("--draft-args", default="{}")
     ap.add_argument("--draft-weights", default=None)
     ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--mesh", action="store_true",
+                    help="replicas serve GSPMD tensor-parallel over "
+                         "every visible device (see serve --mesh); the "
+                         "canary validates candidates under the same "
+                         "sharded config production runs")
+    ap.add_argument("--mesh-shape", default=None, metavar="AXIS=N[,..]",
+                    help="explicit per-replica serving mesh shape "
+                         "(implies --mesh), e.g. 'tp=2'")
+    ap.add_argument("--force-host-devices", type=int, default=None,
+                    metavar="N",
+                    help="expose N virtual CPU devices to every replica "
+                         "(CI / laptop sharded-fleet runs)")
     ap.add_argument("--golden", type=int, default=4,
                     help="golden prompt count the canary replica must "
                          "serve (twice each, identical greedy output, "
@@ -603,6 +718,10 @@ def deploy_main(argv=None) -> int:
                     help="repeatable; extra env per replica child, {i} "
                          "expands to the index (device partitioning)")
     args = ap.parse_args(argv)
+    _apply_force_host_devices(args.force_host_devices)
+    # Typed parent-side validation; the controller also scores golden
+    # batches under this mesh (shard-then-place) when the fleet shards.
+    deploy_mesh = _resolve_mesh(args)
 
     import asyncio
     import signal
@@ -673,7 +792,7 @@ def deploy_main(argv=None) -> int:
             vocab=model.output_dim, golden_count=args.golden,
             golden_len=args.golden_len,
             golden_new_tokens=args.golden_new_tokens, seed=args.seed,
-            registry=registry,
+            registry=registry, mesh=deploy_mesh,
             canary_latency_s=args.canary_latency_ms / 1e3,
             poll_interval_s=args.poll_ms / 1e3,
             initial_weights=boot_weights)

@@ -61,6 +61,12 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
     if isinstance(wv, dict):
         lines.append(f"{indent}weights: v{wv.get('version')} "
                      f"digest={wv.get('digest') or '-'}")
+    mesh = dz.get("mesh")
+    if isinstance(mesh, dict):
+        axes = ",".join(f"{a}={s}" for a, s in
+                        (mesh.get("axes") or {}).items())
+        lines.append(f"{indent}mesh: {axes or '-'} over "
+                     f"{len(mesh.get('devices') or [])} device(s)")
     slots = dz.get("slots", [])
     if slots:
         lines.append(f"{indent}slots:")

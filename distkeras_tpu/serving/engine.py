@@ -81,6 +81,37 @@ Per-request sampling: ``temperature <= 0`` rows take the argmax branch
 inside the same compiled step (a ``jnp.where`` select, not a retrace), so
 greedy and sampled requests coexist in one batch. ``top_k`` is
 engine-wide static config.
+
+**Sharded serving** (``mesh``): hand the engine a
+:func:`distkeras_tpu.parallel.mesh.serving_mesh` and ONE replica runs
+the model GSPMD-sharded over the mesh's ``tp`` axis — models bigger
+than one chip, served by the same engine:
+
+- params are laid out per their logical-axis annotations
+  (:func:`distkeras_tpu.parallel.sharding.infer_variable_shardings`) and
+  **placed shard-then-place** (:func:`...gspmd.place_sharded`): each
+  device receives only its slice, at boot and at every hot swap — the
+  arXiv:2004.13336 move applied to weight rollout;
+- the KV bytes — dense per-slot caches and the paged block pools alike
+  — shard over the **heads** dimension
+  (:func:`...sharding.kv_pytree_shardings`), while block tables, slot
+  state, the scheduler, and every index stay replicated host metadata
+  (the paged refactor is what makes this split clean: the pool's
+  *meaning* was already host-side bookkeeping);
+- every compiled callable — prefill, decode, draft, verify, fallback —
+  is jitted with **explicit ``in_shardings``/``out_shardings``**, so
+  layouts are pinned facts of each executable (stable across calls =
+  still exactly ONE executable per callable under the armed auditor)
+  rather than per-call propagation guesses;
+- greedy output stays **token-identical** to the unsharded engine: the
+  only tensor-parallel cross-device reductions (attention out-proj,
+  mlp_out) keep float32 partial sums until after the all-reduce
+  (``models.bert._F32AccumDense``), so layout noise stays far below the
+  bf16 resolution ``greedy_ids`` quantizes to.
+
+The draft model of a speculative engine stays **replicated** — it is
+small by definition, and replicating it trades a little memory for zero
+collectives in the latency-critical draft scan.
 """
 
 from __future__ import annotations
@@ -550,6 +581,16 @@ class ServingEngine:
     the draft is engine-lifetime config, and a stale draft can only
     lower the accept rate, never change committed output.
 
+    ``mesh``: a :func:`distkeras_tpu.parallel.mesh.serving_mesh` turns
+    this ONE engine into a GSPMD tensor-parallel replica (see the
+    module docstring): params laid out per their logical axes, KV
+    leaves heads-sharded, tables/slot/scheduler state replicated host
+    metadata, every compiled callable pinned to explicit in/out
+    shardings. The model's ``num_heads``/``mlp_dim``/``vocab_size``
+    must divide the mesh's ``tp`` axis (validated here, typed). Greedy
+    output is token-identical to the unsharded engine; hot swaps place
+    candidate weights shard-then-place (bytes/tp per device).
+
     Observability (all default-off; see :mod:`distkeras_tpu.telemetry`):
     ``trace_store`` keeps per-request timeline records queryable by
     trace_id (the ``tracez`` verb); ``flight_recorder`` keeps a bounded
@@ -590,6 +631,7 @@ class ServingEngine:
         draft_model=None,
         draft_variables=None,
         spec_k: int = 4,
+        mesh=None,
         trace_store: TraceStore | None = None,
         flight_recorder: FlightRecorder | None = None,
         slo_s: float | None = None,
@@ -610,9 +652,44 @@ class ServingEngine:
             raise ValueError("draft_model needs draft_variables (the draft's "
                              "trained weights)")
         self._paged = bool(paged or kv_pool_mb > 0 or kv_pool_blocks)
+        # GSPMD-sharded serving: ONE replica spread over a device mesh's
+        # "tp" axis. Validated up front — a bad mesh must be a typed
+        # ValueError here, not a jax lowering error three layers down.
+        self.mesh = mesh
+        self._tp = 1
+        self._replicated = None
+        self._param_shardings = None
+        self._cache_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if "tp" not in mesh.axis_names:
+                raise ValueError(
+                    f"serving mesh {dict(mesh.shape)} has no 'tp' axis; "
+                    f"build it with parallel.mesh.serving_mesh")
+            self._tp = int(mesh.shape["tp"])
+            extra = {a: s for a, s in mesh.shape.items()
+                     if a != "tp" and s > 1}
+            if extra:
+                raise ValueError(
+                    f"serving mesh has non-trivial non-tp axes {extra}: "
+                    f"data parallelism in serving is N replicas (run.py "
+                    f"cluster), not a dp mesh axis inside one engine")
+            self._replicated = NamedSharding(mesh, P())
         # Geometry probe: the plain decode-slots config, for the trained
         # context limit and (paged) the per-token KV byte cost.
         base_module, base_cfg = _decode_module(model, slots=True)
+        if mesh is not None and self._tp > 1:
+            bad = [f"{name}={val}" for name, val in (
+                ("num_heads", base_cfg.num_heads),
+                ("mlp_dim", base_cfg.mlp_dim),
+                ("vocab_size", base_cfg.vocab_size),
+            ) if val % self._tp]
+            if bad:
+                raise ValueError(
+                    f"model {getattr(model, 'name', model)!r} does not "
+                    f"shard over tp={self._tp}: {', '.join(bad)} not "
+                    f"divisible — pick a tp that divides all three")
         base_limit = _context_limit(model, base_cfg)
         if max_context is not None:
             if not 1 <= max_context <= base_limit:
@@ -656,7 +733,7 @@ class ServingEngine:
                     f"bytes)")
             self._module, self._cfg = _decode_module(
                 model, slots=True, paged_blocks=capacity, page_tokens=bt,
-                page_table_blocks=table_blocks)
+                page_table_blocks=table_blocks, tp_mesh=mesh)
             # Prefill pad-width bound. NOT the table reach (table_blocks
             # * bt, which rounds UP past the context when bt doesn't
             # divide it): a pad width past max_seq_len would make the
@@ -687,7 +764,7 @@ class ServingEngine:
                 overrides["decode_cache_len"] = dense_len + (
                     self.spec_k if self._spec else 0)
             self._module, self._cfg = _decode_module(
-                model, slots=True, **overrides)
+                model, slots=True, tp_mesh=mesh, **overrides)
             # Prefill pad-width bound: the REQUEST context, not the
             # spec-extended cache — prefill programs stay identical to a
             # non-speculating engine's.
@@ -705,7 +782,30 @@ class ServingEngine:
         # device_puts) then RETRACED the decode step: numpy and jax.Array
         # arguments occupy different jit-cache entries. One transfer at
         # construction makes boot and swap paths aval-identical.
-        self._params = jax.device_put(variables["params"])
+        #
+        # Sharded: the params' mesh layout comes from the model's
+        # logical-axis annotations resolved against the mesh
+        # (infer_variable_shardings), and boot goes through the SAME
+        # shard-then-place seam every later hot swap uses — each device
+        # is sent its slice directly, never a full replicated copy.
+        if mesh is not None:
+            from distkeras_tpu.parallel.sharding import (
+                infer_variable_shardings,
+                kv_pytree_shardings,
+            )
+
+            abstract = jax.eval_shape(
+                lambda r: self._module.init(
+                    r, jnp.zeros((int(slots), 1), jnp.int32), train=False),
+                jax.random.PRNGKey(0))
+            self._param_shardings = infer_variable_shardings(
+                mesh, abstract)["params"]
+            self._cache_shardings = kv_pytree_shardings(
+                mesh, abstract["cache"])
+        from distkeras_tpu.parallel.gspmd import place_sharded
+
+        self._params = place_sharded(variables["params"],
+                                     self._param_shardings)
         self.slots = int(slots)
         self.metrics = metrics or ServingMetrics()
         self.scheduler = Scheduler(max_depth=max_queue,
@@ -719,9 +819,22 @@ class ServingEngine:
         # SHARED block pools (per-layer [capacity, bt, H, D] leaves, no
         # per-slot index leaves — positions/tables are passed per call);
         # in dense mode, the classic [slots, L, H, D] per-slot caches.
+        # Sharded: the KV leaves are committed to their heads-sharded
+        # layout at creation, and every compiled program's out_shardings
+        # pins the same layout, so the bytes never migrate.
         self._cache = _empty_cache(self._module, self.slots)
         self._tokens = jnp.zeros((self.slots,), jnp.int32)
         self._temps = jnp.zeros((self.slots,), jnp.float32)
+        if mesh is not None:
+            # Commit the rebound state to its layout NOW: jit cache
+            # entries key on the actual argument shardings, so a warmup
+            # or swap-rewarm tick on ctor-fresh (uncommitted) tokens
+            # would occupy a DIFFERENT executable than every post-
+            # admission tick on committed jit outputs — two compiles of
+            # one program, which the armed auditor rightly refuses.
+            self._cache = jax.device_put(self._cache, self._cache_shardings)
+            self._tokens = jax.device_put(self._tokens, self._replicated)
+            self._temps = jax.device_put(self._temps, self._replicated)
         self._slot_state: list[_SlotState | None] = [None] * self.slots
 
         self.kv_pool: KVBlockPool | None = None
@@ -774,20 +887,35 @@ class ServingEngine:
                     r, jnp.zeros((1, 1), jnp.int32), train=False),
                 jax.random.PRNGKey(0),
             )["cache"]
-            self._fresh_row_cache = jax.jit(lambda: jax.tree.map(
-                lambda s: jnp.zeros(s.shape, s.dtype), self._row_shapes))
+            self._row_shardings = None
+            if mesh is not None:
+                from distkeras_tpu.parallel.sharding import (
+                    kv_pytree_shardings,
+                )
+
+                self._row_shardings = kv_pytree_shardings(
+                    mesh, self._row_shapes)
+            self._fresh_row_cache = jax.jit(
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype),
+                    self._row_shapes),
+                **({} if mesh is None
+                   else {"out_shardings": self._row_shardings}))
 
             # Prefix cache: a byte-budgeted pool of KV blocks shared
             # across requests (serving/prefix_cache.py). An explicit
             # instance wins (tests / multi-engine sharing);
-            # prefix_cache_mb > 0 builds one.
+            # prefix_cache_mb > 0 builds one. Sharded engines hand the
+            # mesh down so the cache's device pools (and the rows its
+            # materialize builds) live in the same heads-sharded layout
+            # the batch cache does.
             if prefix_cache is not None:
                 self.prefix_cache = prefix_cache
             elif prefix_cache_mb > 0:
                 self.prefix_cache = PrefixCache(
                     self._row_shapes, block_tokens=prefix_block_tokens,
                     budget_bytes=int(prefix_cache_mb * 2**20),
-                    registry=self.metrics.registry)
+                    registry=self.metrics.registry, mesh=mesh)
             else:
                 self.prefix_cache = None
             if self.prefix_cache is not None:
@@ -813,16 +941,30 @@ class ServingEngine:
                     f"draft model vocab {self._draft_cfg.vocab_size} != "
                     f"target vocab {self._cfg.vocab_size}: draft proposals "
                     "must be target token ids")
-            self._draft_params = jax.device_put(draft_variables["params"])
+            # Sharded engines REPLICATE the draft (params and cache):
+            # the draft is small by definition — gpt_tiny drafting for
+            # gpt_small — so replication buys a collective-free draft
+            # scan on the latency-critical path for a memory cost that
+            # is noise next to the sharded target.
+            self._draft_params = (
+                jax.device_put(draft_variables["params"])
+                if mesh is None else
+                jax.device_put(draft_variables["params"], self._replicated))
             self._draft_cache = _empty_cache(self._draft_module, self.slots)
+            if mesh is not None:
+                self._draft_cache = jax.device_put(self._draft_cache,
+                                                   self._replicated)
             self._draft_row_shapes = jax.eval_shape(
                 lambda r: self._draft_module.init(
                     r, jnp.zeros((1, 1), jnp.int32), train=False),
                 jax.random.PRNGKey(0),
             )["cache"]
-            self._fresh_draft_row = jax.jit(lambda: jax.tree.map(
-                lambda s: jnp.zeros(s.shape, s.dtype),
-                self._draft_row_shapes))
+            self._fresh_draft_row = jax.jit(
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype),
+                    self._draft_row_shapes),
+                **({} if mesh is None
+                   else {"out_shardings": self._replicated}))
             # Host-side fed-token counts (int32 [slots], DENSE mode):
             # the per-row position the draft's entry rewind and the
             # dense verify's index rewind both derive from. Paged mode
@@ -843,41 +985,74 @@ class ServingEngine:
         # prefill's incoming cache (single-row scratch in dense mode, the
         # shared pools in paged mode) is donated too: a chunk chain
         # threads it through every call, updating in place.
+        # Sharded engines jit every callable with EXPLICIT in_shardings/
+        # out_shardings: params in their logical-axis layout, KV leaves
+        # heads-sharded, every index/token/table operand replicated. The
+        # pinned layouts are part of each executable's signature — stable
+        # across calls, so "exactly one executable per callable" survives
+        # the mesh — and out_shardings guarantees the rebind-from-output
+        # state (cache, tokens) never drifts off its layout.
+        def _sharded_jit(fn, in_sh, out_sh, donate):
+            if self.mesh is None:
+                return jax.jit(fn, donate_argnums=donate)
+            return jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh,
+                           donate_argnums=donate)
+
+        rep = self._replicated
+        psh = self._param_shardings
+        csh = self._cache_shardings
         if self._paged:
-            self._prefill = jax.jit(
+            self._prefill = _sharded_jit(
                 functools.partial(_paged_prefill_fn, self._module, top_k),
-                donate_argnums=(1,))
-            self._admit_jit = jax.jit(_paged_admit_fn,
-                                      donate_argnums=(0, 1))
-            self._decode_step = jax.jit(
+                (psh, csh, rep, rep, rep, rep, rep, rep), (csh, rep),
+                donate=(1,))
+            self._admit_jit = _sharded_jit(
+                _paged_admit_fn,
+                (rep, rep, rep, rep, rep), (rep, rep), donate=(0, 1))
+            self._decode_step = _sharded_jit(
                 functools.partial(_paged_decode_fn, self._module, top_k),
-                donate_argnums=(1, 2))
+                (psh, csh, rep, rep, rep, rep, rep), (csh, rep),
+                donate=(1, 2))
         else:
-            self._prefill = jax.jit(
+            rsh = self._row_shardings
+            self._prefill = _sharded_jit(
                 functools.partial(_prefill_fn, self._module, top_k),
-                donate_argnums=(1,))
-            self._admit_jit = jax.jit(_admit_fn, donate_argnums=(0, 1, 2))
-            self._decode_step = jax.jit(
+                (psh, rsh, rep, rep, rep, rep, rep), (rsh, rep),
+                donate=(1,))
+            self._admit_jit = _sharded_jit(
+                _admit_fn,
+                (csh, rep, rep, rep, rsh, rep, rep), (csh, rep, rep),
+                donate=(0, 1, 2))
+            self._decode_step = _sharded_jit(
                 functools.partial(_decode_fn, self._module, top_k),
-                donate_argnums=(1, 2))
+                (psh, csh, rep, rep, rep), (csh, rep), donate=(1, 2))
         if self._spec:
             # Draft cache donated; tokens are NOT (the verify consumes
             # them right after). Verify donates cache + tokens exactly
-            # like the fallback decode step it substitutes for.
-            self._draft_step = jax.jit(
+            # like the fallback decode step it substitutes for. The
+            # draft trio runs fully replicated on a sharded engine.
+            self._draft_step = _sharded_jit(
                 functools.partial(_spec_draft_fn, self._draft_module,
                                   self.spec_k),
-                donate_argnums=(1,))
-            verify = (_paged_spec_verify_fn if self._paged
-                      else _spec_verify_fn)
-            self._verify_step = jax.jit(
-                functools.partial(verify, self._module, top_k),
-                donate_argnums=(1, 2))
-            self._draft_prefill = jax.jit(
+                (rep, rep, rep, rep, rep), (rep, rep), donate=(1,))
+            if self._paged:
+                self._verify_step = _sharded_jit(
+                    functools.partial(_paged_spec_verify_fn, self._module,
+                                      top_k),
+                    (psh, csh, rep, rep, rep, rep, rep, rep, rep, rep,
+                     rep),
+                    (csh, rep, rep, rep), donate=(1, 2))
+            else:
+                self._verify_step = _sharded_jit(
+                    functools.partial(_spec_verify_fn, self._module,
+                                      top_k),
+                    (psh, csh, rep, rep, rep, rep, rep, rep, rep),
+                    (csh, rep, rep, rep), donate=(1, 2))
+            self._draft_prefill = _sharded_jit(
                 functools.partial(_draft_prefill_fn, self._draft_module),
-                donate_argnums=(1,))
-            self._draft_admit = jax.jit(_draft_admit_fn,
-                                        donate_argnums=(0,))
+                (rep, rep, rep, rep, rep), rep, donate=(1,))
+            self._draft_admit = _sharded_jit(
+                _draft_admit_fn, (rep, rep, rep), rep, donate=(0,))
 
         # Recompile auditing: the compile-count==1 decode invariant as a
         # RUNTIME check, not just a benchmark assertion. The auditor wraps
@@ -973,13 +1148,49 @@ class ServingEngine:
             return self.auditor.compiles("serving_decode")
         return -1
 
+    def mesh_info(self) -> dict | None:
+        """Static view of the engine's device mesh for healthz/debugz:
+        axis sizes and the per-shard device names — None unsharded, so
+        consumers (router rollups, the deploy controller's fleet verify)
+        can tell a sharded replica from a plain one at a glance."""
+        if self.mesh is None:
+            return None
+        from distkeras_tpu.telemetry.device import _device_name
+
+        return {
+            "axes": {a: int(s) for a, s in self.mesh.shape.items()},
+            "tp": self._tp,
+            "devices": [_device_name(d)
+                        for d in self.mesh.devices.flatten()],
+        }
+
+    def _bytes_by_device(self, tree) -> dict[str, int]:
+        """Per-device resident bytes of a (possibly sharded) pytree —
+        what makes a sharded engine's params/KV attributable per shard
+        instead of one engine-wide number. Host metadata only (shard
+        shapes), no device sync."""
+        from distkeras_tpu.telemetry.device import _device_name
+
+        out: dict[str, int] = {}
+        for leaf in jax.tree.leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is None:
+                continue
+            for s in shards:
+                name = _device_name(s.device)
+                out[name] = out.get(name, 0) + int(
+                    np.prod(s.data.shape) * s.data.dtype.itemsize)
+        return out
+
     def refresh_memory_metrics(self) -> list[dict]:
         """Probe per-device ``memory_stats()`` (typed sentinel — a
         backend without the API publishes ``available=0``, never a fake
         0 bytes), publish the gauges plus this engine's workload-side
         bytes (params, KV pool reserved/peak), and return the per-device
-        rows for healthz. Host-only; called per metricsz/healthz scrape,
-        never on the decode path."""
+        rows for healthz. Sharded engines additionally publish the
+        params/KV bytes PER MESH DEVICE (labeled gauges + per-row
+        fields), so each shard's footprint is attributable. Host-only;
+        called per metricsz/healthz scrape, never on the decode path."""
         from distkeras_tpu.telemetry.device import publish_memory_gauges
 
         kv_bytes = kv_peak = None
@@ -987,15 +1198,34 @@ class ServingEngine:
             kv_bytes = self.kv_pool.capacity * self.kv_pool.bytes_per_block
             kv_peak = (self.kv_pool.peak_blocks_used
                        * self.kv_pool.bytes_per_block)
+        params_by_dev = kv_by_dev = None
+        if self.mesh is not None:
+            try:
+                params_by_dev = self._bytes_by_device(self._params)
+                # KV leaves live in the engine's cache pytree in BOTH
+                # modes (paged pools and dense per-slot caches alike).
+                kv_by_dev = self._bytes_by_device(self._cache)
+            except Exception:
+                params_by_dev = kv_by_dev = None
         try:
             mems = publish_memory_gauges(
                 self.metrics.registry,
                 params_bytes=self._params_bytes,
                 kv_pool_bytes=kv_bytes,
-                kv_pool_peak_bytes=kv_peak)
+                kv_pool_peak_bytes=kv_peak,
+                params_bytes_by_device=params_by_dev,
+                kv_bytes_by_device=kv_by_dev)
         except Exception:
             return []
-        return [m.to_dict() for m in mems]
+        rows = [m.to_dict() for m in mems]
+        if params_by_dev or kv_by_dev:
+            for row in rows:
+                dev = row.get("device")
+                if params_by_dev and dev in params_by_dev:
+                    row["params_bytes"] = params_by_dev[dev]
+                if kv_by_dev and dev in kv_by_dev:
+                    row["kv_bytes"] = kv_by_dev[dev]
+        return rows
 
     @property
     def active_slots(self) -> int:
@@ -1058,6 +1288,8 @@ class ServingEngine:
             "decode_compile_count": self.decode_compile_count(),
             "weight_version": self.weight_version,
         }
+        if self.mesh is not None:
+            out["mesh"] = self.mesh_info()
         if self._spec:
             drafted = self.metrics.spec_draft_tokens
             out["speculative"] = {
@@ -1239,8 +1471,16 @@ class ServingEngine:
         return False
 
     def _swap_sync(self, params) -> None:
-        """Executor-thread half of the swap: transfer, flush, rewarm."""
-        params = jax.device_put(params)
+        """Executor-thread half of the swap: transfer, flush, rewarm.
+
+        Sharded engines place the candidate SHARD-THEN-PLACE: each host
+        leaf is sliced straight into its mesh layout, so a rolling
+        weight update to a tp-sharded replica transfers bytes/tp per
+        device and never materializes a replicated copy per device —
+        the arXiv:2004.13336 move applied to weight rollout."""
+        from distkeras_tpu.parallel.gspmd import place_sharded
+
+        params = place_sharded(params, self._param_shardings)
         jax.block_until_ready(params)
         self._params = params
         if self.prefix_cache is not None:

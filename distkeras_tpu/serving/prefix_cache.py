@@ -379,10 +379,17 @@ class PrefixCache(_BlockTrie):
     ``registry``: optional :class:`~distkeras_tpu.telemetry.registry.
     MetricsRegistry` — hit/miss/eviction counters and occupancy gauges
     for ``metricsz``.
+    ``mesh``: a serving mesh (GSPMD tensor-parallel engine) — the block
+    pools are then allocated heads-sharded over the mesh's ``tp`` axis
+    (:func:`distkeras_tpu.parallel.sharding.kv_pytree_shardings`, the
+    same rule the engine applies to its batch cache) and the rows
+    ``materialize``/``splice`` build are pinned to the engine's sharded
+    row layout — a cache hit never moves KV bytes between devices, only
+    row ids. Trie/allocator state is host bookkeeping either way.
     """
 
     def __init__(self, template, *, block_tokens: int = 16,
-                 budget_bytes: int = 64 * 2**20, registry=None):
+                 budget_bytes: int = 64 * 2**20, registry=None, mesh=None):
         if block_tokens < 1:
             raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
         kv_leaves = [a for a in jax.tree.leaves(template) if a.ndim > 1]
@@ -402,6 +409,7 @@ class PrefixCache(_BlockTrie):
                 f"budget_bytes={budget_bytes} holds zero blocks "
                 f"(one block = {self.bytes_per_block} bytes)")
         self._init_trie(capacity, block_tokens)
+        self.mesh = mesh
         self._pool = jax.tree.map(
             lambda a: (jnp.zeros((0,), jnp.int32) if a.ndim == 1 else
                        jnp.zeros((self.capacity, self.block_tokens)
@@ -409,15 +417,25 @@ class PrefixCache(_BlockTrie):
             template)
         self._row_shapes = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), template)
+        pool_sh = row_sh = None
+        if mesh is not None:
+            from distkeras_tpu.parallel.sharding import kv_pytree_shardings
+
+            pool_sh = kv_pytree_shardings(mesh, self._pool)
+            row_sh = kv_pytree_shardings(mesh, self._row_shapes)
+            self._pool = jax.device_put(self._pool, pool_sh)
         self._store = jax.jit(
             functools.partial(_store_fn, self.block_tokens),
-            donate_argnums=(0,))
+            donate_argnums=(0,),
+            **({} if mesh is None else {"out_shardings": pool_sh}))
         self._splice = jax.jit(
             functools.partial(_splice_fn, self.block_tokens),
-            donate_argnums=(0,))  # the cache being built; the pool persists
+            donate_argnums=(0,),  # the cache being built; the pool persists
+            **({} if mesh is None else {"out_shardings": row_sh}))
         self._materialize = jax.jit(
             functools.partial(_materialize_fn, self.block_tokens,
-                              self._row_shapes))
+                              self._row_shapes),
+            **({} if mesh is None else {"out_shardings": row_sh}))
         if registry is not None:
             self._metrics = _register_trie_metrics(registry)
             self._metrics["capacity"].set(self.capacity)

@@ -207,6 +207,12 @@ class ServingServer:
                 "weight_version": engine.weight_version,
                 "device_memory": engine.refresh_memory_metrics(),
             }
+            mesh = engine.mesh_info()
+            if mesh is not None:
+                # Sharded replica: axis sizes + shard devices, so fleet
+                # healthz rollups (and the deploy controller's verify)
+                # can spot a mixed-mesh fleet without an extra verb.
+                health["mesh"] = mesh
             if engine.prefix_cache is not None:
                 health["prefix_cache"] = engine.prefix_cache.stats()
             if engine.kv_pool is not None:

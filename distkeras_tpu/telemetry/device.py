@@ -126,6 +126,8 @@ def publish_memory_gauges(
     params_bytes: int | None = None,
     kv_pool_bytes: int | None = None,
     kv_pool_peak_bytes: int | None = None,
+    params_bytes_by_device: dict | None = None,
+    kv_bytes_by_device: dict | None = None,
 ) -> list[DeviceMemory]:
     """Publish per-device memory gauges (and the caller's workload-side
     byte counts) into ``registry``; returns the probed list so callers
@@ -137,6 +139,14 @@ def publish_memory_gauges(
     ``device_memory_headroom_bytes`` / ``device_peak_bytes_in_use``) are
     set only when the backend reports them, so an unavailable backend
     shows NO byte series rather than a flat 0.
+
+    ``params_bytes_by_device`` / ``kv_bytes_by_device``: device-name →
+    resident-bytes maps from a GSPMD-sharded workload (the serving
+    engine computes them from its arrays' addressable shards) —
+    published as ``model_params_bytes{device=...}`` /
+    ``kv_pool_reserved_bytes{device=...}`` labeled series so a sharded
+    engine's params/KV footprint is attributable per shard, alongside
+    the unlabeled engine-wide totals.
     """
     mems = all_device_memory(devices)
     for mem in mems:
@@ -173,6 +183,18 @@ def publish_memory_gauges(
             "kv_pool_peak_bytes",
             help="high-water bytes of KV blocks in use").set(
                 kv_pool_peak_bytes)
+    for name, help_, by_dev in (
+        ("model_params_bytes",
+         "bytes of the live model parameters resident on this device "
+         "(sharded engines: one series per mesh device)",
+         params_bytes_by_device),
+        ("kv_pool_reserved_bytes",
+         "bytes of KV cache/pool resident on this device (sharded "
+         "engines: one series per mesh device)", kv_bytes_by_device),
+    ):
+        if by_dev:
+            for dev, nbytes in sorted(by_dev.items()):
+                registry.gauge(name, help=help_, device=dev).set(nbytes)
     return mems
 
 

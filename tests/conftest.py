@@ -11,25 +11,29 @@ import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("KERAS_BACKEND", "jax")
-# The suite is written against exactly 8 virtual devices; replace any
-# pre-existing count rather than deferring to it.
+# The suite is written against 8 virtual devices by default; replace any
+# pre-existing count rather than deferring to it. DISTKERAS_FORCE_DEVICES
+# overrides the count for lane variants (the CI sharded-serving job runs
+# the mesh parity suite on a 4-device host platform; device-count-
+# sensitive tests read len(jax.devices()) instead of assuming 8).
+_n_devices = int(os.environ.get("DISTKERAS_FORCE_DEVICES", "8"))
 _flags = os.environ.get("XLA_FLAGS", "")
 _flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
-# Single-threaded Eigen: the 8 virtual devices share one intra-op pool,
+# Single-threaded Eigen: the virtual devices share one intra-op pool,
 # and pool-parallel kernels inside collective programs can deadlock the
 # all-reduce rendezvous (see utils/platform.ensure_virtual_cpu_flags).
 os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=8"
+    _flags + f" --xla_force_host_platform_device_count={_n_devices}"
     " --xla_cpu_multi_thread_eigen=false"
 ).strip()
 
 # The container's axon sitecustomize force-selects the TPU platform even
 # when JAX_PLATFORMS=cpu is in the environment; the config update below is
-# what actually pins tests to the 8 virtual CPU devices.
+# what actually pins tests to the virtual CPU devices.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.devices()) == _n_devices, jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -234,6 +234,34 @@ def test_check_bench_regression_speculative_rows_direction(
         "serving/spec_gpt_tiny/slots4/k4/closed/spec_accept_rate")
 
 
+def test_check_bench_regression_sharded_rows_direction(tmp_path, capsys):
+    """serving/sharded_* rows (serving_bench --mesh --record-history)
+    ride the strict serving/ gate with the standard directions: goodput
+    regresses DOWN, latency percentiles UP."""
+    import json as _json
+
+    from scripts import check_bench_regression as cbr
+
+    path = tmp_path / "bench_history.json"
+    path.write_text(_json.dumps({
+        "serving/sharded_gpt_tiny_tp2/slots4/closed/goodput_tokens_per_sec":
+            {"value": 20.0, "when": "2026-08-04T00:00:01Z",
+             "prev": [{"value": 40.0, "when": "2026-08-01T00:00:00Z"}]},
+        "serving/sharded_gpt_tiny_tp2/slots4/closed/ttft_p50_s":
+            {"value": 0.02, "when": "2026-08-04T00:00:02Z",
+             "prev": [{"value": 0.04, "when": "2026-08-01T00:00:00Z"}]},
+    }))
+    rc = cbr.main(["--history", str(path), "--all", "--strict",
+                   "--only", "serving/"])
+    out = capsys.readouterr().out
+    assert rc == 1  # the goodput halving fires the strict gate
+    assert ("[REGRESSION] serving/sharded_gpt_tiny_tp2/slots4/closed/"
+            "goodput_tokens_per_sec") in out
+    # TTFT halved = improvement for a lower-is-better metric.
+    assert ("[ok] serving/sharded_gpt_tiny_tp2/slots4/closed/"
+            "ttft_p50_s") in out
+
+
 def test_check_bench_regression_skips_unusable_rows(tmp_path):
     from scripts import check_bench_regression as cbr
 

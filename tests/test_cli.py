@@ -130,8 +130,59 @@ def test_deploy_and_serve_parsers_accept_serving_config(capsys):
         for flag in ("--draft-model", "--draft-args", "--spec-k",
                      "--paged", "--kv-pool-mb", "--kv-block-tokens",
                      "--prefill-chunk", "--prefix-cache-mb",
-                     "--max-context"):
+                     "--max-context", "--mesh", "--mesh-shape",
+                     "--force-host-devices"):
             assert flag in text, (main_fn.__name__, flag)
+
+
+def test_serve_mesh_shape_typed_cli_errors():
+    """A --mesh-shape that can't parse, or whose device product does
+    not divide the visible device count, must die as ONE typed CLI
+    line (SystemExit) before any server/engine work — never a deep jax
+    traceback."""
+    import jax
+    import pytest as _pytest
+
+    from distkeras_tpu.run import serve_main
+
+    n = len(jax.devices())
+    with _pytest.raises(SystemExit) as e:
+        serve_main(["--mesh-shape", f"tp={n + 1}", "--port", "0"])
+    assert "divide" in str(e.value) and "--mesh" in str(e.value)
+    with _pytest.raises(SystemExit) as e:
+        serve_main(["--mesh-shape", "tp=banana", "--port", "0"])
+    assert "--mesh-shape" in str(e.value)
+    # A mesh shape with no tp axis is equally typed.
+    with _pytest.raises(SystemExit) as e:
+        serve_main(["--mesh-shape", "dp=1", "--port", "0"])
+    assert "tp" in str(e.value)
+
+
+def test_mesh_flags_forwarded_to_replicas():
+    """Cluster/deploy children must inherit the parent's sharding ask:
+    the shared flag builder forwards --mesh/--mesh-shape (and the
+    forced device count) to every replica."""
+    import argparse
+
+    from distkeras_tpu.run import _serving_config_flags
+
+    base = dict(
+        top_k=None, prefill_chunk=None, prefix_cache_mb=0.0,
+        prefix_block=16, paged=False, kv_pool_mb=0.0, kv_block_tokens=16,
+        max_context=None, draft_model=None, draft_args="{}",
+        draft_weights=None, spec_k=4)
+    shaped = argparse.Namespace(**base, mesh=False, mesh_shape="tp=2",
+                                force_host_devices=2)
+    flags = " ".join(_serving_config_flags(shaped))
+    assert "--mesh-shape tp=2" in flags
+    assert "--force-host-devices 2" in flags
+    bare = argparse.Namespace(**base, mesh=True, mesh_shape=None,
+                              force_host_devices=None)
+    flags = _serving_config_flags(bare)
+    assert "--mesh" in flags and "--mesh-shape" not in flags
+    plain = argparse.Namespace(**base, mesh=False, mesh_shape=None,
+                               force_host_devices=None)
+    assert "--mesh" not in _serving_config_flags(plain)
 
 
 def test_cli_unknown_model(job):
