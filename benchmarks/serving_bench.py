@@ -101,6 +101,7 @@ import argparse
 import asyncio
 import json
 import time
+import zlib
 
 import numpy as np
 
@@ -180,7 +181,8 @@ def _draft(args, model, variables):
 
 
 def _make_engine(args, model, variables, metrics=None, trace_store=None,
-                 slots=None):
+                 slots=None, tenant_quotas=None, tenant_weights=None,
+                 quota_burst_s=2.0):
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
     paged = args.paged or args.kv_pool_mb > 0
@@ -211,6 +213,8 @@ def _make_engine(args, model, variables, metrics=None, trace_store=None,
         spec_k=args.spec_k, mesh=mesh,
         auditor=auditor, arm_auditor_after_warmup=auditor is not None,
         trace_store=trace_store,
+        tenant_quotas=tenant_quotas, tenant_weights=tenant_weights,
+        quota_burst_s=quota_burst_s,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None)
 
 
@@ -485,6 +489,150 @@ async def _cluster_bench(args, report):
     return model, variables, all_results
 
 
+async def _qos_phase(engine, args, tenants, rates, salt):
+    """One open-loop phase: every tenant submits Poisson traffic at its
+    own rate concurrently. Returns per-tenant outcome lists — TTFTs for
+    completions, typed error-code counts for rejects."""
+    from distkeras_tpu.serving import ServingError, TenantOverQuota
+
+    prompts = _prompts(args, args.requests, salt=salt)
+    out = {t: {"ttft": [], "sheds": {}, "completed": 0, "errors": {}}
+           for t in tenants}
+    task = asyncio.create_task(engine.run())
+    # Warm the compiled programs before the clock matters: phase A and
+    # phase B must both measure steady-state TTFT, not who paid jit.
+    await engine.submit(prompts[0], args.new_tokens,
+                        tenant="__warmup__").result()
+
+    async def tenant_load(tenant, qps, n):
+        rec = out[tenant]
+        pending = []
+        # Stable per-tenant salt: Python's hash() is randomized per
+        # process and would make the recorded qos rows irreproducible.
+        tsalt = zlib.crc32(tenant.encode())
+        trng = np.random.default_rng(args.seed + salt + tsalt % 9973)
+        for i in range(n):
+            p = prompts[(i + tsalt) % len(prompts)]
+            try:
+                pending.append(engine.submit(p, args.new_tokens,
+                                             tenant=tenant))
+            except TenantOverQuota:
+                rec["sheds"]["tenant_over_quota"] = (
+                    rec["sheds"].get("tenant_over_quota", 0) + 1)
+            except ServingError as e:
+                rec["sheds"][e.code] = rec["sheds"].get(e.code, 0) + 1
+            await asyncio.sleep(float(trng.exponential(1.0 / qps)))
+        for req in pending:
+            try:
+                await req.result()
+                rec["completed"] += 1
+                if req.ttft is not None:
+                    rec["ttft"].append(req.ttft)
+            except ServingError as e:
+                rec["errors"][e.code] = rec["errors"].get(e.code, 0) + 1
+
+    await asyncio.gather(*(
+        tenant_load(t, qps, n) for t, (qps, n) in rates.items()))
+    engine.shutdown(drain=True)
+    await task
+    return out
+
+
+async def _qos_bench(args, model, variables, report):
+    """The adversarial multi-tenant workload: N tenants share one
+    engine; phase A (baseline) has every tenant offering its fair
+    share, phase B (flood) has ONE hot tenant offering
+    ``--hot-tenant-qps`` (default 10x fair) while the others keep their
+    baseline load. With per-tenant quotas + DRR fair queueing, the
+    flood must be shed as typed per-tenant rejects at submit and the
+    OTHER tenants' p99 TTFT must hold (``--qos-max-degradation`` bounds
+    the allowed ratio; the acceptance run uses 1.25)."""
+    from distkeras_tpu.serving.metrics import percentile
+
+    tenants = [f"t{i}" for i in range(args.tenants)]
+    hot = tenants[0]
+    fair_qps = args.rate / args.tenants
+    hot_qps = args.hot_tenant_qps or 10.0 * fair_qps
+    # ONE TENANT=VALUE parser repo-wide (run.py owns it).
+    from distkeras_tpu.run import _parse_tenant_rates
+
+    quotas = _parse_tenant_rates(args.tenant_quota, "--tenant-quota") or {}
+    if not quotas:
+        # Default: every tenant's token budget is DOUBLE its fair share
+        # of the offered token rate, with a 4-second burst bucket —
+        # honest Poisson traffic (bursty by nature) never touches it,
+        # a 10x flood is shed at submit within one burst window.
+        per_tenant = 2.0 * fair_qps * args.new_tokens
+        quotas = {t: per_tenant for t in tenants}
+    n_each = max(args.requests // args.tenants, 8)
+
+    def build():
+        return _make_engine(args, model, variables,
+                            tenant_quotas=quotas, quota_burst_s=4.0)
+
+    phases = {}
+    for phase, hot_rate in (("baseline", fair_qps), ("flood", hot_qps)):
+        engine = build()
+        rates = {t: (fair_qps, n_each) for t in tenants}
+        rates[hot] = (hot_rate,
+                      n_each if phase == "baseline"
+                      else max(int(n_each * hot_rate / fair_qps), n_each))
+        phases[phase] = await _qos_phase(
+            engine, args, tenants, rates,
+            salt=101 if phase == "baseline" else 202)
+
+    sec = {"tenants": args.tenants, "hot_tenant": hot,
+           "fair_qps": round(fair_qps, 3), "hot_qps": round(hot_qps, 3),
+           "quota_tokens_per_s": {t: quotas.get(t) for t in tenants}}
+    for phase, data in phases.items():
+        others = [x for t in tenants if t != hot for x in data[t]["ttft"]]
+        psec = {
+            "completed": {t: data[t]["completed"] for t in tenants},
+            "sheds": {t: data[t]["sheds"] for t in tenants
+                      if data[t]["sheds"]},
+            "errors": {t: data[t]["errors"] for t in tenants
+                       if data[t]["errors"]},
+        }
+        if others:
+            psec["ttft_p50_others_s"] = round(percentile(others, 50), 6)
+            psec["ttft_p99_others_s"] = round(percentile(others, 99), 6)
+        if data[hot]["ttft"]:
+            psec["ttft_p99_hot_s"] = round(
+                percentile(data[hot]["ttft"], 99), 6)
+        sec[phase] = psec
+    base_p99 = sec["baseline"].get("ttft_p99_others_s")
+    flood_p99 = sec["flood"].get("ttft_p99_others_s")
+    if base_p99 and flood_p99:
+        sec["ttft_degradation_ratio"] = round(flood_p99 / base_p99, 4)
+    report["qos"] = sec
+
+    # The QoS contract, asserted: every shed is a TYPED per-tenant
+    # reject (never a generic failure), and honest tenants are never
+    # shed at all — the flood lands exclusively on the flooder.
+    for phase, data in phases.items():
+        for t in tenants:
+            bad = {k: v for k, v in data[t]["sheds"].items()
+                   if k != "tenant_over_quota"}
+            assert not bad, (f"{phase}: tenant {t} shed with non-quota "
+                             f"codes {bad}")
+            assert not data[t]["errors"], (
+                f"{phase}: tenant {t} saw mid-stream errors "
+                f"{data[t]['errors']} — quota must reject at submit, "
+                f"never kill an admitted stream")
+            if t != hot:
+                assert not data[t]["sheds"], (
+                    f"{phase}: honest tenant {t} was shed "
+                    f"{data[t]['sheds']} — the flood leaked")
+    assert phases["flood"][hot]["sheds"].get("tenant_over_quota", 0) > 0, \
+        "the flood was never shed — quota not engaged (raise " \
+        "--hot-tenant-qps or lower the quota)"
+    if args.qos_max_degradation and base_p99 and flood_p99:
+        ratio = flood_p99 / base_p99
+        assert ratio <= args.qos_max_degradation, (
+            f"other tenants' p99 TTFT degraded {ratio:.2f}x under the "
+            f"flood (allowed {args.qos_max_degradation}x)")
+
+
 async def _sweep_point(args, model, variables, slots, salt):
     """One max-concurrent-slots point: a fresh engine at ``slots`` under
     the SAME KV byte budget, saturated closed-loop (>= one client per
@@ -672,6 +820,39 @@ def _record_history(args, report):
     bench.write_history(path, hist)
 
 
+def _record_qos_history(args, report):
+    """``serving/qos_*`` rows for the strict CI gate: the others' p99
+    TTFT under flood and the flood/baseline degradation ratio — both
+    ttft-named, so the checker knows lower-is-better."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    sec = report.get("qos") or {}
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    base = (f"serving/qos_{args.model}/tenants{args.tenants}"
+            f"/hot{sec.get('hot_qps', 0):g}")
+    rows = {
+        "ttft_p99_others_flood_s":
+            (sec.get("flood") or {}).get("ttft_p99_others_s"),
+        "ttft_p99_others_baseline_s":
+            (sec.get("baseline") or {}).get("ttft_p99_others_s"),
+        "ttft_degradation_ratio": sec.get("ttft_degradation_ratio"),
+    }
+    for metric, v in rows.items():
+        if isinstance(v, (int, float)) and v > 0:
+            key = f"{base}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="both",
@@ -761,6 +942,28 @@ def main():
                     help="cluster mode: hard-kill replica r0 this many "
                          "seconds into each load phase and assert the "
                          "retry/restart contract")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help=">= 2: the ADVERSARIAL multi-tenant workload — "
+                         "N tenants share the engine, tenant t0 floods "
+                         "at --hot-tenant-qps while the others offer "
+                         "their fair share of --rate; per-tenant quotas "
+                         "+ DRR fair queueing must shed the flood as "
+                         "typed rejects without moving the others' p99 "
+                         "TTFT")
+    ap.add_argument("--hot-tenant-qps", type=float, default=None,
+                    help="flood phase offered rate for tenant t0 "
+                         "(default: 10x its fair share of --rate)")
+    ap.add_argument("--tenant-quota", action="append", default=None,
+                    metavar="TENANT=TOK_S",
+                    help="per-tenant token-rate quota (repeatable); "
+                         "default in --tenants mode: 2x each tenant's "
+                         "fair-share token rate with a 4s burst bucket "
+                         "(honest Poisson bursts clear it, a 10x flood "
+                         "does not)")
+    ap.add_argument("--qos-max-degradation", type=float, default=0.0,
+                    help="assert the others' flood/baseline p99-TTFT "
+                         "ratio stays <= this (acceptance: 1.25); 0 = "
+                         "report only")
     ap.add_argument("--record-history", action="store_true",
                     help="append serving/* rows to bench_history.json for "
                          "scripts/check_bench_regression.py")
@@ -811,6 +1014,21 @@ def main():
         "mesh": (dict(_mesh(args).shape)
                  if (args.mesh or args.mesh_shape) else None),
     }}
+
+    if args.tenants >= 2:
+        # Adversarial multi-tenant mode: its own phases, its own rows.
+        report["config"]["tenants"] = args.tenants
+        model, variables = _model(args)
+        try:
+            asyncio.run(_qos_bench(args, model, variables, report))
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        if args.record_history:
+            _record_qos_history(args, report)
+        print(json.dumps(report, indent=1))
+        return
 
     if args.replicas >= 2:
         # Cluster path: same workload, driven over TCP through the

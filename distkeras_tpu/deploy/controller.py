@@ -102,6 +102,7 @@ class DeployController:
         trace_store=None,
         quarantine_dir: str | None = None,
         auto_rollback_on_verify: bool = True,
+        canary_tenant: str = "canary",
     ):
         self.router = router
         self.supervisor = router.supervisor
@@ -111,6 +112,10 @@ class DeployController:
                                for p in (golden_prompts or [])]
         self.golden_new_tokens = int(golden_new_tokens)
         self.canary_latency_s = float(canary_latency_s)
+        # The QoS identity canary traffic runs under: attributable in
+        # every per-tenant metric, and deliberately outside the
+        # production quota set (a quota-shed canary would veto deploys).
+        self.canary_tenant = str(canary_tenant)
         self.score_fn = score_fn
         self.poll_interval_s = float(poll_interval_s)
         self.swap_timeout_s = float(swap_timeout_s)
@@ -520,6 +525,7 @@ class DeployController:
                 done = await asyncio.wait_for(
                     client.generate(prompt, self.golden_new_tokens,
                                     temperature=0.0,
+                                    tenant=self.canary_tenant,
                                     trace_id=f"canary-{info.rid}"),
                     budget)
         except asyncio.TimeoutError as e:

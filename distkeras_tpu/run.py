@@ -220,6 +220,27 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "virtual devices (sets XLA_FLAGS before jax "
                          "loads) — how a laptop/CI host runs --mesh "
                          "without real accelerators")
+    ap.add_argument("--wire", default="auto",
+                    choices=["auto", "bin1", "jsonl"],
+                    help="front-door protocol policy: 'auto'/'bin1' "
+                         "serve JSONL as always AND accept the "
+                         "length-prefixed bin1 upgrade from clients "
+                         "that offer it (cluster mode also negotiates "
+                         "bin1 to each replica); 'jsonl' pins "
+                         "everything to the original protocol — the "
+                         "rollback knob")
+    ap.add_argument("--tenant-quota", action="append", default=None,
+                    metavar="TENANT=TOK_S",
+                    help="repeatable; per-tenant token-rate quota in "
+                         "tokens/second — an over-quota tenant gets a "
+                         "typed tenant_over_quota reject at submit, "
+                         "never a mid-stream kill")
+    ap.add_argument("--tenant-weight", action="append", default=None,
+                    metavar="TENANT=W",
+                    help="repeatable; per-tenant weighted-fair-queueing "
+                         "weight (default 1.0) — within a priority "
+                         "class, a weight-2 tenant is offered twice "
+                         "the token bandwidth under contention")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=default_replicas,
                     help="> 1: start this many replica processes behind a "
@@ -387,8 +408,14 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         spec_k=args.spec_k, mesh=mesh,
         trace_store=trace_store, flight_recorder=recorder,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None,
-        weight_version=weight_version)
-    server = ServingServer(engine, host=args.host, port=args.port)
+        weight_version=weight_version,
+        tenant_quotas=_parse_tenant_rates(args.tenant_quota,
+                                          "--tenant-quota"),
+        tenant_weights=_parse_tenant_rates(args.tenant_weight,
+                                           "--tenant-weight"))
+    server = ServingServer(
+        engine, host=args.host, port=args.port,
+        wire_mode="jsonl" if args.wire == "jsonl" else "auto")
 
     async def go():
         import signal
@@ -459,6 +486,25 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     return 0
 
 
+def _parse_tenant_rates(items, flag: str) -> dict | None:
+    """Repeated ``TENANT=VALUE`` CLI items into a dict (None when the
+    flag was never given). Bad input is a typed CLI error, never a deep
+    float() traceback out of the engine ctor."""
+    if not items:
+        return None
+    out = {}
+    for item in items:
+        name, sep, value = str(item).partition("=")
+        if not sep or not name:
+            raise SystemExit(f"{flag} needs TENANT=VALUE, got {item!r}")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            raise SystemExit(
+                f"{flag}: bad numeric value in {item!r}") from None
+    return out
+
+
 def _serving_config_flags(args) -> list[str]:
     """Serving-engine configuration flags a parent process forwards to
     every replica child — ONE builder shared by ``cluster`` and
@@ -499,6 +545,15 @@ def _serving_config_flags(args) -> list[str]:
         extra += ["--mesh"]
     if getattr(args, "force_host_devices", None):
         extra += ["--force-host-devices", str(args.force_host_devices)]
+    # Front-door wire policy + multi-tenant QoS ride to every replica
+    # (and therefore through deploy's canary), so the production wire
+    # configuration is exactly what gets validated.
+    if getattr(args, "wire", None):
+        extra += ["--wire", args.wire]
+    for item in getattr(args, "tenant_quota", None) or []:
+        extra += ["--tenant-quota", str(item)]
+    for item in getattr(args, "tenant_weight", None) or []:
+        extra += ["--tenant-weight", str(item)]
     return extra
 
 
@@ -585,6 +640,7 @@ def cluster_main(args) -> int:
         router_kwargs={
             "affinity_tokens": args.prefix_block,
             "affinity_slack": args.affinity_slack,
+            "wire_mode": "jsonl" if args.wire == "jsonl" else "auto",
             "trace_capacity":
                 512 if args.request_trace is None else args.request_trace,
         })
@@ -717,6 +773,26 @@ def deploy_main(argv=None) -> int:
                     metavar="KEY=VAL",
                     help="repeatable; extra env per replica child, {i} "
                          "expands to the index (device partitioning)")
+    ap.add_argument("--wire", default="auto",
+                    choices=["auto", "bin1", "jsonl"],
+                    help="front-door protocol policy, forwarded to every "
+                         "replica AND applied to the deploy router — the "
+                         "canary validates candidates under the "
+                         "production wire configuration")
+    ap.add_argument("--tenant-quota", action="append", default=None,
+                    metavar="TENANT=TOK_S",
+                    help="repeatable; per-tenant token-rate quotas, "
+                         "forwarded to every replica")
+    ap.add_argument("--tenant-weight", action="append", default=None,
+                    metavar="TENANT=W",
+                    help="repeatable; per-tenant fair-queueing weights, "
+                         "forwarded to every replica")
+    ap.add_argument("--tenant", default="canary",
+                    help="client-side tenant id the canary's golden "
+                         "requests run under — keep it OUT of the "
+                         "production quota set (a quota-shed canary "
+                         "would fail every deploy) and it makes canary "
+                         "traffic attributable in every tenant metric")
     args = ap.parse_args(argv)
     _apply_force_host_devices(args.force_host_devices)
     # Typed parent-side validation; the controller also scores golden
@@ -781,7 +857,9 @@ def deploy_main(argv=None) -> int:
     cluster = ServingCluster(
         lambda i: ProcessReplica(replica_args(i), host=args.host,
                                  env=replica_env(i)),
-        args.replicas, host=args.host, port=args.port, registry=registry)
+        args.replicas, host=args.host, port=args.port, registry=registry,
+        router_kwargs={
+            "wire_mode": "jsonl" if args.wire == "jsonl" else "auto"})
 
     async def go():
         # Controller first: its ctor stages the boot weights, and the
@@ -795,6 +873,7 @@ def deploy_main(argv=None) -> int:
             registry=registry, mesh=deploy_mesh,
             canary_latency_s=args.canary_latency_ms / 1e3,
             poll_interval_s=args.poll_ms / 1e3,
+            canary_tenant=args.tenant,
             initial_weights=boot_weights)
         cluster.supervisor.current_weights = (
             (controller.last_good or {}).get("path") or boot_weights)

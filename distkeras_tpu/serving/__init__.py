@@ -45,6 +45,8 @@ from distkeras_tpu.serving.scheduler import (
     RequestTimeout,
     Scheduler,
     ServingError,
+    TenantOverQuota,
+    TenantQuota,
 )
 from distkeras_tpu.serving.metrics import ServingMetrics
 from distkeras_tpu.serving.prefix_cache import KVBlockPool, PrefixCache
@@ -79,4 +81,6 @@ __all__ = [
     "RequestTimeout",
     "RequestCancelled",
     "EngineStopped",
+    "TenantOverQuota",
+    "TenantQuota",
 ]

@@ -44,6 +44,8 @@ __all__ = [
     "ReplicaHandle",
     "LocalReplica",
     "ProcessReplica",
+    "EchoServer",
+    "EchoReplica",
     "probe_healthz",
     "send_control",
 ]
@@ -74,6 +76,15 @@ class ReplicaInfo:
     consecutive_restarts: int = 0  # backoff exponent; reset on stable READY
     ready_since: float | None = None
     last_health: dict = dataclasses.field(default_factory=dict)
+    # Incarnation counter, bumped by the supervisor every time the
+    # handle (re)starts. The router keys its pooled connections and
+    # negotiated-protocol cache by it: a replica restarted onto the
+    # SAME port must never be served by a connection (or a protocol
+    # capability) negotiated with its previous life.
+    generation: int = 0
+    # The front-door protocol the router negotiated with THIS
+    # generation ("bin1"/"jsonl"); None = not yet probed.
+    wire_proto: str | None = None
 
     def public(self) -> dict:
         """The JSON-safe view the router's aggregate healthz exposes."""
@@ -84,6 +95,8 @@ class ReplicaInfo:
             "outstanding": self.outstanding,
             "restarts": self.restarts,
             "consecutive_failures": self.consecutive_failures,
+            "generation": self.generation,
+            "wire_proto": self.wire_proto,
         }
 
 
@@ -209,6 +222,214 @@ class LocalReplica(ReplicaHandle):
             return
         self._killed = True
         await self.server.stop(drain=True)
+
+
+class EchoServer:
+    """A protocol-complete, engine-free replica: answers every front-door
+    verb (JSONL and the negotiated bin1 upgrade) but "decodes" by
+    echoing — each generation request gets ``echo_tokens`` token events
+    (the prompt's first token id, or 0) and a done line.
+
+    This is what isolates FRONT-DOOR cost from decode cost:
+    ``benchmarks/router_bench.py`` measures the router's requests/s
+    ceiling against an echo fleet, and the protocol-negotiation tests
+    exercise downgrade/mixed-fleet paths without paying a jax import.
+
+    ``wire_mode``: ``"auto"`` accepts the bin1 upgrade, ``"jsonl"``
+    refuses it (the old-but-hello-aware peer), ``"legacy"`` emulates a
+    pre-bin1 server — the hello verb itself is unknown and answered
+    with the standard ``bad_request``, which is exactly what a client's
+    auto-downgrade must survive.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 echo_tokens: int = 1, wire_mode: str = "auto"):
+        if wire_mode not in ("auto", "jsonl", "legacy"):
+            raise ValueError(f"bad wire_mode {wire_mode!r}")
+        self.host = host
+        self.echo_tokens = int(echo_tokens)
+        self.wire_mode = wire_mode
+        self.requests = 0
+        self._requested_port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self._requested_port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
+
+    # -- replies ------------------------------------------------------------
+    def _reply(self, spec: dict) -> list[dict]:
+        """The event list (token lines then terminal line) for one spec."""
+        cmd = spec.get("cmd")
+        if cmd is not None:
+            if cmd == "healthz":
+                return [{"healthz": {
+                    "slots": 0, "active_slots": 0, "queue_depth": 0,
+                    "decode_compile_count": -1, "stopping": False,
+                    "weight_version": None, "echo": True,
+                    "requests": self.requests}}]
+            if cmd == "metricsz":
+                return [{"metricsz": {"echo_requests_total":
+                                      {"value": self.requests}}}]
+            if cmd == "reload":
+                return [{"reload": {"ok": True, "echo": True,
+                                    "weights": spec.get("weights")}}]
+            return [{"error": f"unknown cmd {cmd!r}",
+                     "code": "bad_request"}]
+        prompt = spec.get("prompt") or []
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return [{"error": "prompt must be a non-empty token list",
+                     "code": "bad_request",
+                     "trace_id": spec.get("trace_id")}]
+        self.requests += 1
+        try:
+            tok = int(prompt[0])
+        except (TypeError, ValueError):
+            return [{"error": "non-integer prompt token",
+                     "code": "bad_request",
+                     "trace_id": spec.get("trace_id")}]
+        toks = [tok] * self.echo_tokens
+        done = {"done": True, "tokens": toks,
+                "trace_id": spec.get("trace_id"),
+                "tenant": spec.get("tenant") or "default",
+                "ttft_ms": 0.0, "latency_ms": 0.0}
+        return [{"token": t} for t in toks] + [done]
+
+    async def _handle(self, reader, writer) -> None:
+        from distkeras_tpu.serving import wire
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    spec = json.loads(line)
+                except ValueError:
+                    writer.write(b'{"error": "bad json", '
+                                 b'"code": "bad_request"}\n')
+                    await writer.drain()
+                    continue
+                if (isinstance(spec, dict) and spec.get("cmd") == "hello"
+                        and self.wire_mode != "legacy"):
+                    proto = (wire.PROTO_JSONL if self.wire_mode == "jsonl"
+                             else wire.choose_proto(spec.get("proto")))
+                    writer.write((json.dumps(
+                        {"hello": {"proto": proto}}) + "\n").encode())
+                    await writer.drain()
+                    if proto == wire.PROTO_BIN1:
+                        await self._handle_bin1(reader, writer)
+                        return
+                    continue
+                for rec in self._reply(spec if isinstance(spec, dict)
+                                       else {}):
+                    writer.write((json.dumps(rec) + "\n").encode())
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _handle_bin1(self, reader, writer) -> None:
+        from distkeras_tpu.serving import wire
+
+        decoder = wire.FrameDecoder()
+        while True:
+            data = await reader.read(2 ** 18)
+            if not data:
+                return
+            out = bytearray()
+            try:
+                frames = decoder.feed(data)
+            except wire.WireError as e:
+                writer.write(wire.encode_json_frame(
+                    wire.T_ERR, 0,
+                    {"error": str(e), "code": "bad_request"}))
+                await writer.drain()
+                return
+            for ftype, sid, payload in frames:
+                if ftype == wire.T_REQ:
+                    try:
+                        spec = wire.decode_request(payload)
+                    except wire.WireError as e:
+                        out += wire.encode_json_frame(
+                            wire.T_ERR, sid,
+                            {"error": str(e), "code": "bad_request"})
+                        continue
+                    prompt = spec.get("prompt") or []
+                    if not prompt:
+                        out += wire.encode_json_frame(
+                            wire.T_ERR, sid,
+                            {"error": "prompt must be a non-empty token "
+                                      "list", "code": "bad_request",
+                             "trace_id": spec.get("trace_id")})
+                        continue
+                    self.requests += 1
+                    toks = [int(prompt[0])] * self.echo_tokens
+                    if toks:
+                        out += wire.encode_token_frame(sid, toks)
+                    out += wire.encode_json_frame(wire.T_DONE, sid, {
+                        "done": True, "tokens": toks,
+                        "trace_id": spec.get("trace_id"),
+                        "tenant": spec.get("tenant") or "default",
+                        "ttft_ms": 0.0, "latency_ms": 0.0})
+                elif ftype == wire.T_CTRL:
+                    out += wire.encode_json_frame(
+                        wire.T_CTRLR, sid,
+                        self._reply(wire.decode_json(payload))[0])
+                elif ftype == wire.T_CANCEL:
+                    pass
+                else:
+                    out += wire.encode_json_frame(
+                        wire.T_ERR, sid,
+                        {"error": f"unexpected frame type {ftype}",
+                         "code": "bad_request"})
+            if out:
+                writer.write(bytes(out))
+                await writer.drain()
+
+
+class EchoReplica(ReplicaHandle):
+    """ReplicaHandle over an :class:`EchoServer` — slots into the
+    supervisor/router exactly like a real replica (healthz readiness,
+    kill semantics), for front-door benchmarks and protocol tests."""
+
+    def __init__(self, host: str = "127.0.0.1", *, echo_tokens: int = 1,
+                 wire_mode: str = "auto"):
+        self.server = EchoServer(host, 0, echo_tokens=echo_tokens,
+                                 wire_mode=wire_mode)
+        self._killed = False
+
+    async def start(self) -> tuple[str, int]:
+        await self.server.start()
+        return self.server.host, self.server.port
+
+    @property
+    def alive(self) -> bool:
+        return not self._killed and self.server._server is not None
+
+    async def kill(self) -> None:
+        self._killed = True
+        await self.server.stop()
+
+    async def terminate(self) -> None:
+        await self.kill()
 
 
 class ProcessReplica(ReplicaHandle):

@@ -42,10 +42,12 @@ replicas and no client stream is ever cut.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import time
 import zlib
 
+from distkeras_tpu.serving import wire
 from distkeras_tpu.serving.cluster.replicas import (
     DRAINING,
     READY,
@@ -83,6 +85,289 @@ class _ClientGone(Exception):
     supervisor's death detection or burn a retry."""
 
 
+class _PooledConn:
+    """One pooled backend connection plus the negotiation state it was
+    created under. ``generation`` is the replica incarnation the
+    connection was dialed against — checkout re-verifies it, so a
+    replica restarted onto the SAME port can never be handed a socket
+    (or a half-done handshake) from its previous life."""
+
+    __slots__ = ("reader", "writer", "generation", "proto")
+
+    def __init__(self, reader, writer, generation: int,
+                 proto: str = wire.PROTO_JSONL):
+        self.reader = reader
+        self.writer = writer
+        self.generation = generation
+        self.proto = proto
+
+
+class _FastStream:
+    """One request on the router's zero-task fast path: a bin1 client
+    stream switched straight onto a replica's mux. The mux read loop
+    calls :meth:`on_frame` synchronously — token deltas and the DONE
+    payload are RE-FRAMED (never re-encoded) into the client
+    connection's coalescing sink, so the steady-state per-request cost
+    is a few dict operations and buffer appends, with no task, no
+    queue, and no JSON on the done path. Failure cases (backend loss,
+    retryable reject with zero streamed tokens) hand the request to the
+    classic slow-path dispatch, which owns retry/exclusion — rare by
+    construction, so its task cost doesn't gate the ceiling."""
+
+    __slots__ = ("router", "sink", "csid", "payload", "info", "mux",
+                 "bsid", "streamed", "registry")
+
+    def __init__(self, router, sink, csid, payload, info, mux, registry):
+        self.router = router
+        self.sink = sink
+        self.csid = csid
+        self.payload = payload
+        self.info = info
+        self.mux = mux
+        self.bsid = None
+        self.streamed = 0
+        self.registry = registry  # this client connection's live table
+
+    def _finish(self) -> None:
+        self.info.outstanding -= 1
+        self.registry.pop(self.csid, None)
+        if self.bsid is not None:
+            self.mux.release(self.bsid)
+
+    def abandon(self) -> None:
+        """Client cancelled / connection closed: stop the backend work."""
+        self.info.outstanding -= 1
+        self.registry.pop(self.csid, None)
+        if self.bsid is not None:
+            self.mux.cancel(self.bsid)
+
+    def on_frame(self, ftype, payload) -> None:
+        if ftype == wire.T_TOK:
+            self.streamed += len(payload) // 4
+            if self.sink.closed:
+                # Client walked away mid-stream: cancel server-side
+                # instead of decoding for nobody.
+                self.abandon()
+                return
+            # Verbatim relay: the payload is already wire-format int32s.
+            self.sink.forward_tokens(self.csid, payload)
+        elif ftype == wire.T_DONE:
+            self._finish()
+            self.sink.send_raw(wire.T_DONE, self.csid, payload)
+        elif ftype == wire.T_ERR:
+            rec = wire.decode_json(payload)
+            if self.streamed == 0 \
+                    and rec.get("code") in _RETRYABLE_CODES:
+                self._finish()
+                self.router._fast_failover(self, rec)
+                return
+            self._finish()
+            self.sink.send_raw(wire.T_ERR, self.csid, payload)
+        else:  # ftype None: mux died
+            self.info.outstanding -= 1
+            self.registry.pop(self.csid, None)
+            self.bsid = None
+            self.router.supervisor.note_failure(self.info.rid)
+            if self.streamed == 0:
+                self.router._fast_failover(self, None)
+            else:
+                if self.router._c_lost is not None:
+                    self.router._c_lost.inc()
+                self.sink.send_error(self.csid, {
+                    "error": f"replica {self.info.rid} lost after "
+                             f"{self.streamed} streamed tokens",
+                    "code": "replica_lost"})
+
+
+class _JsonClientSink:
+    """Client-facing output for a JSONL connection: one line per token,
+    one line for the terminal record — the original wire behavior."""
+
+    __slots__ = ("_writer",)
+
+    def __init__(self, writer):
+        self._writer = writer
+
+    async def tokens(self, toks) -> None:
+        for t in toks:
+            await Router._send_client(self._writer, {"token": int(t)})
+
+    async def final(self, rec: dict) -> None:
+        await Router._send_client(self._writer, rec)
+
+
+class _BinClientSink:
+    """Client-facing output for one bin1 stream: token deltas go through
+    the connection's shared coalescing :class:`wire.FrameSink` (one
+    write per flush interval across ALL streams), terminal records as
+    DONE/ERR frames."""
+
+    __slots__ = ("_sink", "_sid")
+
+    def __init__(self, sink: "wire.FrameSink", sid: int):
+        self._sink = sink
+        self._sid = sid
+
+    async def tokens(self, toks) -> None:
+        if self._sink.closed:
+            raise _ClientGone()
+        self._sink.add_tokens(self._sid, toks)
+
+    async def final(self, rec: dict) -> None:
+        if self._sink.closed:
+            raise _ClientGone()
+        if rec.get("done"):
+            self._sink.send_done(self._sid, rec)
+        else:
+            self._sink.send_error(self._sid, rec)
+
+
+class _BackendMux:
+    """ONE bin1 connection to a replica carrying every in-flight stream
+    the router routes there — the front door's core restructuring: the
+    per-request exclusive pooled socket (and its per-token readline)
+    becomes stream frames multiplexed over a single connection, so a
+    decode tick's tokens for N requests arrive in a handful of reads
+    and leave in coalesced writes.
+
+    Per-stream events are delivered by CALLBACK — ``handler(ftype,
+    payload)`` with the raw frame payload, or ``handler(None, None)``
+    when the connection dies (every open stream is failed at once — the
+    dispatcher's retry logic treats it exactly like a dropped exclusive
+    connection). The router's fast path installs a zero-task forwarding
+    handler; the slow path adapts the callback onto a queue."""
+
+    def __init__(self, key, reader, writer):
+        self.key = key
+        self.reader = reader
+        self.writer = writer
+        self.dead = False
+        self.streams: dict[int, object] = {}  # sid -> handler callable
+        self._sid = itertools.count(1)
+        self._out = bytearray()
+        self._wscheduled = False
+        self._kick = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        self._reader_task = loop.create_task(self._read_loop())
+        self._drain_task = loop.create_task(self._drain_loop())
+
+    def open(self, handler) -> int:
+        if self.dead:
+            raise _BackendLost("mux connection is dead")
+        sid = next(self._sid)
+        self.streams[sid] = handler
+        return sid
+
+    def enqueue(self, frame: bytes) -> None:
+        """Buffer one outgoing frame; every frame enqueued in the same
+        event-loop tick leaves in ONE write — the batched-forwarding
+        half of batched admission."""
+        self._out += frame
+        if not self._wscheduled and not self.dead:
+            self._wscheduled = True
+            asyncio.get_running_loop().call_soon(self._wflush)
+
+    _MAX_BUFFER = 32 * 2 ** 20
+
+    def _wflush(self) -> None:
+        self._wscheduled = False
+        if self.dead or not self._out:
+            return
+        data = bytes(self._out)
+        self._out.clear()
+        try:
+            transport = self.writer.transport
+            if transport is not None and (
+                    transport.get_write_buffer_size() + len(data)
+                    > self._MAX_BUFFER):
+                # A replica that stopped reading is a dead replica:
+                # failing the mux (streams retry / report lost) is the
+                # bounded outcome, buffering toward OOM is not.
+                self.fail("backend stopped reading (write buffer over "
+                          "the cap)")
+                return
+            self.writer.write(data)
+        except (ConnectionResetError, BrokenPipeError, OSError,
+                RuntimeError) as e:
+            self.fail(f"write failed: {e}")
+            return
+        self._kick.set()
+
+    def send_req(self, sid: int, spec: dict) -> None:
+        """Queue one REQ frame; may raise :class:`wire.WireError` on a
+        spec binary encoding can't express (malformed prompt — the
+        caller maps it to the same typed bad_request a replica would
+        send)."""
+        payload = wire.encode_request(spec)
+        if self.dead:
+            raise _BackendLost("mux connection is dead")
+        self.enqueue(wire.encode_frame(wire.T_REQ, sid, payload))
+
+    def cancel(self, sid: int) -> None:
+        """Tell the replica to abandon one stream (client gone / dispatch
+        cancelled) — a mux can't signal by closing the shared socket."""
+        self.streams.pop(sid, None)
+        if not self.dead:
+            self.enqueue(wire.encode_frame(wire.T_CANCEL, sid, b""))
+
+    def release(self, sid: int) -> None:
+        self.streams.pop(sid, None)
+
+    def fail(self, why: str) -> None:
+        if self.dead:
+            return
+        self.dead = True
+        self._out.clear()
+        streams, self.streams = self.streams, {}
+        for handler in streams.values():
+            try:
+                handler(None, None)
+            except Exception:
+                pass  # one stream's cleanup must not strand the rest
+        self._kick.set()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    async def close(self) -> None:
+        self.fail("closed")
+        for task in (self._reader_task, self._drain_task):
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def _drain_loop(self) -> None:
+        try:
+            while not self.dead:
+                await self._kick.wait()
+                self._kick.clear()
+                await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError,
+                asyncio.CancelledError, RuntimeError):
+            self.fail("drain failed")
+
+    async def _read_loop(self) -> None:
+        decoder = wire.FrameDecoder()
+        try:
+            while True:
+                data = await self.reader.read(2 ** 18)
+                if not data:
+                    self.fail("backend closed the connection")
+                    return
+                for ftype, sid, payload in decoder.feed(data):
+                    handler = self.streams.get(sid)
+                    if handler is None:
+                        continue  # late frames of a cancelled stream
+                    handler(ftype, payload)
+        except asyncio.CancelledError:
+            raise
+        except (OSError, wire.WireError, ValueError) as e:
+            self.fail(f"read failed: {e}")
+
+
 class Router:
     """Front-port router over a :class:`ReplicaSupervisor`'s table.
 
@@ -115,7 +400,12 @@ class Router:
         connect_timeout_s: float = 5.0,
         registry=None,
         trace_capacity: int = 512,
+        wire_mode: str = "auto",
+        flush_interval_s: float = 0.0,
     ):
+        if wire_mode not in ("auto", "jsonl"):
+            raise ValueError(
+                f"wire_mode must be 'auto' or 'jsonl', got {wire_mode!r}")
         self.supervisor = supervisor
         self.host = host
         self._requested_port = port
@@ -125,6 +415,12 @@ class Router:
         self.pick_wait_s = float(pick_wait_s)
         self.pool_size = int(pool_size)
         self.connect_timeout_s = float(connect_timeout_s)
+        # Front-door protocol policy, BOTH directions: "auto" accepts
+        # the bin1 upgrade from clients and offers it to replicas (per
+        # replica, falling back to jsonl for old ones); "jsonl" pins
+        # everything to the original protocol (the rollback knob).
+        self.wire_mode = wire_mode
+        self.flush_interval_s = float(flush_interval_s)
         self.trace_store = (TraceStore(trace_capacity)
                             if trace_capacity else None)
         # A DeployController (distkeras_tpu.deploy) registers itself
@@ -132,10 +428,20 @@ class Router:
         # state page. None = verb replies bad_request.
         self.deploy_controller = None
         self._server: asyncio.AbstractServer | None = None
-        # Idle backend connections, keyed by (rid, port): a restarted
-        # replica binds a fresh port, so its stale pool is simply never
-        # hit again.
-        self._pools: dict[tuple[str, int], list] = {}
+        # Idle backend connections, keyed by (rid, port, generation): a
+        # restarted replica bumps its generation even when the OS hands
+        # it the SAME port back, so a stale pool is never hit again —
+        # and checkout re-verifies the entry's recorded negotiation
+        # state besides (belt and braces for hand-built tables).
+        self._pools: dict[tuple[str, int, int], list[_PooledConn]] = {}
+        # One multiplexed bin1 connection per replica incarnation, for
+        # generation streams (control verbs keep pooled JSONL conns —
+        # they are rare and aggregate-bound, not hot).
+        self._muxes: dict[tuple[str, int, int], _BackendMux] = {}
+        self._mux_locks: dict[str, asyncio.Lock] = {}
+        # Strong refs for fast-path failover dispatch tasks (a bare
+        # create_task result can be garbage-collected mid-flight).
+        self._failover_tasks: set[asyncio.Task] = set()
         self._reload_lock = asyncio.Lock()
         self.registry = registry
         self._c_requests = self._c_retries = self._c_affinity = None
@@ -184,9 +490,12 @@ class Router:
             except asyncio.TimeoutError:
                 pass
         for pool in self._pools.values():
-            for _, writer in pool:
-                writer.close()
+            for conn in pool:
+                conn.writer.close()
         self._pools.clear()
+        for mux in list(self._muxes.values()):
+            await mux.close()
+        self._muxes.clear()
 
     # -- replica choice -----------------------------------------------------
     def _family(self, prompt) -> int:
@@ -242,54 +551,125 @@ class Router:
             await asyncio.sleep(0.02)
 
     # -- backend connections ------------------------------------------------
-    async def _acquire(self, info: ReplicaInfo):
-        # A restarted replica binds a fresh port: drop the old port's
-        # pooled sockets now, or a crash-looping replica accretes one
-        # dead pool per restart for the router's lifetime.
+    def _prune_stale(self, info: ReplicaInfo) -> None:
+        """Drop pools and muxes negotiated with a previous incarnation of
+        this replica (different port OR different generation — a restart
+        onto the SAME port still invalidates everything)."""
+        live = (info.rid, info.port, info.generation)
         for key in [k for k in self._pools
-                    if k[0] == info.rid and k[1] != info.port]:
-            for _, writer in self._pools.pop(key):
-                writer.close()
-        pool = self._pools.get((info.rid, info.port))
+                    if k[0] == info.rid and k != live]:
+            for conn in self._pools.pop(key):
+                conn.writer.close()
+        for key in [k for k in self._muxes
+                    if k[0] == info.rid and k != live]:
+            self._muxes.pop(key).fail("replica restarted")
+
+    async def _acquire(self, info: ReplicaInfo) -> _PooledConn:
+        # A restarted replica bumps its generation (even on a reused
+        # port): drop stale pools now, or a crash-looping replica
+        # accretes one dead pool per restart for the router's lifetime.
+        self._prune_stale(info)
+        pool = self._pools.get((info.rid, info.port, info.generation))
         while pool:
-            reader, writer = pool.pop()
-            if not writer.is_closing():
-                return reader, writer
-            writer.close()
+            conn = pool.pop()
+            # Checkout re-verification: the entry's recorded negotiation
+            # state must match the replica's CURRENT incarnation — the
+            # regression fix for a replica restarted onto the same port
+            # being served by a connection from its previous life.
+            if conn.generation != info.generation \
+                    or conn.proto != wire.PROTO_JSONL:
+                conn.writer.close()
+                continue
+            if not conn.writer.is_closing():
+                return conn
+            conn.writer.close()
         try:
             # Bounded connect (the OS default is minutes — a SYN-dropping
             # host must not stall dispatch, fleet aggregation, or a
             # rolling reload holding its lock) and a generous line limit:
             # an aggregate-bound metricsz snapshot is one long JSON line,
             # far past StreamReader's 64 KB default.
-            return await asyncio.wait_for(
+            reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(info.host, info.port, limit=2**24),
                 self.connect_timeout_s)
+            return _PooledConn(reader, writer, info.generation)
         except asyncio.TimeoutError as e:
             raise OSError(
                 f"connect to {info.rid} ({info.host}:{info.port}) timed "
                 f"out after {self.connect_timeout_s}s") from e
 
-    def _release(self, info: ReplicaInfo, conn, healthy: bool) -> None:
-        reader, writer = conn
-        if not healthy or writer.is_closing():
-            writer.close()
+    def _release(self, info: ReplicaInfo, conn: _PooledConn,
+                 healthy: bool) -> None:
+        if not healthy or conn.writer.is_closing() \
+                or conn.generation != info.generation:
+            conn.writer.close()
             return
-        pool = self._pools.setdefault((info.rid, info.port), [])
+        pool = self._pools.setdefault(
+            (info.rid, info.port, info.generation), [])
         if len(pool) < self.pool_size:
             pool.append(conn)
         else:
-            writer.close()
+            conn.writer.close()
+
+    async def _get_mux(self, info: ReplicaInfo) -> _BackendMux | None:
+        """The replica's live bin1 mux, negotiating one on first use —
+        or None when this replica (or this router) speaks JSONL only.
+        The negotiated capability is cached per INCARNATION
+        (``info.wire_proto``, reset by the supervisor on every restart),
+        so a replica that comes back older — or on the same port — is
+        re-probed, never assumed."""
+        if self.wire_mode == "jsonl" or info.wire_proto == wire.PROTO_JSONL:
+            return None
+        key = (info.rid, info.port, info.generation)
+        mux = self._muxes.get(key)
+        if mux is not None and not mux.dead:
+            return mux
+        lock = self._mux_locks.setdefault(info.rid, asyncio.Lock())
+        async with lock:
+            mux = self._muxes.get(key)
+            if mux is not None and not mux.dead:
+                return mux
+            if info.wire_proto == wire.PROTO_JSONL:
+                return None
+            self._prune_stale(info)
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(info.host, info.port,
+                                            limit=2**24),
+                    self.connect_timeout_s)
+            except (OSError, asyncio.TimeoutError):
+                return None  # dispatch's jsonl path will surface the loss
+            try:
+                writer.write(wire.hello_line())
+                await writer.drain()
+                line = await asyncio.wait_for(
+                    reader.readline(), self.connect_timeout_s)
+                rec = json.loads(line) if line else {}
+            except (OSError, ValueError, asyncio.TimeoutError):
+                writer.close()
+                return None
+            proto = wire.parse_hello(rec)
+            info.wire_proto = proto
+            if proto != wire.PROTO_BIN1:
+                # Old replica: it answered the unknown hello verb with a
+                # typed bad_request (or picked jsonl). Remember for this
+                # incarnation and keep the probe connection pooled — it
+                # is a perfectly good jsonl connection.
+                self._release(info, _PooledConn(
+                    reader, writer, info.generation), healthy=True)
+                return None
+            mux = _BackendMux(key, reader, writer)
+            self._muxes[key] = mux
+            return mux
 
     async def _backend_control(self, info: ReplicaInfo, spec: dict,
                                timeout: float = 5.0) -> dict:
         """One control verb against one replica over a pooled connection."""
         conn = await self._acquire(info)
-        reader, writer = conn
         try:
-            writer.write((json.dumps(spec) + "\n").encode())
-            await writer.drain()
-            line = await asyncio.wait_for(reader.readline(), timeout)
+            conn.writer.write((json.dumps(spec) + "\n").encode())
+            await conn.writer.drain()
+            line = await asyncio.wait_for(conn.reader.readline(), timeout)
             if not line:
                 raise _BackendLost(f"{info.rid} closed the connection")
             rec = json.loads(line)
@@ -315,10 +695,23 @@ class Router:
                     await self._send(writer,
                                      {"error": str(e), "code": "bad_request"})
                     continue
+                if spec.get("cmd") == "hello":
+                    # The bin1 upgrade offer — same negotiation as a
+                    # single ServingServer, so a client cannot tell a
+                    # router from a replica.
+                    proto = (wire.PROTO_JSONL if self.wire_mode == "jsonl"
+                             else wire.choose_proto(spec.get("proto")))
+                    await self._send(writer, {"hello": {
+                        "proto": proto,
+                        "fastwire": wire.native_available()}})
+                    if proto == wire.PROTO_BIN1:
+                        await self._handle_bin1(reader, writer)
+                        return
+                    continue
                 if "cmd" in spec:
                     await self._send(writer, await self._control(spec))
                 else:
-                    await self._dispatch(spec, writer)
+                    await self._dispatch(spec, _JsonClientSink(writer))
         except (ConnectionResetError, BrokenPipeError, _ClientGone):
             pass
         finally:
@@ -328,9 +721,202 @@ class Router:
             except (ConnectionResetError, BrokenPipeError, OSError):
                 pass
 
-    async def _dispatch(self, spec: dict,
-                        client: asyncio.StreamWriter) -> None:
+    async def _handle_bin1(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        """The negotiated binary front door for one client connection:
+        pipelined REQ frames each dispatch as their own task (so many
+        requests ride one connection concurrently), token output
+        coalesces through one shared FrameSink, and every frame that
+        arrived in one event-loop tick is drained in one read."""
+        sink = wire.FrameSink(writer, self.flush_interval_s)
+        decoder = wire.FrameDecoder()
+        tasks: dict[int, asyncio.Task] = {}
+        fast: dict[int, _FastStream] = {}
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                data = await reader.read(2 ** 18)
+                if not data:
+                    break
+                try:
+                    frames = decoder.feed(data)
+                except wire.WireError as e:
+                    sink.send_error(0, {"error": str(e),
+                                        "code": "bad_request"})
+                    break
+                # The READY list is shared by every REQ frame of this
+                # read batch (status changes land between reads, and a
+                # one-read-stale pick is indistinguishable from the
+                # request having arrived a tick earlier).
+                ready = None
+                for ftype, sid, payload in frames:
+                    if ftype == wire.T_REQ:
+                        # Steady state: the zero-task switch. Falls back
+                        # to a dispatch task for the cases that need one
+                        # (first contact with a replica, tracing on,
+                        # nothing READY).
+                        if ready is None:
+                            ready = ([] if self.trace_store is not None
+                                     or self.wire_mode == "jsonl" else
+                                     [r for r in
+                                      self.supervisor.replicas.values()
+                                      if r.status == READY])
+                        if self._fast_dispatch(payload, sid, sink, fast,
+                                               ready):
+                            continue
+                        try:
+                            spec = wire.decode_request(payload)
+                        except wire.WireError as e:
+                            sink.send_error(sid, {"error": str(e),
+                                                  "code": "bad_request"})
+                            continue
+                        task = loop.create_task(self._dispatch_frame(
+                            spec, _BinClientSink(sink, sid)))
+                        tasks[sid] = task
+                        task.add_done_callback(
+                            lambda _t, s=sid: tasks.pop(s, None))
+                    elif ftype == wire.T_CANCEL:
+                        st = fast.get(sid)
+                        if st is not None:
+                            st.abandon()
+                            continue
+                        task = tasks.get(sid)
+                        if task is not None:
+                            task.cancel()
+                    elif ftype == wire.T_CTRL:
+                        # As a task, like REQ dispatch: a slow verb (an
+                        # aggregate healthz with one wedged replica, a
+                        # rolling reload) must not stall every
+                        # multiplexed stream's frame processing.
+                        ctrl = loop.create_task(
+                            self._ctrl_frame(sid, payload, sink))
+                        self._failover_tasks.add(ctrl)
+                        ctrl.add_done_callback(
+                            self._failover_tasks.discard)
+                    else:
+                        sink.send_error(sid, {
+                            "error": f"unexpected frame type {ftype}",
+                            "code": "bad_request"})
+        finally:
+            # Client gone: cancel every in-flight dispatch — each relay's
+            # cleanup cancels its backend stream (mux CANCEL frame, or
+            # closing an exclusive jsonl backend connection).
+            for st in list(fast.values()):
+                st.abandon()
+            for task in list(tasks.values()):
+                task.cancel()
+            if tasks:
+                await asyncio.gather(*tasks.values(),
+                                     return_exceptions=True)
+            await sink.aclose()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _ctrl_frame(self, sid: int, payload, sink) -> None:
+        """One control verb off a bin1 connection, as its own task."""
+        try:
+            rep = await self._control(wire.decode_json(payload))
+        except wire.WireError as e:
+            rep = {"error": str(e), "code": "bad_request"}
+        sink.send_json(wire.T_CTRLR, sid, rep)
+
+    async def _dispatch_frame(self, spec: dict, sink, *,
+                              exclude: set | None = None,
+                              counted: bool = False) -> None:
+        """One pipelined stream's dispatch task: client loss and
+        cancellation are normal endings here, never connection-handler
+        errors (other streams on the connection keep running)."""
+        try:
+            await self._dispatch(spec, sink, exclude=exclude,
+                                 counted=counted)
+        except (_ClientGone, asyncio.CancelledError):
+            pass
+
+    # -- the zero-task fast path -------------------------------------------
+    def _fast_pick(self, ready: list, payload: bytes) -> ReplicaInfo:
+        """The fast path's replica choice: same rendezvous-affinity +
+        least-outstanding policy as :meth:`_pick`, but the prompt family
+        hashes the REQ payload's raw prefix bytes (no int->str joins)
+        and the per-replica rank seeds crc32 with the family instead of
+        building strings. The family value differs from the JSONL
+        path's string hash — affinity is a placement HINT, so bin1 and
+        jsonl clients pinning the same prefix to different replicas
+        costs cache warmth, never correctness."""
+        if len(ready) == 1:
+            return ready[0]
+        fam = zlib.crc32(wire.affinity_prefix(payload,
+                                              self.affinity_tokens))
+        preferred = max(
+            ready, key=lambda r: zlib.crc32(r.rid.encode(), fam))
+        least = min(ready, key=lambda r: r.outstanding)
+        if preferred.outstanding - least.outstanding > self.affinity_slack:
+            if self._c_affinity_spill is not None:
+                self._c_affinity_spill.inc()
+            return least
+        if self._c_affinity is not None:
+            self._c_affinity.inc()
+        return preferred
+
+    def _fast_dispatch(self, payload: bytes, csid: int, sink,
+                       registry: dict, ready: list) -> bool:
+        """Switch one bin1 client stream straight onto a replica mux
+        with NO per-request task, queue, or JSON: re-frame the payload
+        under a backend stream id and let the mux read loop forward
+        events through :class:`_FastStream`. Returns False when the
+        fast path can't serve this request (tracing on, no READY
+        replica, mux not negotiated yet, dead connection) — the caller
+        falls back to the classic dispatch task, which also NEGOTIATES
+        the mux, so only a replica's first request pays the slow path."""
+        if not ready:
+            return False
+        info = self._fast_pick(ready, payload)
+        mux = self._muxes.get((info.rid, info.port, info.generation))
+        if mux is None or mux.dead:
+            return False
+        st = _FastStream(self, sink, csid, payload, info, mux, registry)
+        try:
+            st.bsid = mux.open(st.on_frame)
+        except _BackendLost:
+            return False
+        mux.enqueue(wire.encode_frame(wire.T_REQ, st.bsid, payload))
+        registry[csid] = st
+        info.outstanding += 1
+        if self._c_requests is not None:
+            self._c_requests.inc()
+        return True
+
+    def _fast_failover(self, st: "_FastStream", rec: dict | None) -> None:
+        """A fast-path request hit a retryable failure (backend lost, or
+        a typed reject with zero streamed tokens): hand it to the
+        classic dispatch, excluding the replica that failed it. Rare by
+        construction — the task cost lives off the ceiling path."""
+        try:
+            spec = wire.decode_request(st.payload)
+        except wire.WireError as e:
+            st.sink.send_error(st.csid, {"error": str(e),
+                                         "code": "bad_request"})
+            return
+        if self._c_retries is not None:
+            self._c_retries.inc()
+        task = asyncio.get_running_loop().create_task(
+            self._dispatch_frame(spec, _BinClientSink(st.sink, st.csid),
+                                 exclude={st.info.rid}, counted=True))
+        self._failover_tasks.add(task)
+        task.add_done_callback(self._failover_tasks.discard)
+
+    async def _dispatch(self, spec: dict, sink, *,
+                        exclude: set | None = None,
+                        counted: bool = False) -> None:
         """Route one generation request, retrying while idempotent.
+        ``exclude`` pre-seeds the excluded-replica set (a fast-path
+        failover already burned one attempt there); ``counted`` skips
+        the request counter (the fast path already counted it).
+
+        ``sink`` is the client-facing output (JSONL lines or bin1
+        frames) — the retry loop is protocol-agnostic on BOTH sides.
 
         Trace context: the client's ``trace_id`` (or a router-minted one
         for bare clients) is forced into the forwarded spec, so the
@@ -347,11 +933,11 @@ class Router:
             trace = TimelineRecord(trace_id, "router", "router")
             trace.event("request", prompt_tokens=len(prompt)
                         if isinstance(prompt, (list, tuple)) else None)
-        if self._c_requests is not None:
+        if self._c_requests is not None and not counted:
             self._c_requests.inc()
         attempts = 0
         hops: list[str] = []
-        exclude: set[str] = set()
+        exclude = set(exclude or ())
         try:
             while True:
                 info = await self._pick_wait(prompt, exclude)
@@ -361,7 +947,7 @@ class Router:
                     if trace is not None:
                         trace.event("unavailable")
                         trace.data["status"] = "unavailable"
-                    await self._send_client(client, {
+                    await sink.final({
                         "error": "no serving replica available",
                         "code": "unavailable", "trace_id": trace_id})
                     return
@@ -370,8 +956,8 @@ class Router:
                     trace.event("dispatch", replica=info.rid,
                                 attempt=attempts,
                                 outstanding=info.outstanding)
-                outcome, streamed, rec = await self._relay(
-                    info, spec, client)
+                outcome, streamed, rec = await self._relay_any(
+                    info, spec, sink)
                 if outcome == "terminal":
                     if trace is not None:
                         trace.event("terminal", replica=info.rid,
@@ -404,13 +990,13 @@ class Router:
                     # backpressure signal, not a lost stream.
                     if trace is not None:
                         trace.data["status"] = rec.get("code", "error")
-                    await self._send_client(client, rec)
+                    await sink.final(rec)
                     return
                 if self._c_lost is not None:
                     self._c_lost.inc()
                 if trace is not None:
                     trace.data["status"] = "replica_lost"
-                await self._send_client(client, {
+                await sink.final({
                     "error": f"replica {info.rid} lost after {streamed} "
                              f"streamed tokens",
                     "code": "replica_lost", "trace_id": trace_id})
@@ -421,16 +1007,93 @@ class Router:
                 trace.data["retries"] = attempts
                 self.trace_store.put(trace)
 
-    async def _relay(self, info: ReplicaInfo, spec: dict,
-                     client: asyncio.StreamWriter):
-        """Stream one attempt through ``info``. Returns ``(outcome,
-        streamed, rec)`` where outcome is ``"terminal"`` (a final line
-        reached the client — done, or a non-retryable/late error),
-        ``"lost"`` (connection-level backend failure), or ``"reject"``
-        (typed replica-side error with zero tokens streamed — replica
-        answered, caller may retry elsewhere; ``rec`` carries its error
-        line). A client-side write failure cancels the backend work by
-        closing the backend connection."""
+    async def _relay_any(self, info: ReplicaInfo, spec: dict, sink):
+        """One attempt through ``info`` over the best protocol it
+        speaks: the multiplexed bin1 connection when negotiated, the
+        classic exclusive JSONL connection otherwise (old replicas in a
+        mixed fleet, or ``wire='jsonl'``)."""
+        mux = await self._get_mux(info)
+        if mux is not None:
+            return await self._relay_mux(mux, info, spec, sink)
+        return await self._relay(info, spec, sink)
+
+    async def _relay_mux(self, mux: _BackendMux, info: ReplicaInfo,
+                         spec: dict, sink):
+        """Stream one attempt through the replica's bin1 mux. Same
+        outcome contract as :meth:`_relay`. A client loss (or dispatch
+        cancellation) sends the backend a CANCEL frame — the mux peer
+        cannot be cancelled by closing the shared connection."""
+        streamed = 0
+        terminal = False
+        sid = None
+        q: asyncio.Queue = asyncio.Queue()
+
+        def handler(ftype, payload):
+            # Callback -> queue adapter (the slow path keeps its awaitable
+            # shape; the fast path skips the queue entirely).
+            if ftype is None:
+                q.put_nowait(("lost", None))
+            elif ftype == wire.T_TOK:
+                q.put_nowait(("tok", wire.decode_tokens(payload)))
+            elif ftype == wire.T_DONE:
+                q.put_nowait(("done", wire.decode_json(payload)))
+            elif ftype == wire.T_ERR:
+                q.put_nowait(("err", wire.decode_json(payload)))
+
+        info.outstanding += 1
+        try:
+            try:
+                sid = mux.open(handler)
+                mux.send_req(sid, spec)
+            except _BackendLost:
+                return "lost", streamed, None
+            except wire.WireError as e:
+                # The spec can't be expressed in binary (malformed
+                # prompt): the same typed bad_request a replica would
+                # answer, synthesized at the router.
+                terminal = True
+                rec = {"error": str(e), "code": "bad_request",
+                       "trace_id": spec.get("trace_id")}
+                await sink.final(rec)
+                return "terminal", streamed, rec
+            while True:
+                kind, payload = await q.get()
+                if kind == "tok":
+                    streamed += len(payload)
+                    await sink.tokens(payload)
+                elif kind == "done":
+                    terminal = True
+                    await sink.final(payload)
+                    return "terminal", streamed, payload
+                elif kind == "err":
+                    code = payload.get("code")
+                    if streamed == 0 and code in _RETRYABLE_CODES:
+                        terminal = True  # replica answered; no cancel
+                        return "reject", streamed, payload
+                    terminal = True
+                    await sink.final(payload)
+                    return "terminal", streamed, payload
+                else:  # lost
+                    return "lost", streamed, None
+        finally:
+            if sid is not None:
+                if terminal:
+                    mux.release(sid)
+                else:
+                    # Client gone / cancelled mid-stream: tell the
+                    # replica to stop decoding for nobody.
+                    mux.cancel(sid)
+            info.outstanding -= 1
+
+    async def _relay(self, info: ReplicaInfo, spec: dict, sink):
+        """Stream one attempt through ``info`` over an exclusive JSONL
+        connection. Returns ``(outcome, streamed, rec)`` where outcome
+        is ``"terminal"`` (a final line reached the client — done, or a
+        non-retryable/late error), ``"lost"`` (connection-level backend
+        failure), or ``"reject"`` (typed replica-side error with zero
+        tokens streamed — replica answered, caller may retry elsewhere;
+        ``rec`` carries its error line). A client-side failure cancels
+        the backend work by closing the backend connection."""
         streamed = 0
         info.outstanding += 1
         try:
@@ -438,26 +1101,25 @@ class Router:
                 conn = await self._acquire(info)
             except OSError:
                 return "lost", streamed, None
-            reader, writer = conn
             healthy = False
             try:
                 with span("route", replica=info.rid,
                           trace_id=spec.get("trace_id"),
                           outstanding=info.outstanding):
-                    writer.write((json.dumps(spec) + "\n").encode())
-                    await writer.drain()
+                    conn.writer.write((json.dumps(spec) + "\n").encode())
+                    await conn.writer.drain()
                     while True:
-                        line = await reader.readline()
+                        line = await conn.reader.readline()
                         if not line:
                             return "lost", streamed, None
                         rec = json.loads(line)
                         if "token" in rec:
                             streamed += 1
-                            await self._send_client(client, rec)
+                            await sink.tokens([rec["token"]])
                             continue
                         if rec.get("done"):
                             healthy = True
-                            await self._send_client(client, rec)
+                            await sink.final(rec)
                             return "terminal", streamed, rec
                         # Terminal error line from the replica.
                         code = rec.get("code")
@@ -465,7 +1127,7 @@ class Router:
                             healthy = True
                             return "reject", streamed, rec
                         healthy = True
-                        await self._send_client(client, rec)
+                        await sink.final(rec)
                         return "terminal", streamed, rec
             except (OSError, ConnectionResetError, BrokenPipeError,
                     ValueError):

@@ -179,6 +179,13 @@ class ReplicaSupervisor:
     async def _start_replica(self, info: ReplicaInfo) -> None:
         info.status = STARTING
         info.host, info.port = await info.handle.start()
+        # New incarnation: invalidate everything the router negotiated
+        # with the previous life — pooled connections AND the cached
+        # wire protocol are keyed by this generation, so a replica that
+        # restarts onto the SAME port can never be served by a stale
+        # connection mid-handshake.
+        info.generation += 1
+        info.wire_proto = None
         await self._await_ready(info)
 
     async def _await_ready(self, info: ReplicaInfo,
@@ -311,6 +318,10 @@ class ReplicaSupervisor:
             try:
                 info.status = STARTING
                 info.host, info.port = await info.handle.start()
+                # Same invalidation as _start_replica: the restarted
+                # replica is a new incarnation even on a reused port.
+                info.generation += 1
+                info.wire_proto = None
                 await self._await_ready(info)
             except Exception:
                 await info.handle.kill()

@@ -74,6 +74,10 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
                 ("trace_id", "trace_id"),
                 ("depth", "depth"), ("age_s", "age_s"),
                 ("remaining", "remaining")]
+        if any(s.get("tenant") not in (None, "default") for s in slots):
+            # Multi-tenant traffic: whose request holds each slot — the
+            # first column of the hot-tenant triage.
+            cols.insert(2, ("tenant", "tenant"))
         if any("blocks" in s for s in slots):
             # Paged engine: per-slot block-table depth (total blocks the
             # slot addresses / how many are shared prefix blocks).
@@ -92,6 +96,30 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
                                   ("prio", "priority"), ("age_s", "age_s"),
                                   ("prompt", "prompt_tokens"),
                                   ("deadline_in", "deadline_in_s")]):
+            lines.append(f"{indent}  {ln}")
+    tenants = dz.get("tenants")
+    if isinstance(tenants, dict) and (
+            len(tenants) > 1 or any(t != "default" for t in tenants)):
+        lines.append(f"{indent}tenants:")
+        rows = []
+        for name, st in sorted(tenants.items()):
+            quota = st.get("quota") or {}
+            rows.append({
+                "tenant": name,
+                "active": st.get("active_slots", 0),
+                "queued": st.get("queued", 0),
+                "completed": st.get("completed", 0),
+                "quota_tok_s": quota.get("rate_tokens_per_s", "-"),
+                "quota_avail": quota.get("available", "-"),
+                "shed": st.get("over_quota_rejects", 0),
+            })
+        for ln in _table(rows, [("tenant", "tenant"),
+                                ("active", "active"),
+                                ("queued", "queued"),
+                                ("done", "completed"),
+                                ("quota_tok/s", "quota_tok_s"),
+                                ("avail", "quota_avail"),
+                                ("shed", "shed")]):
             lines.append(f"{indent}  {ln}")
     pc = dz.get("prefix_cache")
     if pc:

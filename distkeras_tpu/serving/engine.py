@@ -636,6 +636,9 @@ class ServingEngine:
         flight_recorder: FlightRecorder | None = None,
         slo_s: float | None = None,
         weight_version: dict | None = None,
+        tenant_weights: dict | None = None,
+        tenant_quotas: dict | None = None,
+        quota_burst_s: float = 2.0,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -808,8 +811,16 @@ class ServingEngine:
                                      self._param_shardings)
         self.slots = int(slots)
         self.metrics = metrics or ServingMetrics()
-        self.scheduler = Scheduler(max_depth=max_queue,
-                                   registry=self.metrics.registry)
+        self.scheduler = Scheduler(
+            max_depth=max_queue,
+            registry=self.metrics.registry,
+            tenant_weights=tenant_weights,
+            tenant_quotas=tenant_quotas,
+            quota_burst_s=quota_burst_s,
+            # ONE labeler across the scheduler's and the metrics'
+            # tenant families: a tenant past the cardinality cap folds
+            # into "__other__" consistently everywhere.
+            tenant_labeler=getattr(self.metrics, "tenant_labeler", None))
         self._min_bucket = int(min_prefill_bucket)
         self._chunk = None if prefill_chunk is None else int(prefill_chunk)
         self._prefill_rr = 0  # round-robin cursor over prefilling slots
@@ -1227,6 +1238,26 @@ class ServingEngine:
                     row["kv_bytes"] = kv_by_dev[dev]
         return rows
 
+    def tenant_snapshot(self) -> dict:
+        """Per-tenant QoS rollup for healthz/debugz — occupancy (active
+        decode slots), queue depth, quota bucket state, over-quota shed
+        counts, and lifetime completed/token counters — refreshing the
+        labeled tenant gauges on the way (scrape-time, like the memory
+        gauges: the triage page for "is one tenant starving the
+        fleet")."""
+        active: dict[str, int] = {}
+        for st in self._slot_state:
+            if st is not None:
+                t = st.request.tenant
+                active[t] = active.get(t, 0) + 1
+        out = self.scheduler.tenant_stats()
+        for tenant, n in active.items():
+            out.setdefault(tenant, {"queued": 0})["active_slots"] = n
+        for tenant, counts in self.metrics.tenant_counters().items():
+            out.setdefault(tenant, {"queued": 0}).update(counts)
+        self.metrics.set_tenant_active(active)
+        return out
+
     @property
     def active_slots(self) -> int:
         return sum(1 for s in self._slot_state if s is not None)
@@ -1255,6 +1286,7 @@ class ServingEngine:
                 "slot": i,
                 "state": "prefill" if st.prefill is not None else "decode",
                 "trace_id": req.trace_id,
+                "tenant": req.tenant,
                 "depth": len(req.prompt) + len(req.out_tokens),
                 "remaining": st.remaining,
                 "age_s": (round(now - req.t_submit, 6)
@@ -1283,6 +1315,7 @@ class ServingEngine:
             "slots": slots,
             "active_slots": self.active_slots,
             "queue": self.scheduler.debugz(now),
+            "tenants": self.tenant_snapshot(),
             "stopping": self._stopping,
             "pending_swap": self._pending_swap is not None,
             "decode_compile_count": self.decode_compile_count(),
@@ -1320,7 +1353,7 @@ class ServingEngine:
         return out
 
     # -- submission ---------------------------------------------------------
-    def submit(
+    def _build_request(
         self,
         prompt,
         max_new_tokens: int,
@@ -1330,13 +1363,11 @@ class ServingEngine:
         timeout: float | None = None,
         trace_id: str | None = None,
         speculate: bool = True,
+        tenant: str = "default",
     ) -> Request:
-        """Validate and enqueue a request; returns the streaming handle.
-
-        Raises :class:`ValueError` (bad prompt / context overflow),
-        :class:`QueueFullError` (backpressure), or :class:`EngineStopped`
-        (shutting down) — all before any device work.
-        """
+        """Validation half of submission: everything that can reject a
+        request typed BEFORE it touches the scheduler — shared by
+        :meth:`submit` and the batched :meth:`submit_many`."""
         if self._stopping:
             raise EngineStopped("engine is shutting down; not admitting")
         prompt_arr = np.asarray(prompt, np.int32)
@@ -1370,20 +1401,85 @@ class ServingEngine:
         req = Request(
             prompt_arr.tolist(), max_new_tokens, temperature=temperature,
             priority=priority, timeout=timeout, trace_id=trace_id,
-            speculate=speculate,
+            speculate=speculate, tenant=tenant,
         )
         if self._trace_requests:
             req.trace = TimelineRecord(req.trace_id, "engine",
                                        self.trace_source)
             req.trace.event("submit", prompt_tokens=len(req.prompt),
                             max_new_tokens=req.max_new_tokens,
-                            priority=req.priority)
+                            priority=req.priority, tenant=req.tenant)
+        return req
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        priority: int = 0,
+        timeout: float | None = None,
+        trace_id: str | None = None,
+        speculate: bool = True,
+        tenant: str = "default",
+    ) -> Request:
+        """Validate and enqueue a request; returns the streaming handle.
+
+        Raises :class:`ValueError` (bad prompt / context overflow),
+        :class:`QueueFullError` (backpressure),
+        :class:`TenantOverQuota` (the tenant's token-rate budget has no
+        room), or :class:`EngineStopped` (shutting down) — all before
+        any device work.
+        """
+        req = self._build_request(
+            prompt, max_new_tokens, temperature=temperature,
+            priority=priority, timeout=timeout, trace_id=trace_id,
+            speculate=speculate, tenant=tenant)
         try:
             self.scheduler.submit(req)
         except ServingError:
             self.metrics.record_reject()
             raise
         return req
+
+    def submit_many(self, specs) -> list:
+        """Batched admission for the binary front door: every spec that
+        arrived in one event-loop tick is validated and handed to the
+        scheduler in ONE ``submit_many`` call (one clock read, one
+        arrival wake-up). Returns a list aligned with ``specs``: a
+        :class:`Request` per accepted entry, the typed exception
+        (:class:`ServingError` or ``ValueError``-shaped bad input) per
+        rejected one — different streams on one connection fail
+        independently."""
+        built: list = []
+        for spec in specs:
+            try:
+                built.append(self._build_request(
+                    spec["prompt"], spec["max_new_tokens"],
+                    temperature=float(spec.get("temperature", 0.0)),
+                    priority=int(spec.get("priority", 0)),
+                    timeout=spec.get("timeout"),
+                    trace_id=spec.get("trace_id"),
+                    speculate=bool(spec.get("speculate", True)),
+                    tenant=str(spec.get("tenant") or "default"),
+                ))
+            except (ServingError, KeyError, TypeError, ValueError) as e:
+                built.append(e)
+        reqs = [r for r in built if isinstance(r, Request)]
+        outcomes = iter(self.scheduler.submit_many(reqs))
+        out: list = []
+        for r in built:
+            if not isinstance(r, Request):
+                self.metrics.record_reject()
+                out.append(r)
+                continue
+            err = next(outcomes)
+            if err is not None:
+                self.metrics.record_reject()
+                out.append(err)
+            else:
+                out.append(r)
+        return out
 
     # -- lifecycle ----------------------------------------------------------
     def shutdown(self, drain: bool = True) -> None:
@@ -2424,19 +2520,26 @@ class ServingEngine:
 
     def _finish_ok(self, req: Request) -> None:
         req.t_done = time.monotonic()
+        self.scheduler.release_quota(req)
         self.metrics.record_finish(req.t_done - req.t_submit)
+        self.metrics.record_tenant_done(req.tenant, len(req.out_tokens))
         self._finalize_trace(req, "ok")
         req.events.put_nowait(("done", {
             "tokens": len(req.out_tokens),
             "ttft_s": req.ttft,
             "latency_s": req.t_done - req.t_submit,
             "weight_version": req.weight_version,
+            "tenant": req.tenant,
         }))
         req.done.set()
 
     def _finish_error(self, req: Request, err: ServingError) -> None:
         req.error = err
         req.t_done = time.monotonic()
+        # Quota credit on EVERY terminal path: a charged request that
+        # expired in queue must hand its unused tokens back, or a
+        # bursty tenant's failed work double-charges its budget.
+        self.scheduler.release_quota(req)
         self._finalize_trace(req, err.code, message=str(err))
         req.events.put_nowait(("error", err))
         req.done.set()

@@ -184,6 +184,17 @@ class ServingMetrics:
             "serving_slots_active", help="occupied decode slots")
         self._g_occupancy = reg.gauge(
             "serving_slot_occupancy", help="occupied / total slots")
+        # Multi-tenant accounting: lifetime completed/token counters and
+        # the per-tenant occupancy gauge, all labeled by tenant through
+        # ONE shared cardinality-capping labeler (the engine hands the
+        # same instance to its Scheduler, so a tenant is labeled — or
+        # folded into "__other__" — consistently across every family).
+        from distkeras_tpu.serving.scheduler import TenantLabeler
+
+        self.tenant_labeler = TenantLabeler()
+        self._tenant_completed: dict[str, int] = {}
+        self._tenant_tokens: dict[str, int] = {}
+        self._tenant_active_gauges: dict[str, object] = {}
 
     # -- counter compatibility surface (pre-registry attribute names) -------
     @property
@@ -301,6 +312,55 @@ class ServingMetrics:
     @property
     def spec_accepted_tokens(self) -> int:
         return int(self._c_spec_accepted.value)
+
+    def _tenant_label(self, tenant: str) -> str:
+        return self.tenant_labeler(tenant)
+
+    def record_tenant_done(self, tenant: str, tokens: int) -> None:
+        """One completed request's per-tenant accounting: request and
+        token counters, both as host dicts (healthz rollups) and labeled
+        registry counters (metricsz)."""
+        label = self._tenant_label(tenant)
+        self._tenant_completed[label] = (
+            self._tenant_completed.get(label, 0) + 1)
+        self._tenant_tokens[label] = (
+            self._tenant_tokens.get(label, 0) + int(tokens))
+        self.registry.counter(
+            "serving_tenant_requests_completed_total",
+            help="completed requests per tenant", tenant=label).inc()
+        self.registry.counter(
+            "serving_tenant_tokens_out_total",
+            help="tokens streamed per tenant", tenant=label).inc(
+                int(tokens))
+
+    def tenant_counters(self) -> dict[str, dict]:
+        return {t: {"completed": self._tenant_completed.get(t, 0),
+                    "tokens_out": self._tenant_tokens.get(t, 0)}
+                for t in self._tenant_completed}
+
+    def set_tenant_active(self, active: dict[str, int]) -> None:
+        """Refresh the per-tenant occupancy gauges; tenants that dropped
+        to zero active slots read 0 (their series stays, bounded by the
+        label cap) so a scrape sees the release, not a stale high.
+        Counts aggregate per LABEL (over-cap tenants share
+        ``__other__``) so the folded series reports the sum, not one
+        arbitrary tenant's value."""
+        by_label: dict[str, int] = {}
+        for tenant, n in active.items():
+            label = self._tenant_label(tenant)
+            by_label[label] = by_label.get(label, 0) + int(n)
+        for label, gauge in self._tenant_active_gauges.items():
+            if label not in by_label:
+                gauge.set(0)
+        for label, n in by_label.items():
+            g = self._tenant_active_gauges.get(label)
+            if g is None:
+                g = self.registry.gauge(
+                    "serving_tenant_slots_active",
+                    help="occupied decode slots per tenant",
+                    tenant=label)
+                self._tenant_active_gauges[label] = g
+            g.set(n)
 
     def record_slo_violation(self) -> None:
         self._c_slo_violations.inc()

@@ -1,17 +1,31 @@
-"""Request queue and admission control for the serving engine.
+"""Request queue, admission control, and multi-tenant QoS for the
+serving engine.
 
-Policy: **FIFO within priority** (lower ``priority`` value is served
-first; ties break by arrival order), **bounded depth** (submission past
-``max_depth`` raises :class:`QueueFullError` — the engine sheds load with
-a typed error instead of growing an unbounded queue toward OOM), and
-**per-request deadlines** (a request that has not *completed* within its
-``timeout`` is expired, whether still queued or mid-decode).
+Policy, in layers:
+
+- **priority classes** (lower ``priority`` value is served first) —
+  unchanged from the original FIFO scheduler;
+- **weighted deficit round robin across tenants WITHIN a class**: every
+  request carries a ``tenant`` id (default ``"default"``), and each
+  class serves its tenants by DRR over *token* cost (a request costs its
+  remaining ``max_new_tokens``) with per-tenant ``tenant_weights``. One
+  tenant flooding the queue therefore cannot starve the others — it only
+  deepens its OWN backlog. With a single tenant the DRR ring has one
+  member and the scheduler degenerates to exactly the old FIFO order;
+- **per-tenant token-rate quotas** (``tenant_quotas``: tokens/second
+  budgets backed by a token bucket with ``quota_burst_s`` of burst):
+  enforced at ``submit`` only — an over-quota tenant gets a typed
+  :class:`TenantOverQuota` reject before any device work, and a stream
+  that was admitted is NEVER killed mid-flight for quota. Unused charge
+  (a stream that finished early) is credited back at completion;
+- **bounded depth** (:class:`QueueFullError` past ``max_depth``) and
+  **per-request deadlines**, as before.
 """
 
 from __future__ import annotations
 
 import asyncio
-import heapq
+import collections
 import itertools
 import time
 from typing import AsyncIterator, Sequence
@@ -27,9 +41,14 @@ __all__ = [
     "PoolExhausted",
     "RequestTimeout",
     "EngineStopped",
+    "TenantOverQuota",
+    "TenantQuota",
+    "DEFAULT_TENANT",
     "Request",
     "Scheduler",
 ]
+
+DEFAULT_TENANT = "default"
 
 
 class ServingError(Exception):
@@ -75,6 +94,78 @@ class RequestCancelled(ServingError):
     code = "cancelled"
 
 
+class TenantOverQuota(ServingError):
+    """The tenant's token-rate quota has no room for this request's
+    ``max_new_tokens``. Raised at submit ONLY — admitted streams are
+    never cut mid-flight for quota; the reject is the tenant's signal to
+    back off (a well-behaved client retries after ~need/rate seconds)."""
+
+    code = "tenant_over_quota"
+
+
+class TenantLabeler:
+    """One shared cardinality cap for per-tenant label series: past
+    ``cap`` distinct tenants, new ids map to ``__other__`` so id churn
+    (or a hostile client minting tenants) cannot grow the scrape
+    unbounded. The ENGINE hands one instance to both the scheduler and
+    ServingMetrics, so a tenant is either labeled in every family or
+    folded in every family — never half-joined across dashboards."""
+
+    def __init__(self, cap: int = 32):
+        self.cap = int(cap)
+        self.seen: set[str] = set()
+
+    def __call__(self, tenant: str) -> str:
+        if tenant in self.seen or len(self.seen) < self.cap:
+            self.seen.add(tenant)
+            return tenant
+        return "__other__"
+
+
+class TenantQuota:
+    """Token bucket for one tenant: refills at ``rate`` tokens/second up
+    to ``rate * burst_s`` capacity. ``take`` charges a request's worst
+    case (its ``max_new_tokens``) at submit; ``credit`` returns the
+    unused part when the stream finishes short — so the quota meters
+    tokens the tenant could actually have consumed, not its optimism."""
+
+    def __init__(self, rate: float, burst_s: float = 2.0):
+        if rate <= 0:
+            raise ValueError(f"quota rate must be > 0 tok/s, got {rate}")
+        self.rate = float(rate)
+        self.capacity = max(self.rate * float(burst_s), 1.0)
+        self.available = self.capacity
+        self._t: float | None = None
+
+    def _refill(self, now: float) -> None:
+        if self._t is None:
+            self._t = now
+            return
+        dt = now - self._t
+        if dt > 0:
+            self.available = min(self.capacity,
+                                 self.available + dt * self.rate)
+        self._t = now
+
+    def take(self, n: float, now: float) -> bool:
+        self._refill(now)
+        if self.available >= n:
+            self.available -= n
+            return True
+        return False
+
+    def credit(self, n: float) -> None:
+        if n > 0:
+            self.available = min(self.capacity, self.available + n)
+
+    def stats(self) -> dict:
+        return {
+            "rate_tokens_per_s": self.rate,
+            "burst_capacity": round(self.capacity, 3),
+            "available": round(self.available, 3),
+        }
+
+
 class Request:
     """One generation request plus its streaming output channel.
 
@@ -94,6 +185,7 @@ class Request:
         timeout: float | None = None,
         trace_id: str | None = None,
         speculate: bool = True,
+        tenant: str = DEFAULT_TENANT,
     ):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -106,6 +198,14 @@ class Request:
         # is a greedy-consistency rule.
         self.speculate = bool(speculate)
         self.priority = int(priority)
+        # Multi-tenant QoS identity: rides the wire client -> router ->
+        # replica, keys the scheduler's fair queueing and quotas, and is
+        # echoed on the done line so per-tenant accounting closes the
+        # loop. Cast defensively — it arrives from the wire.
+        self.tenant = str(tenant) if tenant else DEFAULT_TENANT
+        # Tokens charged against the tenant's quota at submit; the
+        # scheduler credits back the unused part at completion.
+        self.quota_charged = 0
         # Every request carries a trace id: the client's (propagated over
         # the wire, sanitized against junk) or a fresh mint — so
         # done/error replies, debugz slot tables, and histogram exemplars
@@ -164,6 +264,7 @@ class Request:
             else:  # "error"
                 raise payload
 
+
     async def result(self) -> list[int]:
         await self.done.wait()
         if self.error is not None:
@@ -171,45 +272,106 @@ class Request:
         return self.out_tokens
 
 
-class Scheduler:
-    """Bounded priority-FIFO queue with deadline expiry.
+class _TenantQueue:
+    """One tenant's FIFO within one priority class, plus its DRR
+    deficit counter."""
 
-    Pure bookkeeping — no device state. The engine calls :meth:`pop` between
-    decode iterations to fill free slots and :meth:`expire` to shed requests
-    whose deadline passed while queued.
+    __slots__ = ("name", "q", "deficit", "turn_topped")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.q: collections.deque = collections.deque()  # (seq, Request)
+        self.deficit = 0.0
+        # One quantum top-up per service TURN (reset when the turn
+        # passes): without this, the ring head would be re-funded on
+        # every pop and never yield — the anti-starvation property DRR
+        # exists for.
+        self.turn_topped = False
+
+
+class _PrioClass:
+    """One priority value's tenants and their DRR service ring."""
+
+    __slots__ = ("tenants", "ring")
+
+    def __init__(self):
+        self.tenants: dict[str, _TenantQueue] = {}
+        self.ring: collections.deque = collections.deque()  # _TenantQueue
+
+
+class Scheduler:
+    """Bounded multi-tenant queue: priority classes served lowest-first,
+    weighted deficit round robin across tenants within a class, FIFO
+    within a tenant, deadline expiry, and per-tenant token-rate quotas.
+
+    Pure bookkeeping — no device state. The engine calls :meth:`pop`
+    between decode iterations to fill free slots and :meth:`expire` to
+    shed requests whose deadline passed while queued.
+
+    ``tenant_weights``: relative DRR weights (missing tenants weigh 1.0)
+    — a weight-2 tenant is offered twice the token bandwidth of a
+    weight-1 tenant when both have backlog. ``tenant_quotas``: tokens/
+    second budgets (missing tenants are unmetered); ``quota_burst_s``
+    sizes each bucket's burst. ``drr_quantum``: deficit added per
+    service turn (tokens) — smaller interleaves finer, larger favors
+    per-tenant batching; the default of 64 serves several typical
+    requests per turn.
     """
 
     def __init__(self, max_depth: int = 64, registry=None, cache_probe=None,
-                 probe_window: int = 8, max_overtake: int = 4):
+                 probe_window: int = 8, max_overtake: int = 4,
+                 tenant_weights: dict | None = None,
+                 tenant_quotas: dict | None = None,
+                 quota_burst_s: float = 2.0,
+                 drr_quantum: int = 64,
+                 tenant_labeler: TenantLabeler | None = None):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = int(max_depth)
         # Cache-aware admission: an optional ``prompt -> matched-token
         # count`` scorer (the prefix cache's ``probe``); when set, pop()
         # may serve a cache-hitting request ahead of colder ones within
-        # the same priority class (bounded by ``probe_window``) — a hit
-        # admits nearly for free, so serving it first raises goodput
-        # without starving anyone outside the window.
+        # the same priority class AND tenant (bounded by
+        # ``probe_window``) — a hit admits nearly for free, so serving
+        # it first raises goodput without starving anyone outside the
+        # window. The window never crosses tenants: cache affinity must
+        # not override fairness.
         self.cache_probe = cache_probe
         self.probe_window = int(probe_window)
         # Starvation bound: once a request has been overtaken this many
-        # times while at the head of its class, it is served regardless
+        # times while at the head of its queue, it is served regardless
         # of cache scores (otherwise steady cache-warm traffic refilling
         # the window could delay a cold head forever).
         self.max_overtake = int(max_overtake)
-        self._heap: list[tuple[int, int, Request]] = []
+        self.tenant_weights = dict(tenant_weights or {})
+        if drr_quantum < 1:
+            raise ValueError(f"drr_quantum must be >= 1, got {drr_quantum}")
+        self.drr_quantum = int(drr_quantum)
+        self._quotas: dict[str, TenantQuota] = {}
+        for name, rate in (tenant_quotas or {}).items():
+            self._quotas[str(name)] = (
+                rate if isinstance(rate, TenantQuota)
+                else TenantQuota(float(rate), quota_burst_s))
+        self._classes: dict[int, _PrioClass] = {}
+        self._n = 0
         self._seq = itertools.count()
         # Requeues (preemption, admission park) jump to the FRONT of
-        # their priority class: sequence numbers from a deeply negative
-        # counter sort before every arrival seq (which starts at 0)
-        # while staying FIFO among requeues themselves.
+        # their tenant's queue AND their tenant to the front of the DRR
+        # ring: sequence numbers from a deeply negative counter keep
+        # them ordered before every arrival in flattened views while
+        # staying FIFO among requeues themselves.
         self._requeue_seq = itertools.count(-(2**62))
         self._arrival = asyncio.Event()
         # Requests found expired during pop(), awaiting pickup by expire().
         self._expired_backlog: list[Request] = []
+        # Per-tenant shed accounting (quota rejects), served by
+        # tenant_stats() / healthz even without a registry.
+        self.over_quota_rejects: collections.Counter = collections.Counter()
+        self._tenant_label = tenant_labeler or TenantLabeler()
         # Optional telemetry (MetricsRegistry): admission counters + live
         # depth gauge, so a scrape sees queue pressure without waiting for
         # the engine's next sample() record.
+        self._registry = registry
         self._c_submitted = self._c_shed = self._g_depth = None
         self._c_cache_preferred = self._c_requeued = None
         if registry is not None:
@@ -230,60 +392,230 @@ class Scheduler:
                      "or admission parked on a dry pool)")
 
     def __len__(self) -> int:
-        return len(self._heap)
+        return self._n
+
+    # -- tenant helpers -----------------------------------------------------
+    def _weight(self, tenant: str) -> float:
+        try:
+            w = float(self.tenant_weights.get(tenant, 1.0))
+        except (TypeError, ValueError):
+            return 1.0
+        return w if w > 0 else 1.0
+
+    @staticmethod
+    def _cost(request: Request) -> float:
+        """DRR cost of serving a request: the decode tokens it is still
+        owed (a preempted resume costs only its remainder)."""
+        return float(max(1, request.max_new_tokens
+                         - len(request.out_tokens)))
+
+    def set_tenant_quota(self, tenant: str, rate: float,
+                         burst_s: float = 2.0) -> None:
+        """Install or replace one tenant's token-rate quota at runtime."""
+        self._quotas[str(tenant)] = TenantQuota(float(rate), burst_s)
 
     def _note_depth(self) -> None:
         if self._g_depth is not None:
-            self._g_depth.set(len(self._heap))
+            self._g_depth.set(self._n)
 
-    def submit(self, request: Request, now: float | None = None) -> None:
-        """Enqueue; raises :class:`QueueFullError` at ``max_depth``."""
-        if len(self._heap) >= self.max_depth:
+    # -- submission ---------------------------------------------------------
+    def _submit_one(self, request: Request, now: float) -> None:
+        if self._n >= self.max_depth:
             raise QueueFullError(
-                f"queue depth {len(self._heap)} at max_depth={self.max_depth}"
-            )
-        request.t_submit = time.monotonic() if now is None else now
-        heapq.heappush(self._heap, (request.priority, next(self._seq), request))
+                f"queue depth {self._n} at max_depth={self.max_depth}")
+        quota = self._quotas.get(request.tenant)
+        if quota is not None:
+            need = max(1, request.max_new_tokens)
+            if not quota.take(need, now):
+                self.over_quota_rejects[request.tenant] += 1
+                if self._registry is not None:
+                    self._registry.counter(
+                        "scheduler_tenant_over_quota_total",
+                        help="requests rejected at submit because the "
+                             "tenant's token-rate quota had no room",
+                        tenant=self._tenant_label(request.tenant)).inc()
+                if need > quota.capacity:
+                    # Not a transient: no amount of waiting refills past
+                    # the burst capacity — the retry advice below would
+                    # be a lie (same stance as PoolExhausted's sizing
+                    # reject).
+                    raise TenantOverQuota(
+                        f"tenant {request.tenant!r}: request needs "
+                        f"{need} tokens but the quota's burst capacity "
+                        f"is {quota.capacity:g} (rate {quota.rate:g} "
+                        f"tok/s) — it can NEVER be admitted; raise the "
+                        f"quota/burst or lower max_new_tokens")
+                raise TenantOverQuota(
+                    f"tenant {request.tenant!r} over quota: request needs "
+                    f"{need} tokens, bucket has "
+                    f"{quota.available:.1f} (rate "
+                    f"{quota.rate:g} tok/s) — back off and retry")
+            request.quota_charged = need
+        request.t_submit = now
+        self._push(request, next(self._seq))
         if self._c_submitted is not None:
             self._c_submitted.inc()
-            self._note_depth()
+
+    def _push(self, request: Request, seq: int, front: bool = False) -> None:
+        cls = self._classes.get(request.priority)
+        if cls is None:
+            cls = self._classes[request.priority] = _PrioClass()
+        tq = cls.tenants.get(request.tenant)
+        if tq is None:
+            tq = cls.tenants[request.tenant] = _TenantQueue(request.tenant)
+            cls.ring.append(tq)
+        if front:
+            tq.q.appendleft((seq, request))
+        else:
+            tq.q.append((seq, request))
+        self._n += 1
+
+    def submit(self, request: Request, now: float | None = None) -> None:
+        """Enqueue; raises :class:`QueueFullError` at ``max_depth`` or
+        :class:`TenantOverQuota` when the tenant's token budget has no
+        room (both before any device work, both typed)."""
+        self._submit_one(request,
+                         time.monotonic() if now is None else now)
+        self._note_depth()
         self._arrival.set()
+
+    def submit_many(self, requests: Sequence[Request],
+                    now: float | None = None) -> list:
+        """Batched admission: enqueue every request under ONE clock and
+        one arrival wake-up — the scheduler half of the front door's
+        drain-all-ready-frames-per-tick path. Returns a list aligned
+        with ``requests``: ``None`` for accepted entries, the typed
+        :class:`ServingError` for rejected ones (per-request rejects
+        must not fail the whole batch — they are different clients)."""
+        now = time.monotonic() if now is None else now
+        out: list = []
+        for request in requests:
+            try:
+                self._submit_one(request, now)
+            except ServingError as e:
+                out.append(e)
+            else:
+                out.append(None)
+        self._note_depth()
+        if any(e is None for e in out):
+            self._arrival.set()
+        return out
 
     def requeue(self, request: Request) -> None:
         """Return an already-admitted (or popped-but-unadmittable)
         request to the FRONT of its priority class — the preempt-and-
         requeue half of KV-pool oversubscription. Bypasses ``max_depth``
         (shedding a request the engine itself displaced would turn a
-        capacity wobble into a client-visible error) and keeps the
-        original ``t_submit`` so the deadline clock never resets."""
-        heapq.heappush(
-            self._heap,
-            (request.priority, next(self._requeue_seq), request))
+        capacity wobble into a client-visible error) AND the tenant
+        quota (its tokens were already charged at first admission), and
+        keeps the original ``t_submit`` so the deadline clock never
+        resets. The tenant moves to the front of its class's DRR ring
+        with enough deficit banked to be served next."""
+        self._push(request, next(self._requeue_seq), front=True)
+        cls = self._classes[request.priority]
+        tq = cls.tenants[request.tenant]
+        if cls.ring and cls.ring[0] is not tq:
+            cls.ring.remove(tq)
+            cls.ring.appendleft(tq)
+        tq.deficit = max(tq.deficit, self._cost(request))
         if self._c_requeued is not None:
             self._c_requeued.inc()
-            self._note_depth()
+        self._note_depth()
         self._arrival.set()
 
-    def _pop_valid(self, now: float):
-        """Pop heap entries until a live one surfaces; dead ones (expired
-        or cancelled while queued) go to the expired backlog so expire()
-        hands them back uniformly. Returns the full heap tuple or None."""
-        while self._heap:
-            item = heapq.heappop(self._heap)
-            req = item[2]
+    # -- service ------------------------------------------------------------
+    def _prune_head(self, tq: _TenantQueue, now: float) -> Request | None:
+        """Drop dead (cancelled/expired) heads into the expired backlog;
+        returns the live head or None when the tenant queue emptied."""
+        while tq.q:
+            req = tq.q[0][1]
             if req.cancelled or (req.deadline is not None
                                  and now > req.deadline):
+                tq.q.popleft()
+                self._n -= 1
                 self._expired_backlog.append(req)
                 continue
-            return item
+            return req
         return None
 
+    def _drop_tenant(self, cls: _PrioClass, tq: _TenantQueue) -> None:
+        del cls.tenants[tq.name]
+        try:
+            cls.ring.remove(tq)
+        except ValueError:
+            pass
+
+    def _drr_pick(self, cls: _PrioClass, now: float):
+        """The tenant whose head this class serves next: visit the ring,
+        topping up deficits by weight x quantum, until one covers its
+        head's cost. Terminates: every full cycle raises every backlog
+        tenant's deficit, so the cheapest head qualifies within
+        ``ceil(cost / quantum)`` cycles (one, in the common case)."""
+        while cls.ring:
+            tq = cls.ring[0]
+            head = self._prune_head(tq, now)
+            if head is None:
+                self._drop_tenant(cls, tq)
+                continue
+            cost = self._cost(head)
+            if tq.deficit >= cost:
+                return tq
+            if not tq.turn_topped:
+                # One top-up per turn: a tenant serves until its banked
+                # deficit runs out, then the turn passes — re-funding
+                # the head on every pop would let it hog the ring.
+                tq.turn_topped = True
+                tq.deficit += self.drr_quantum * self._weight(tq.name)
+                if tq.deficit >= cost:
+                    return tq
+            tq.turn_topped = False
+            cls.ring.rotate(-1)
+        return None
+
+    def _serve(self, cls: _PrioClass, tq: _TenantQueue,
+               now: float) -> Request:
+        """Pop from the chosen tenant's queue — FIFO, except the bounded
+        cache-probe window (same class, same tenant) may serve the best
+        prefix hit first; ``max_overtake`` bounds how often the head can
+        be passed over."""
+        idx = 0
+        head = tq.q[0][1]
+        if (self.cache_probe is not None and len(tq.q) > 1
+                and head.cache_overtaken < self.max_overtake):
+            window = min(self.probe_window, len(tq.q))
+            best_score = self.cache_probe(head.prompt)
+            for i in range(1, window):
+                req_i = tq.q[i][1]
+                if req_i.cancelled or (req_i.deadline is not None
+                                       and now > req_i.deadline):
+                    continue
+                score = self.cache_probe(req_i.prompt)
+                # Strict >: equal scores preserve FIFO arrival order.
+                if score > best_score:
+                    idx, best_score = i, score
+            if idx != 0:
+                head.cache_overtaken += 1
+                if self._c_cache_preferred is not None:
+                    self._c_cache_preferred.inc()
+        req = tq.q[idx][1]
+        del tq.q[idx]
+        self._n -= 1
+        tq.deficit -= self._cost(req)
+        if not tq.q:
+            self._drop_tenant(cls, tq)
+        return req
+
     def peek(self) -> Request | None:
-        """Non-destructive view of the head request (heap order), or
-        None if empty. May return an expired/cancelled request — callers
-        using peek() as an admission hint must still pop() for deadline
-        handling."""
-        return self._heap[0][2] if self._heap else None
+        """Non-destructive view of the request :meth:`pop` would serve
+        next (best-effort: deficits are not consumed), or None if empty.
+        May return an expired/cancelled request — callers using peek()
+        as an admission hint must still pop() for deadline handling."""
+        for prio in sorted(self._classes):
+            cls = self._classes[prio]
+            for tq in cls.ring:
+                if tq.q:
+                    return tq.q[0][1]
+        return None
 
     def has_streamed(self) -> bool:
         """True when any queued live request has already streamed tokens
@@ -291,55 +623,48 @@ class Scheduler:
         under the weights that produced its streamed prefix, so the
         engine holds a pending param swap while the queue carries one
         (admission==completion provenance survives preempt-requeue)."""
-        return any(item[2].out_tokens and not item[2].cancelled
-                   for item in self._heap)
+        return any(req.out_tokens and not req.cancelled
+                   for _, req in self._iter_items())
+
+    def _iter_items(self):
+        for cls in self._classes.values():
+            for tq in cls.tenants.values():
+                yield from tq.q
 
     def pop(self, now: float | None = None) -> Request | None:
-        """Highest-priority non-expired request, or None if empty.
-
-        With ``cache_probe`` set, up to ``probe_window`` head requests of
-        the SAME priority class are scored by matched-prefix length and
-        the best hit is served first: FIFO breaks ties, other priority
-        classes are never jumped, the window bounds the probe cost per
-        pop, and ``max_overtake`` bounds how many times any request can
-        be passed over in total — a cold request under sustained
-        cache-warm traffic is served after at most ``max_overtake``
-        extra pops once it reaches its class head.
-        """
+        """Highest-priority non-expired request, or None if empty —
+        within the class, the tenant DRR's pick; within the tenant,
+        FIFO modulo the bounded cache-probe window."""
         now = time.monotonic() if now is None else now
-        head = self._pop_valid(now)
-        if head is None:
+        while self._classes:
+            prio = min(self._classes)
+            cls = self._classes[prio]
+            tq = self._drr_pick(cls, now)
+            if tq is None:
+                # Class emptied while pruning dead heads.
+                self._classes.pop(prio, None)
+                continue
+            req = self._serve(cls, tq, now)
+            if not cls.tenants:
+                # Empty classes are pruned so min() stays cheap.
+                self._classes.pop(prio, None)
             self._note_depth()
-            return None
-        if (self.cache_probe is not None and self._heap
-                and head[2].cache_overtaken < self.max_overtake):
-            cands = [head]
-            while (len(cands) < self.probe_window and self._heap
-                   and self._heap[0][0] == head[0]):
-                nxt = self._pop_valid(now)
-                if nxt is None:
-                    break
-                if nxt[0] != head[0]:
-                    # Skipping expired entries crossed into a lower
-                    # priority class: put it back, keep the window
-                    # class-pure.
-                    heapq.heappush(self._heap, nxt)
-                    break
-                cands.append(nxt)
-            # max() keeps the FIRST maximum — candidates are in pop
-            # (FIFO) order, so equal scores preserve arrival order.
-            best = max(cands, key=lambda it: self.cache_probe(it[2].prompt))
-            for it in cands:
-                if it is not best:
-                    heapq.heappush(self._heap, it)
-            if best is not head:
-                head[2].cache_overtaken += 1
-                if self._c_cache_preferred is not None:
-                    self._c_cache_preferred.inc()
-            self._note_depth()
-            return best[2]
+            return req
         self._note_depth()
-        return head[2]
+        return None
+
+    def release_quota(self, request: Request) -> None:
+        """Credit back the unused part of a finished request's quota
+        charge (a stream that stopped early was charged its worst case).
+        Called by the engine on every terminal path; a request that was
+        never charged is a no-op."""
+        if not request.quota_charged:
+            return
+        quota = self._quotas.get(request.tenant)
+        unused = request.quota_charged - len(request.out_tokens)
+        request.quota_charged = 0
+        if quota is not None and unused > 0:
+            quota.credit(unused)
 
     def expire(self, now: float | None = None) -> list[Request]:
         """Remove and return every queued request whose deadline passed or
@@ -347,33 +672,87 @@ class Scheduler:
         now = time.monotonic() if now is None else now
         expired = self._expired_backlog
         self._expired_backlog = []
-        keep = []
-        for item in self._heap:
-            req = item[2]
-            if req.cancelled or (req.deadline is not None
-                                 and now > req.deadline):
-                expired.append(req)
-            else:
-                keep.append(item)
-        if len(keep) != len(self._heap):
-            heapq.heapify(keep)
-            self._heap = keep
+        for prio in list(self._classes):
+            cls = self._classes[prio]
+            for name in list(cls.tenants):
+                tq = cls.tenants[name]
+                keep = collections.deque()
+                for item in tq.q:
+                    req = item[1]
+                    if req.cancelled or (req.deadline is not None
+                                         and now > req.deadline):
+                        expired.append(req)
+                        self._n -= 1
+                    else:
+                        keep.append(item)
+                tq.q = keep
+                if not keep:
+                    self._drop_tenant(cls, tq)
+            if not cls.tenants:
+                del self._classes[prio]
         if expired and self._c_shed is not None:
             self._c_shed.inc(len(expired))
-            self._note_depth()
+        self._note_depth()
         return expired
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant QoS snapshot: queue depth (across classes), DRR
+        weight, quota bucket state, and over-quota shed count — the
+        healthz/debugz payload, and the refresh point for the labeled
+        ``scheduler_tenant_depth`` gauges (scrape-time, like the memory
+        gauges: a passive registry cannot watch the queue itself)."""
+        depth: collections.Counter = collections.Counter()
+        for _, req in self._iter_items():
+            depth[req.tenant] += 1
+        # Every tenant that EVER had a labeled series is refreshed, so
+        # a tenant whose queue drained reads 0 on the next scrape
+        # instead of its last nonzero depth forever.
+        tenants = sorted(set(depth) | set(self._quotas)
+                         | set(self.over_quota_rejects)
+                         | self._tenant_label.seen)
+        out = {}
+        for name in tenants:
+            entry: dict = {"queued": int(depth.get(name, 0))}
+            if name in self.tenant_weights:
+                entry["weight"] = self._weight(name)
+            quota = self._quotas.get(name)
+            if quota is not None:
+                entry["quota"] = quota.stats()
+            shed = int(self.over_quota_rejects.get(name, 0))
+            if shed:
+                entry["over_quota_rejects"] = shed
+            out[name] = entry
+        if self._registry is not None:
+            # Aggregate per LABEL before setting: past the cap, many
+            # tenants share "__other__", and last-writer-wins would
+            # report one arbitrary tenant's depth instead of the sum.
+            label_depth: collections.Counter = collections.Counter()
+            for name in tenants:
+                label_depth[self._tenant_label(name)] += depth.get(
+                    name, 0)
+            for label, d in label_depth.items():
+                self._registry.gauge(
+                    "scheduler_tenant_depth",
+                    help="queued requests per tenant",
+                    tenant=label).set(float(d))
+        return out
 
     def debugz(self, now: float | None = None, limit: int = 64) -> dict:
         """Queue introspection for the ``debugz`` verb: depth plus the
-        oldest ``limit`` queued requests in service order with their ages
-        — the page that answers "WHO is waiting and for how long" where
-        the depth gauge only answers "how many"."""
+        oldest ``limit`` queued requests in (priority, arrival) order
+        with their ages — the page that answers "WHO is waiting and for
+        how long" where the depth gauge only answers "how many" — and
+        the per-tenant QoS table."""
         now = time.monotonic() if now is None else now
+        items = sorted(
+            ((req.priority, seq, req) for seq, req in self._iter_items()),
+            key=lambda t: (t[0], t[1]))
         queued = []
-        for prio, _, req in sorted(self._heap)[:int(limit)]:
+        for prio, _, req in items[:int(limit)]:
             age = (now - req.t_submit) if req.t_submit is not None else 0.0
             entry = {
                 "trace_id": req.trace_id,
+                "tenant": req.tenant,
                 "priority": prio,
                 "age_s": round(age, 6),
                 "prompt_tokens": len(req.prompt),
@@ -383,20 +762,26 @@ class Scheduler:
                 entry["deadline_in_s"] = round(req.deadline - now, 6)
             queued.append(entry)
         return {
-            "depth": len(self._heap),
+            "depth": self._n,
             "max_depth": self.max_depth,
             # Over the WHOLE queue, not just the listed window — the
             # starvation signal must survive a deep queue.
             "oldest_age_s": round(max(
-                ((now - item[2].t_submit) for item in self._heap
-                 if item[2].t_submit is not None), default=0.0), 6),
+                ((now - req.t_submit) for _, req in self._iter_items()
+                 if req.t_submit is not None), default=0.0), 6),
             "queued": queued,
+            "tenants": self.tenant_stats(),
         }
 
     def drain(self) -> list[Request]:
-        """Remove and return everything queued (engine shutdown path)."""
-        out = [item[2] for item in sorted(self._heap)]
-        self._heap = []
+        """Remove and return everything queued (engine shutdown path),
+        in (priority, arrival) order."""
+        items = sorted(
+            ((req.priority, seq, req) for seq, req in self._iter_items()),
+            key=lambda t: (t[0], t[1]))
+        out = [req for _, _, req in items]
+        self._classes.clear()
+        self._n = 0
         out.extend(self._expired_backlog)
         self._expired_backlog = []
         self._note_depth()
@@ -405,7 +790,7 @@ class Scheduler:
     async def wait_for_request(self, timeout: float | None = None) -> bool:
         """Block until something is submitted (or timeout); True if woken
         by an arrival."""
-        if self._heap:
+        if self._n:
             return True
         self._arrival.clear()
         try:

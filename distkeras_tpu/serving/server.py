@@ -39,8 +39,9 @@ from __future__ import annotations
 import asyncio
 import json
 
+from distkeras_tpu.serving import wire
 from distkeras_tpu.serving.engine import ServingEngine
-from distkeras_tpu.serving.scheduler import ServingError
+from distkeras_tpu.serving.scheduler import Request, ServingError
 from distkeras_tpu.telemetry.request_trace import sanitize_trace_id
 
 __all__ = ["ServingServer"]
@@ -51,12 +52,27 @@ class ServingServer:
 
     ``port=0`` binds an ephemeral port (read back via :attr:`port`) —
     the test/bench-friendly default.
+
+    ``wire``: front-door protocol policy. ``"auto"`` (default) serves
+    JSONL exactly as before AND accepts the bin1 upgrade from clients
+    that offer it via the hello line (see :mod:`.wire`); ``"jsonl"``
+    refuses the upgrade (every peer stays on JSONL — the rollback knob).
+    ``flush_interval_s`` is the bin1 token-coalescing window per
+    connection: 0 batches within one event-loop tick (no added
+    latency), a small positive value trades first-token latency for
+    fewer, larger writes under many concurrent streams.
     """
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, *, wire_mode: str = "auto",
+                 flush_interval_s: float = 0.0):
+        if wire_mode not in ("auto", "jsonl"):
+            raise ValueError(
+                f"wire_mode must be 'auto' or 'jsonl', got {wire_mode!r}")
         self.engine = engine
         self.host = host
+        self.wire_mode = wire_mode
+        self.flush_interval_s = float(flush_interval_s)
         self._requested_port = port
         self._server: asyncio.AbstractServer | None = None
         self._engine_task: asyncio.Task | None = None
@@ -105,6 +121,36 @@ class ServingServer:
             except asyncio.TimeoutError:
                 pass  # idle keep-alive clients; loop cleanup cancels them
 
+    def _submit_spec(self, spec: dict) -> Request:
+        """One wire spec (JSONL line or decoded bin1 REQ frame) into the
+        engine — the protocol-agnostic submit point."""
+        return self.engine.submit(
+            spec["prompt"], spec["max_new_tokens"],
+            temperature=float(spec.get("temperature", 0.0)),
+            priority=int(spec.get("priority", 0)),
+            timeout=spec.get("timeout"),
+            trace_id=spec.get("trace_id"),
+            speculate=bool(spec.get("speculate", True)),
+            tenant=str(spec.get("tenant") or "default"),
+        )
+
+    @staticmethod
+    def _done_record(req: Request) -> dict:
+        done = {
+            "done": True,
+            "tokens": req.out_tokens,
+            "trace_id": req.trace_id,
+            "tenant": req.tenant,
+            "ttft_ms": round(1e3 * req.ttft, 3),
+            "latency_ms": round(1e3 * (req.t_done - req.t_submit), 3),
+        }
+        if req.weight_version is not None:
+            # Provenance: the exact checkpoint (version + content
+            # digest) the serving params came from — a bad answer
+            # names its weights.
+            done["weight_version"] = req.weight_version
+        return done
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
@@ -112,19 +158,27 @@ class ServingServer:
                 line = await reader.readline()
                 if not line:
                     break
+                spec: dict = {}
                 try:
                     spec = json.loads(line)
+                    if isinstance(spec, dict) and spec.get("cmd") == "hello":
+                        # Protocol negotiation: a bin1-capable client's
+                        # upgrade offer. Unknown peers never send it, so
+                        # the JSONL path below stays byte-for-byte.
+                        proto = (wire.PROTO_JSONL
+                                 if self.wire_mode == "jsonl"
+                                 else wire.choose_proto(spec.get("proto")))
+                        await self._send(writer, {"hello": {
+                            "proto": proto,
+                            "fastwire": wire.native_available()}})
+                        if proto == wire.PROTO_BIN1:
+                            await self._handle_bin1(reader, writer)
+                            return  # the frame loop owned the connection
+                        continue
                     if isinstance(spec, dict) and "cmd" in spec:
                         await self._send(writer, await self._control(spec))
                         continue
-                    req = self.engine.submit(
-                        spec["prompt"], spec["max_new_tokens"],
-                        temperature=float(spec.get("temperature", 0.0)),
-                        priority=int(spec.get("priority", 0)),
-                        timeout=spec.get("timeout"),
-                        trace_id=spec.get("trace_id"),
-                        speculate=bool(spec.get("speculate", True)),
-                    )
+                    req = self._submit_spec(spec)
                 except ServingError as e:
                     await self._send(writer, self._error(e, spec))
                     continue
@@ -144,19 +198,7 @@ class ServingServer:
                     # slot instead of generating tokens nobody will read.
                     req.cancel()
                     raise
-                done = {
-                    "done": True,
-                    "tokens": req.out_tokens,
-                    "trace_id": req.trace_id,
-                    "ttft_ms": round(1e3 * req.ttft, 3),
-                    "latency_ms": round(1e3 * (req.t_done - req.t_submit), 3),
-                }
-                if req.weight_version is not None:
-                    # Provenance: the exact checkpoint (version + content
-                    # digest) the serving params came from — a bad answer
-                    # names its weights.
-                    done["weight_version"] = req.weight_version
-                await self._send(writer, done)
+                await self._send(writer, self._done_record(req))
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
@@ -165,6 +207,128 @@ class ServingServer:
                 await writer.wait_closed()
             except (ConnectionResetError, BrokenPipeError):
                 pass
+
+    async def _handle_bin1(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        """The negotiated binary front door for one connection.
+
+        Streams are pipelined: any number of REQ frames may be in
+        flight, each tagged with the client's stream id. Every REQ that
+        arrived in one read is validated and admitted through ONE
+        ``engine.submit_many`` call (batched admission), token output is
+        coalesced per flush interval into one write for ALL streams
+        (:class:`wire.FrameSink`), and a corrupt or oversized frame is a
+        typed ``bad_request`` followed by connection close — framing
+        cannot be resynchronized, but the failure is never a hung
+        read."""
+        sink = wire.FrameSink(writer, self.flush_interval_s)
+        decoder = wire.FrameDecoder()
+        live: dict[int, Request] = {}
+        pumps: set[asyncio.Task] = set()
+        ctrls: set[asyncio.Task] = set()
+        try:
+            while True:
+                data = await reader.read(2 ** 18)
+                if not data:
+                    break
+                try:
+                    frames = decoder.feed(data)
+                except wire.WireError as e:
+                    sink.send_error(0, {"error": str(e),
+                                        "code": "bad_request"})
+                    break
+                batch: list[tuple[int, dict]] = []
+                precancelled: set[int] = set()
+                for ftype, sid, payload in frames:
+                    if ftype == wire.T_REQ:
+                        try:
+                            batch.append((sid, wire.decode_request(payload)))
+                        except wire.WireError as e:
+                            sink.send_error(sid, {"error": str(e),
+                                                  "code": "bad_request"})
+                    elif ftype == wire.T_CANCEL:
+                        req = live.get(sid)
+                        if req is not None:
+                            req.cancel()
+                        else:
+                            # The REQ may sit in THIS read's batch,
+                            # not yet submitted — remember, or a
+                            # same-tick cancel is silently lost and the
+                            # slot decodes for nobody.
+                            precancelled.add(sid)
+                    elif ftype == wire.T_CTRL:
+                        # As a task: a slow verb (reload waits for the
+                        # engine's quiet moment, up to its timeout) must
+                        # not stall every multiplexed stream on this
+                        # connection.
+                        ctrl = asyncio.get_running_loop().create_task(
+                            self._ctrl_bin1(sid, payload, sink))
+                        ctrls.add(ctrl)
+                        ctrl.add_done_callback(ctrls.discard)
+                    else:
+                        sink.send_error(sid, {
+                            "error": f"unexpected frame type {ftype}",
+                            "code": "bad_request"})
+                if batch:
+                    results = self.engine.submit_many(
+                        [spec for _, spec in batch])
+                    for (sid, spec), res in zip(batch, results):
+                        if isinstance(res, Request):
+                            live[sid] = res
+                            if sid in precancelled:
+                                res.cancel()
+                            task = asyncio.get_running_loop().create_task(
+                                self._pump_bin1(sid, res, sink, live))
+                            pumps.add(task)
+                            task.add_done_callback(pumps.discard)
+                        else:
+                            code = ("bad_request"
+                                    if not isinstance(res, ServingError)
+                                    else None)
+                            sink.send_error(sid, self._error(
+                                res, spec, code=code))
+        finally:
+            # Client gone (EOF, reset, or corrupt framing): release every
+            # in-flight slot instead of decoding for nobody.
+            for req in live.values():
+                req.cancel()
+            for task in list(ctrls):
+                task.cancel()
+            if pumps or ctrls:
+                await asyncio.gather(*pumps, *ctrls,
+                                     return_exceptions=True)
+            await sink.aclose()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _ctrl_bin1(self, sid: int, payload,
+                         sink: "wire.FrameSink") -> None:
+        """One control verb off a bin1 connection, as its own task."""
+        try:
+            rep = await self._control(wire.decode_json(payload))
+        except wire.WireError as e:
+            rep = {"error": str(e), "code": "bad_request"}
+        sink.send_json(wire.T_CTRLR, sid, rep)
+
+    async def _pump_bin1(self, sid: int, req: Request,
+                         sink: "wire.FrameSink",
+                         live: dict[int, Request]) -> None:
+        """Relay one stream's events into the shared frame sink. Token
+        pushes are synchronous buffer appends — the coalescer turns a
+        whole decode tick's output across all of this connection's
+        streams into one write."""
+        try:
+            async for tok in req.tokens():
+                sink.add_token(sid, tok)
+            sink.send_done(sid, self._done_record(req))
+        except ServingError as e:
+            sink.send_error(sid, {"error": str(e), "code": e.code,
+                                  "trace_id": req.trace_id})
+        finally:
+            live.pop(sid, None)
 
     @staticmethod
     def _error(e: Exception, spec: dict, code: str | None = None) -> dict:
@@ -188,9 +352,10 @@ class ServingServer:
             return self._tracez(spec)
         if cmd == "metricsz":
             registry = self.engine.metrics.registry
-            # Memory gauges are refreshed per scrape (a passive registry
-            # cannot probe devices itself).
+            # Memory and tenant gauges are refreshed per scrape (a
+            # passive registry cannot probe devices or the queue itself).
             self.engine.refresh_memory_metrics()
+            self.engine.tenant_snapshot()
             if spec.get("format") == "prometheus":
                 from distkeras_tpu.telemetry import prometheus_text
 
@@ -206,6 +371,10 @@ class ServingServer:
                 "stopping": engine._stopping,
                 "weight_version": engine.weight_version,
                 "device_memory": engine.refresh_memory_metrics(),
+                # Per-tenant occupancy / queue depth / quota + shed
+                # counters — the "is one tenant starving the fleet"
+                # page (refreshes the labeled tenant gauges too).
+                "tenants": engine.tenant_snapshot(),
             }
             mesh = engine.mesh_info()
             if mesh is not None:

@@ -1,0 +1,106 @@
+// fastwire: native frame core for the serving front door's bin1 wire
+// protocol (distkeras_tpu/serving/wire.py).
+//
+// The JSONL front door spends its request budget on readline() + per-line
+// json.loads/json.dumps — the per-record serialization overhead DeepSpark
+// (arXiv:1602.08191 §IV) names as its exchange-path scaling ceiling, and
+// the control-plane bottleneck TensorFlow's design (arXiv:1605.08695 §4)
+// is built to avoid. bin1 replaces lines with length-prefixed frames:
+//
+//   [u32 len (LE)] [u8 type] [u32 stream_id (LE)] [payload: len-5 bytes]
+//
+// The receive hot loop lives here behind ctypes (same pattern as
+// fastdata.cpp: raw buffers shared with numpy, pure-Python struct
+// fallback when the .so is absent or stale):
+//
+//   fw_scan_frames  — split a receive buffer into complete frames in one
+//                     call (the batched-admission read path: every frame
+//                     that arrived in one event-loop tick, one FFI hop;
+//                     engaged for LARGE buffers — small ones scan faster
+//                     in pure Python than one ctypes round trip costs);
+//   fw_pack_token_frames — one contiguous buffer of TOK frames from many
+//                     streams' token lists. The production send path
+//                     (wire.FrameSink) stages raw payload bytes and
+//                     frames them directly, so this serves wide int-list
+//                     batch writers and the ctypes-vs-fallback parity
+//                     suite.
+//
+// Build: make -C native   (produces libfastwire.so).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint32_t read_u32le(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+inline void write_u32le(uint8_t* p, uint32_t v) {
+  p[0] = (uint8_t)(v & 0xff);
+  p[1] = (uint8_t)((v >> 8) & 0xff);
+  p[2] = (uint8_t)((v >> 16) & 0xff);
+  p[3] = (uint8_t)((v >> 24) & 0xff);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan `buf` for complete frames. For each complete frame i (< cap) the
+// PAYLOAD location and the header fields are written to offsets[i] /
+// lengths[i] / types[i] / streams[i]. Returns the number of complete
+// frames found (0 when the buffer holds only a partial frame), and sets
+// *consumed to the byte offset just past the last complete frame — the
+// caller discards exactly that prefix and keeps the tail for the next
+// read. Returns -1 on a corrupt header: a declared length below the
+// 5-byte (type + stream) minimum, or above max_frame (an oversized — or
+// desynchronized — peer must fail typed, never grow an unbounded buffer
+// waiting for a frame that can't be trusted).
+int64_t fw_scan_frames(const uint8_t* buf, int64_t len, int64_t max_frame,
+                       int64_t* offsets, int64_t* lengths, uint8_t* types,
+                       uint32_t* streams, int64_t cap, int64_t* consumed) {
+  int64_t pos = 0;
+  int64_t n = 0;
+  *consumed = 0;
+  while (n < cap && pos + 4 <= len) {
+    uint32_t flen = read_u32le(buf + pos);
+    if (flen < 5 || (int64_t)flen > max_frame) return -1;
+    if (pos + 4 + (int64_t)flen > len) break;  // partial frame: stop clean
+    types[n] = buf[pos + 4];
+    streams[n] = read_u32le(buf + pos + 5);
+    offsets[n] = pos + 9;
+    lengths[n] = (int64_t)flen - 5;
+    pos += 4 + (int64_t)flen;
+    *consumed = pos;
+    ++n;
+  }
+  return n;
+}
+
+// Pack n_streams TOK frames into `out` back to back: frame i carries
+// tokens[offs[i] : offs[i+1]] (offs is a prefix-sum array of n_streams+1
+// entries) for stream streams[i]. Returns bytes written. The caller
+// sizes `out` as sum over i of (9 + 4 * count_i) — exact, no slack.
+// `tok_type` is the TOK frame-type byte (passed in so the wire module
+// owns the type registry in ONE place).
+int64_t fw_pack_token_frames(const uint32_t* streams, const int64_t* offs,
+                             const int32_t* tokens, int64_t n_streams,
+                             uint8_t tok_type, uint8_t* out) {
+  int64_t pos = 0;
+  for (int64_t i = 0; i < n_streams; ++i) {
+    int64_t count = offs[i + 1] - offs[i];
+    uint32_t flen = (uint32_t)(5 + 4 * count);
+    write_u32le(out + pos, flen);
+    out[pos + 4] = tok_type;
+    write_u32le(out + pos + 5, streams[i]);
+    // Token ids are written little-endian; on LE hosts (every platform
+    // this repo targets) that is a straight memcpy of the int32 array.
+    std::memcpy(out + pos + 9, tokens + offs[i], (size_t)(4 * count));
+    pos += 9 + 4 * count;
+  }
+  return pos;
+}
+
+}  // extern "C"

@@ -16,7 +16,12 @@ and stay warn-only like every training row. Continuous-deployment rows
 from ``benchmarks/deploy_bench.py`` live under ``deploy/...`` keys:
 ``deploy_latency_p50_s``/``p95_s`` regress by RISING (a slower deploy
 is a wider trained->serving staleness window), ``canary_pass_rate``
-and goodput by dropping. This script compares the
+and goodput by dropping. Front-door rows ride the same strict
+``serving/`` gate: ``serving/router_echo/...`` (router_bench) carries
+``requests_per_sec`` and the bin1/jsonl ``speedup_x`` — both regress
+by DROPPING (higher-is-better default) — plus latency percentiles;
+``serving/qos_.../ttft_*`` rows (the adversarial multi-tenant bench)
+are ttft-named and regress by rising like every latency row. This script compares the
 latest entry of each config (by default only the most recently updated
 one) against its prior same-config entry and WARNS when it drifted by
 more than ``--threshold`` (default 10%) **in the bad direction**:
