@@ -58,13 +58,15 @@ if _ROOT not in sys.path:
 
 
 # -- child processes --------------------------------------------------------
-def _echo_proc(conn, echo_tokens: int) -> None:
+def _echo_proc(conn, echo_tokens: int, kv_block_tokens: int = 4) -> None:
     """One echo replica in its own process: bind, report the port, serve
-    until killed."""
+    until killed. ``kv_block_tokens`` sizes the emulated KV blocks so a
+    roles run's short bench prompts still export/adopt chains."""
     from distkeras_tpu.serving.cluster.replicas import EchoServer
 
     async def run():
-        server = EchoServer(echo_tokens=echo_tokens)
+        server = EchoServer(echo_tokens=echo_tokens,
+                            kv_block_tokens=kv_block_tokens)
         await server.start()
         conn.send(("127.0.0.1", server.port))
         await asyncio.Event().wait()  # until SIGTERM
@@ -174,14 +176,20 @@ async def _measure(args, wire_name: str) -> dict:
     from distkeras_tpu.serving.cluster.router import Router
     from distkeras_tpu.serving.cluster.supervisor import ReplicaSupervisor
     from distkeras_tpu.serving.metrics import percentile
+    from distkeras_tpu.telemetry import MetricsRegistry
 
+    roles = getattr(args, "_roles", None)  # parsed once in main()
     supervisor = ReplicaSupervisor(
         lambda i: _ProcEchoReplica(args.echo_tokens),
-        args.replicas, health_interval_s=5.0)
+        args.replicas, health_interval_s=5.0, roles=roles)
     await supervisor.start()
-    router = Router(supervisor, port=0,
+    registry = MetricsRegistry() if roles else None
+    router = Router(supervisor, port=0, registry=registry,
                     trace_capacity=512 if args.trace else 0,
-                    wire_mode="jsonl" if wire_name == "jsonl" else "auto")
+                    wire_mode="jsonl" if wire_name == "jsonl" else "auto",
+                    # Bench prompts are short; hand off anything with
+                    # at least one emulated block.
+                    min_handoff_tokens=4)
     await router.start()
     procs, conns = [], []
     n_procs = args.client_procs
@@ -222,6 +230,14 @@ async def _measure(args, wire_name: str) -> dict:
         sec["backend_wire"] = {
             rid: info.wire_proto
             for rid, info in supervisor.replicas.items()}
+        if roles:
+            snap = registry.snapshot()
+            sec["roles"] = {"prefill": roles.count("prefill"),
+                            "decode": roles.count("decode")}
+            sec["kv_handoffs"] = snap.get(
+                "router_kv_handoffs_total", {}).get("value", 0)
+            sec["kv_handoff_fallbacks"] = snap.get(
+                "router_kv_handoff_fallbacks_total", {}).get("value", 0)
         return sec
     finally:
         for p in procs:
@@ -284,6 +300,13 @@ def main() -> None:
                          "protocol)")
     ap.add_argument("--prompt-len", type=int, default=8,
                     help="tokens per request prompt")
+    ap.add_argument("--roles", default=None, metavar="prefill=N,decode=M",
+                    help="disaggregated echo fleet: the router prefills "
+                         "each prompt on an (emulated) prefill replica "
+                         "and decode replicas run the REAL KVBLK pull "
+                         "before echoing — measures the handoff path's "
+                         "router cost jax-free (overrides --replicas; "
+                         "disables the zero-task fast path by design)")
     ap.add_argument("--echo-tokens", type=int, default=1,
                     help="token events per echoed request")
     ap.add_argument("--wire", default="both",
@@ -301,6 +324,12 @@ def main() -> None:
                     help="append serving/router_* rows to "
                          "bench_history.json for the strict CI gate")
     args = ap.parse_args()
+    args._roles = None
+    if args.roles:
+        from benchmarks.serving_bench import _parse_roles_spec
+
+        args._roles = _parse_roles_spec(args.roles)
+        args.replicas = len(args._roles)
 
     report: dict = {"config": {
         "requests": args.requests, "replicas": args.replicas,
@@ -308,6 +337,7 @@ def main() -> None:
         "conns_per_proc": args.conns_per_proc,
         "pipeline": args.pipeline, "prompt_len": args.prompt_len,
         "echo_tokens": args.echo_tokens, "trace": bool(args.trace),
+        "roles": args.roles,
     }}
     for wire_name in (("jsonl", "bin1") if args.wire == "both"
                       else (args.wire,)):

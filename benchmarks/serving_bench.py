@@ -317,12 +317,16 @@ def _check_parity(model, variables, results, new_tokens):
     return mismatches
 
 
-async def _cluster_bench(args, report):
+async def _cluster_bench(args, report, roles=None):
     """Drive the load phases through an in-process router + N replicas.
 
-    End-to-end numbers (client-observed TTFT/latency, through the router
-    hop), router/supervisor counters, and — with ``--chaos-kill-at`` —
-    the cluster contract asserted under a mid-phase replica kill."""
+    End-to-end numbers (client-observed TTFT/ITL/latency, through the
+    router hop), router/supervisor counters, and — with
+    ``--chaos-kill-at`` — the cluster contract asserted under a
+    mid-phase replica kill. ``roles`` (a per-index "prefill"/"decode"
+    list) runs the fleet DISAGGREGATED: the router prefills each prompt
+    on a prefill replica and decode replicas adopt the KV blocks — the
+    report then carries the fleet's migration counters."""
     import time as _time
 
     from distkeras_tpu.serving import (
@@ -353,9 +357,15 @@ async def _cluster_bench(args, report):
 
         return LocalReplica(build)
 
+    router_kwargs = {"affinity_tokens": args.prefix_block}
+    if roles:
+        # Hand off any prompt holding at least one KV block — the bench
+        # drives fixed prompt lengths, so the threshold must track the
+        # block size, not the affinity prefix.
+        router_kwargs["min_handoff_tokens"] = args.kv_block
     cluster = ServingCluster(
-        replica, args.replicas, registry=registry,
-        router_kwargs={"affinity_tokens": args.prefix_block},
+        replica, args.replicas, registry=registry, roles=roles,
+        router_kwargs=router_kwargs,
         supervisor_kwargs=dict(health_interval_s=0.1, base_delay_s=0.2))
     all_results = []
     async with cluster:
@@ -368,18 +378,26 @@ async def _cluster_bench(args, report):
             async def one(c, p):
                 nonlocal rejects
                 streamed = []
-                # Client-side clocks: TTFT/latency as the CLIENT sees
-                # them — router hop, pick-wait, and any mid-request
+                gaps = []
+                # Client-side clocks: TTFT/ITL/latency as the CLIENT
+                # sees them — router hop, pick-wait, and any mid-request
                 # retry included (the replica-reported done-record
                 # timings would hide exactly the penalties the cluster
-                # and chaos modes exist to measure).
+                # and chaos modes exist to measure). ITL gaps are what
+                # the disaggregated comparison is ABOUT: prefill
+                # stealing decode ticks shows up as p99 inter-token
+                # spikes on every in-flight stream.
                 t_sub = _time.monotonic()
-                t_first = None
+                t_first = t_last = None
 
                 def on_token(tok):
-                    nonlocal t_first
+                    nonlocal t_first, t_last
+                    now = _time.monotonic()
                     if t_first is None:
-                        t_first = _time.monotonic()
+                        t_first = now
+                    else:
+                        gaps.append(now - t_last)
+                    t_last = now
                     streamed.append(tok)
 
                 try:
@@ -390,6 +408,8 @@ async def _cluster_bench(args, report):
                     dones.append({
                         "ttft_s": (t_first or t_done) - t_sub,
                         "latency_s": t_done - t_sub,
+                        "itl": gaps,
+                        "kv_migration": done.get("kv_migration"),
                     })
                 except QueueFullError:
                     rejects += 1
@@ -445,6 +465,19 @@ async def _cluster_bench(args, report):
                 if xs:
                     sec[f"{key}_p50_s"] = round(percentile(xs, 50), 6)
                     sec[f"{key}_p99_s"] = round(percentile(xs, 99), 6)
+            all_gaps = [g for d in dones for g in d.get("itl", ())]
+            if all_gaps:
+                sec["itl_p50_s"] = round(percentile(all_gaps, 50), 6)
+                sec["itl_p99_s"] = round(percentile(all_gaps, 99), 6)
+            migs = [d["kv_migration"] for d in dones
+                    if d.get("kv_migration")]
+            if migs:
+                sec["kv_migrations"] = sum(
+                    1 for m in migs if "fallback" not in m)
+                sec["kv_migration_fallbacks"] = sum(
+                    1 for m in migs if "fallback" in m)
+                sec["kv_migration_bytes"] = sum(
+                    int(m.get("bytes") or 0) for m in migs)
             report[mode] = sec
             all_results.extend(results)
             # The chaos contract, part 1: idempotent work never fails —
@@ -481,6 +514,30 @@ async def _cluster_bench(args, report):
         }
         report["cluster"]["decode_compile_count"] = compiles
         assert all(c in (1, -1, 0) for c in compiles.values()), compiles
+        if roles:
+            # Fleet migration rollup, read straight off the in-process
+            # engines (the same counters metricsz/healthz export).
+            snap = registry.snapshot()
+            fleet = {
+                "roles": {"prefill": roles.count("prefill"),
+                          "decode": roles.count("decode")},
+                "migrations": 0, "fallbacks": 0, "bytes_moved": 0,
+                "exports": 0,
+                "router_handoffs": snap.get(
+                    "router_kv_handoffs_total", {}).get("value", 0),
+                "router_handoff_fallbacks": snap.get(
+                    "router_kv_handoff_fallbacks_total", {}).get(
+                        "value", 0),
+            }
+            for info in cluster.replicas.values():
+                eng = getattr(info.handle, "engine", None)
+                if eng is None:
+                    continue
+                fleet["migrations"] += eng.metrics.kv_migrations
+                fleet["fallbacks"] += eng.metrics.kv_migration_fallbacks
+                fleet["bytes_moved"] += eng.metrics.kv_migration_bytes
+                fleet["exports"] += eng.metrics.kv_exports
+            report["disagg"] = fleet
     for _, stream in streams:
         stream.close()
     if args.metrics_out:
@@ -820,6 +877,62 @@ def _record_history(args, report):
     bench.write_history(path, hist)
 
 
+def _parse_roles_spec(spec: str) -> list[str]:
+    """``prefill=N,decode=M`` via the ONE shared parser (bad input is a
+    typed CLI exit)."""
+    from distkeras_tpu.serving.cluster import parse_roles
+
+    try:
+        return parse_roles(spec)
+    except ValueError as e:
+        raise SystemExit(f"--roles: {e}") from None
+
+
+def _record_disagg_history(args, report, roles):
+    """``serving/disagg_*`` rows for the strict CI gate: saturated-fleet
+    client-observed p99 TTFT/ITL (lower-is-better by name), the
+    migration/fallback/bytes counters, and — when a monolithic baseline
+    ran — the p99-ITL improvement factor (higher-is-better)."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    base = (f"serving/disagg_{args.model}"
+            f"/p{roles.count('prefill')}d{roles.count('decode')}"
+            f"/slots{args.slots}/block{args.kv_block}")
+    disagg = report.get("disagg") or {}
+    for mode in ("closed", "open"):
+        sec = report.get(mode)
+        if not isinstance(sec, dict):
+            continue
+        rows = {
+            "ttft_p99_s": sec.get("ttft_p99_s"),
+            "itl_p99_s": sec.get("itl_p99_s"),
+            "goodput_tokens_per_sec": sec.get("goodput_tokens_per_sec"),
+            "speedup_itl_x": sec.get("speedup_itl_x"),
+        }
+        for metric, v in rows.items():
+            if isinstance(v, (int, float)) and v > 0:
+                key = f"{base}/{mode}/{metric}"
+                hist[key] = bench.history_entry(hist.get(key), float(v),
+                                                when)
+    for metric in ("migrations", "fallbacks", "bytes_moved"):
+        v = disagg.get(metric)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            key = f"{base}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v),
+                                            when)
+    bench.write_history(path, hist)
+
+
 def _record_qos_history(args, report):
     """``serving/qos_*`` rows for the strict CI gate: the others' p99
     TTFT under flood and the flood/baseline degradation ratio — both
@@ -938,6 +1051,22 @@ def main():
                     help=">= 2: drive an in-process cluster (N engines "
                          "behind the supervised router) over TCP instead "
                          "of one engine directly")
+    ap.add_argument("--roles", default=None, metavar="prefill=N,decode=M",
+                    help="disaggregated fleet mode (implies cluster + "
+                         "--paged): N prefill replicas prefill and "
+                         "export KV blocks, M decode replicas adopt "
+                         "them and stream — the report carries "
+                         "client-observed p99 TTFT/ITL plus the "
+                         "fleet's migration/fallback/bytes counters")
+    ap.add_argument("--disagg-baseline", action="store_true",
+                    help="roles mode: first run the SAME workload on a "
+                         "monolithic fleet of equal size, and report "
+                         "the p99 ITL improvement disaggregation buys "
+                         "(speedup_itl_x)")
+    ap.add_argument("--min-itl-improvement", type=float, default=0.0,
+                    help="roles mode with --disagg-baseline: assert the "
+                         "closed-phase p99-ITL improvement is at least "
+                         "this factor; 0 = report only")
     ap.add_argument("--chaos-kill-at", type=float, default=None,
                     help="cluster mode: hard-kill replica r0 this many "
                          "seconds into each load phase and assert the "
@@ -1027,6 +1156,58 @@ def main():
                     args.trace_out)
         if args.record_history:
             _record_qos_history(args, report)
+        print(json.dumps(report, indent=1))
+        return
+
+    if args.roles:
+        # Disaggregated fleet mode: prefill/decode roles with KV block
+        # migration, optionally diffed against a monolithic fleet of
+        # the same size. Rows land under serving/disagg_* — their OWN
+        # series (client-observed fleet numbers diff against their own
+        # prior, never the engine-direct series).
+        roles = _parse_roles_spec(args.roles)
+        if not (args.paged or args.kv_pool_mb > 0):
+            args.paged = True  # migration needs the paged pool
+        args.replicas = len(roles)
+        report["config"]["roles"] = {
+            "prefill": roles.count("prefill"),
+            "decode": roles.count("decode")}
+        report["config"]["paged"] = True
+        baseline = None
+        try:
+            if args.disagg_baseline:
+                braw: dict = {}
+                asyncio.run(_cluster_bench(args, braw, roles=None))
+                baseline = {m: braw[m] for m in ("closed", "open")
+                            if isinstance(braw.get(m), dict)}
+                report["monolithic_baseline"] = baseline
+            model, variables, all_results = asyncio.run(
+                _cluster_bench(args, report, roles=roles))
+            if not args.skip_parity:
+                mism = _check_parity(model, variables, all_results,
+                                     args.new_tokens)
+                report["parity_mismatches"] = mism
+                assert mism == 0, \
+                    f"{mism} disaggregated streams diverged from " \
+                    f"generate()"
+            if baseline:
+                for mode in ("closed", "open"):
+                    b = (baseline.get(mode) or {}).get("itl_p99_s")
+                    d = (report.get(mode) or {}).get("itl_p99_s")
+                    if b and d:
+                        report[mode]["speedup_itl_x"] = round(b / d, 3)
+            if args.min_itl_improvement > 0:
+                got = (report.get("closed") or {}).get("speedup_itl_x")
+                assert got is not None and \
+                    got >= args.min_itl_improvement, (
+                        f"closed-phase p99-ITL improvement "
+                        f"{got} < required {args.min_itl_improvement}")
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        if args.record_history:
+            _record_disagg_history(args, report, roles)
         print(json.dumps(report, indent=1))
         return
 

@@ -247,6 +247,19 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "supervised router on --port (least-outstanding "
                          "routing with prefix-cache affinity, automatic "
                          "restarts, rolling weight reloads)")
+    ap.add_argument("--roles", default=None, metavar="prefill=N,decode=M",
+                    help="disaggregated cluster: N prefill replicas + M "
+                         "decode replicas (implies cluster mode, "
+                         "overrides --replicas; requires --paged/"
+                         "--kv-pool-mb). The router prefills each "
+                         "prompt family ONCE on its prefill replica "
+                         "and decode replicas adopt the KV blocks over "
+                         "the wire (KVBLK frames) — chunked prefill "
+                         "stops stealing decode ticks, hot prefixes "
+                         "are prefilled once per FLEET, and every "
+                         "transfer failure falls back to monolithic "
+                         "serving. See docs/serving.md 'Disaggregated "
+                         "serving'")
     ap.add_argument("--affinity-slack", type=int, default=4,
                     help="cluster mode: max outstanding-request imbalance "
                          "the prefix-affinity pin may create before plain "
@@ -310,7 +323,7 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     # BEFORE anything imports jax: the forced-device-count XLA flag is
     # read once at backend init, so it must hit the environment first.
     _apply_force_host_devices(args.force_host_devices)
-    if args.replicas > 1:
+    if args.replicas > 1 or args.roles:
         return cluster_main(args)
 
     import asyncio
@@ -557,12 +570,26 @@ def _serving_config_flags(args) -> list[str]:
     return extra
 
 
+def _parse_roles(spec: str | None) -> list[str] | None:
+    """``--roles prefill=N,decode=M`` via the ONE shared parser
+    (``serving.cluster.parse_roles``); bad input is a typed CLI exit,
+    never a deep traceback out of the supervisor."""
+    from distkeras_tpu.serving.cluster import parse_roles
+
+    try:
+        return parse_roles(spec)
+    except ValueError as e:
+        raise SystemExit(f"--roles: {e}") from None
+
+
 def cluster_main(args) -> int:
     """Multi-replica serving: N child processes (each a full ``serve``
     on an ephemeral port) behind a supervised router on ``--port``.
     Replica death -> capped-backoff restart; ``{"cmd": "reload",
     "weights": path}`` on the router rolls new weights with zero
-    downtime. See docs/operations.md for the runbook."""
+    downtime. ``--roles prefill=N,decode=M`` splits the fleet into
+    prefill and decode roles with KV block migration between them.
+    See docs/operations.md for the runbook."""
     import asyncio
     import signal
     import tempfile
@@ -571,6 +598,14 @@ def cluster_main(args) -> int:
     # the cluster command with one clear line, not N crash-looping
     # replica children. (The children re-validate on their own devices.)
     _resolve_mesh(args)
+    roles = _parse_roles(getattr(args, "roles", None))
+    if roles is not None:
+        if not (args.paged or args.kv_pool_mb):
+            raise SystemExit(
+                "--roles requires --paged or --kv-pool-mb: KV block "
+                "migration (the prefill->decode handoff) only exists "
+                "on the paged pool")
+        args.replicas = len(roles)
 
     from distkeras_tpu.serving.cluster import ProcessReplica, ServingCluster
     from distkeras_tpu.telemetry import MetricsRegistry
@@ -637,19 +672,27 @@ def cluster_main(args) -> int:
                                  env=replica_env(i),
                                  last_words_path=flight_dump(i)),
         args.replicas, host=args.host, port=args.port, registry=registry,
+        roles=roles,
         router_kwargs={
             "affinity_tokens": args.prefix_block,
             "affinity_slack": args.affinity_slack,
             "wire_mode": "jsonl" if args.wire == "jsonl" else "auto",
             "trace_capacity":
                 512 if args.request_trace is None else args.request_trace,
+            # Handoff threshold tracks the KV BLOCK size, not the
+            # affinity prefix: a prompt shorter than one block exports
+            # nothing, so handing it off would pay two prefills + two
+            # round trips for a guaranteed peer_miss.
+            **({"min_handoff_tokens": args.kv_block_tokens}
+               if roles is not None else {}),
         })
 
     async def go():
         await cluster.start()
         print(json.dumps({
             "cluster": args.model, "host": args.host, "port": cluster.port,
-            "replicas": {rid: {"host": info.host, "port": info.port}
+            "replicas": {rid: {"host": info.host, "port": info.port,
+                               "role": info.role}
                          for rid, info in cluster.replicas.items()},
             "slots": args.slots, "prefix_cache_mb": args.prefix_cache_mb,
             "flight_dir": flight_dir,

@@ -33,7 +33,15 @@ request path:
   :class:`ServingCluster` (= :class:`Router` front port +
   :class:`ReplicaSupervisor` restarts) with prefix-cache-affine routing,
   zero-streamed retry on replica death, and zero-downtime rolling weight
-  reloads.
+  reloads;
+- :mod:`distkeras_tpu.serving.kv_transfer` — KV block migration: a
+  prompt's paged blocks serialized (bitwise, provenance-stamped) and
+  adopted into a peer replica's pool, the primitive behind
+  **disaggregated prefill/decode fleets** (``run.py cluster --roles
+  prefill=N,decode=M``), cross-replica prefix sharing, and
+  drain-by-migration rolling reloads (typed
+  :class:`KVTransferError` rejects; every failure falls back to
+  monolithic serving).
 """
 
 from distkeras_tpu.serving.scheduler import (
@@ -48,6 +56,7 @@ from distkeras_tpu.serving.scheduler import (
     TenantOverQuota,
     TenantQuota,
 )
+from distkeras_tpu.serving.kv_transfer import KVTransferError
 from distkeras_tpu.serving.metrics import ServingMetrics
 from distkeras_tpu.serving.prefix_cache import KVBlockPool, PrefixCache
 from distkeras_tpu.serving.engine import ServingEngine
@@ -83,4 +92,5 @@ __all__ = [
     "EngineStopped",
     "TenantOverQuota",
     "TenantQuota",
+    "KVTransferError",
 ]

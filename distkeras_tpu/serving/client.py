@@ -552,14 +552,22 @@ class ServingClient:
         return (await self._control({"cmd": "deployz"},
                                     retry=True))["deployz"]
 
-    async def reload(self, weights: str, timeout: float = 60.0) -> dict:
+    async def reload(self, weights: str, timeout: float = 60.0,
+                     migrate: bool = False) -> dict:
         """Hot-swap weights: a rolling reload when pointed at a cluster
         router, a single-engine swap when pointed at one server. NOT
         transport-retried (a retry could double-trigger a long rolling
-        drain); callers handle ``ConnectionError`` themselves."""
-        return (await self._control(
-            {"cmd": "reload", "weights": weights,
-             "timeout": timeout}))["reload"]
+        drain); callers handle ``ConnectionError`` themselves.
+
+        ``migrate=True`` (router only): drain each replica by MIGRATING
+        its live streams to peers (KV blocks pulled, streamed tokens
+        folded into a resume) instead of waiting them out — long
+        generations no longer hold the roll hostage. Migrated streams
+        continue on whatever weights their new replica serves."""
+        spec = {"cmd": "reload", "weights": weights, "timeout": timeout}
+        if migrate:
+            spec["migrate"] = True
+        return (await self._control(spec))["reload"]
 
     def generate_sync(self, prompt: Sequence[int], max_new_tokens: int,
                       **kw) -> dict:

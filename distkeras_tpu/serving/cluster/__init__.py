@@ -32,13 +32,17 @@ from distkeras_tpu.serving.cluster.replicas import (
     ReplicaInfo,
     probe_healthz,
 )
-from distkeras_tpu.serving.cluster.supervisor import ReplicaSupervisor
+from distkeras_tpu.serving.cluster.supervisor import (
+    ReplicaSupervisor,
+    parse_roles,
+)
 from distkeras_tpu.serving.cluster.router import Router, ServingCluster
 
 __all__ = [
     "ServingCluster",
     "Router",
     "ReplicaSupervisor",
+    "parse_roles",
     "ReplicaHandle",
     "ReplicaInfo",
     "LocalReplica",

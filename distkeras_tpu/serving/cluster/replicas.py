@@ -85,11 +85,19 @@ class ReplicaInfo:
     # The front-door protocol the router negotiated with THIS
     # generation ("bin1"/"jsonl"); None = not yet probed.
     wire_proto: str | None = None
+    # Fleet role (disaggregated serving): "monolithic" replicas do
+    # everything (today's default); "prefill" replicas only take
+    # kv_prefill work and export blocks; "decode" replicas take
+    # generation dispatches and adopt blocks from prefill peers. The
+    # supervisor assigns roles at construction; the router routes by
+    # them.
+    role: str = "monolithic"
 
     def public(self) -> dict:
         """The JSON-safe view the router's aggregate healthz exposes."""
         return {
             "status": self.status,
+            "role": self.role,
             "host": self.host,
             "port": self.port,
             "outstanding": self.outstanding,
@@ -240,16 +248,35 @@ class EchoServer:
     pre-bin1 server — the hello verb itself is unknown and answered
     with the standard ``bad_request``, which is exactly what a client's
     auto-downgrade must survive.
+
+    The disaggregation verbs are emulated too, so router-level
+    handoff/fallback logic (and ``router_bench``'s roles mode) runs
+    jax-free: ``kv_prefill`` succeeds instantly (or fails typed with
+    ``kv_fail=True`` — the fallback-path switch), ``kv_export``
+    answers a real KVBLK frame carrying a leafless KVX1 payload (the
+    token chain without KV bytes — enough for a peer Echo's pull to
+    exercise the genuine :func:`~distkeras_tpu.serving.kv_transfer.
+    fetch_blocks` client), and a generation spec carrying ``kv_from``
+    performs the REAL peer pull before echoing, reporting the
+    ``kv_migration`` outcome on its done line exactly like a real
+    replica.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
-                 echo_tokens: int = 1, wire_mode: str = "auto"):
+                 echo_tokens: int = 1, wire_mode: str = "auto",
+                 kv_fail: bool = False, kv_block_tokens: int = 16):
         if wire_mode not in ("auto", "jsonl", "legacy"):
             raise ValueError(f"bad wire_mode {wire_mode!r}")
         self.host = host
         self.echo_tokens = int(echo_tokens)
         self.wire_mode = wire_mode
+        self.kv_fail = bool(kv_fail)
+        self.kv_block_tokens = int(kv_block_tokens)
         self.requests = 0
+        self.kv_prefills = 0
+        self.kv_exports = 0
+        self.kv_imports = 0
+        self.kv_fallbacks = 0
         self._requested_port = port
         self._server: asyncio.AbstractServer | None = None
 
@@ -286,6 +313,18 @@ class EchoServer:
             if cmd == "reload":
                 return [{"reload": {"ok": True, "echo": True,
                                     "weights": spec.get("weights")}}]
+            if cmd == "kv_prefill":
+                if self.kv_fail:
+                    return [{"error": "kv_prefill disabled (kv_fail)",
+                             "code": "kv_transfer",
+                             "trace_id": spec.get("trace_id")}]
+                self.kv_prefills += 1
+                prompt = spec.get("prompt") or []
+                return [{"kv_prefill": {
+                    "ok": True, "echo": True,
+                    "prompt_tokens": len(prompt),
+                    "blocks": len(prompt) // self.kv_block_tokens,
+                    "trace_id": spec.get("trace_id")}}]
             return [{"error": f"unknown cmd {cmd!r}",
                      "code": "bad_request"}]
         prompt = spec.get("prompt") or []
@@ -306,6 +345,55 @@ class EchoServer:
                 "tenant": spec.get("tenant") or "default",
                 "ttft_ms": 0.0, "latency_ms": 0.0}
         return [{"token": t} for t in toks] + [done]
+
+    async def _pull_kv(self, spec: dict) -> dict:
+        """A generation spec naming a KV source: run the REAL
+        :func:`~distkeras_tpu.serving.kv_transfer.fetch_blocks` pull
+        against the peer (an Echo peer answers a leafless KVX1
+        payload), with every failure folding to a ``fallback`` info —
+        the same contract as :meth:`ServingServer._import_from_peer`,
+        minus the device adopt."""
+        from distkeras_tpu.serving import kv_transfer
+
+        src = spec.pop("kv_from", None) or {}
+        info = {"from": f"{src.get('host')}:{src.get('port')}",
+                "echo": True}
+        tokens = list(spec.get("prompt") or ())
+        tokens += list(spec.get("resume_tokens") or ())
+        try:
+            payload = await asyncio.wait_for(
+                kv_transfer.fetch_blocks(
+                    str(src.get("host")), int(src.get("port")), tokens,
+                    timeout=5.0),
+                5.0)
+            if payload is None:
+                info["fallback"] = "peer_miss"
+            else:
+                header = kv_transfer.peek_header(payload)
+                self.kv_imports += 1
+                info["bytes"] = len(payload)
+                info["matched_tokens"] = len(header.get("tokens", []))
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                TypeError, ValueError) as e:
+            info["fallback"] = f"{type(e).__name__}: {e}"
+        if "fallback" in info:
+            self.kv_fallbacks += 1
+        return info
+
+    def _kv_export_payload(self, prompt) -> bytes | None:
+        """A leafless KVX1 payload over the prompt's complete blocks —
+        wire-real (magic, header, token chain, provenance stamp), KV
+        bytes elided (an Echo has none)."""
+        from distkeras_tpu.serving import kv_transfer
+
+        n = len(prompt) // self.kv_block_tokens
+        if n == 0:
+            return None
+        self.kv_exports += 1
+        return kv_transfer.serialize_blocks(
+            prompt[:n * self.kv_block_tokens], [],
+            block_tokens=self.kv_block_tokens,
+            provenance={"version": 0, "digest": None})
 
     async def _handle(self, reader, writer) -> None:
         from distkeras_tpu.serving import wire
@@ -333,8 +421,15 @@ class EchoServer:
                         await self._handle_bin1(reader, writer)
                         return
                     continue
-                for rec in self._reply(spec if isinstance(spec, dict)
-                                       else {}):
+                kv_info = None
+                if (isinstance(spec, dict) and "kv_from" in spec
+                        and "cmd" not in spec):
+                    kv_info = await self._pull_kv(spec)
+                recs = self._reply(spec if isinstance(spec, dict)
+                                   else {})
+                if kv_info is not None and recs and recs[-1].get("done"):
+                    recs[-1]["kv_migration"] = kv_info
+                for rec in recs:
                     writer.write((json.dumps(rec) + "\n").encode())
                 await writer.drain()
         except (ConnectionResetError, BrokenPipeError, OSError):
@@ -380,19 +475,52 @@ class EchoServer:
                                       "list", "code": "bad_request",
                              "trace_id": spec.get("trace_id")})
                         continue
+                    kv_info = None
+                    if "kv_from" in spec:
+                        kv_info = await self._pull_kv(spec)
                     self.requests += 1
                     toks = [int(prompt[0])] * self.echo_tokens
                     if toks:
                         out += wire.encode_token_frame(sid, toks)
-                    out += wire.encode_json_frame(wire.T_DONE, sid, {
+                    done = {
                         "done": True, "tokens": toks,
                         "trace_id": spec.get("trace_id"),
                         "tenant": spec.get("tenant") or "default",
-                        "ttft_ms": 0.0, "latency_ms": 0.0})
+                        "ttft_ms": 0.0, "latency_ms": 0.0}
+                    if kv_info is not None:
+                        done["kv_migration"] = kv_info
+                    out += wire.encode_json_frame(wire.T_DONE, sid, done)
                 elif ftype == wire.T_CTRL:
-                    out += wire.encode_json_frame(
-                        wire.T_CTRLR, sid,
-                        self._reply(wire.decode_json(payload))[0])
+                    ctrl = wire.decode_json(payload)
+                    if ctrl.get("cmd") == "kv_export":
+                        if self.kv_fail:
+                            out += wire.encode_json_frame(
+                                wire.T_CTRLR, sid,
+                                {"error": "kv_export disabled (kv_fail)",
+                                 "code": "kv_transfer"})
+                        else:
+                            blob = self._kv_export_payload(
+                                ctrl.get("prompt") or [])
+                            if blob is None:
+                                out += wire.encode_json_frame(
+                                    wire.T_CTRLR, sid,
+                                    {"kv_export": {"matched_tokens": 0,
+                                                   "blocks": 0}})
+                            else:
+                                out += wire.encode_frame(
+                                    wire.T_KVBLK, sid, blob)
+                    else:
+                        out += wire.encode_json_frame(
+                            wire.T_CTRLR, sid, self._reply(ctrl)[0])
+                elif ftype == wire.T_KVBLK:
+                    # A pushed chain: acknowledge the adopt (kv_import).
+                    self.kv_imports += 1
+                    out += wire.encode_json_frame(wire.T_CTRLR, sid, {
+                        "kv_import": {"adopted_blocks": 0,
+                                      "resident_blocks": 0,
+                                      "matched_tokens": 0,
+                                      "bytes": len(payload),
+                                      "echo": True}})
                 elif ftype == wire.T_CANCEL:
                     pass
                 else:
@@ -411,9 +539,11 @@ class EchoReplica(ReplicaHandle):
     kill semantics), for front-door benchmarks and protocol tests."""
 
     def __init__(self, host: str = "127.0.0.1", *, echo_tokens: int = 1,
-                 wire_mode: str = "auto"):
+                 wire_mode: str = "auto", kv_fail: bool = False,
+                 kv_block_tokens: int = 16):
         self.server = EchoServer(host, 0, echo_tokens=echo_tokens,
-                                 wire_mode=wire_mode)
+                                 wire_mode=wire_mode, kv_fail=kv_fail,
+                                 kv_block_tokens=kv_block_tokens)
         self._killed = False
 
     async def start(self) -> tuple[str, int]:

@@ -85,6 +85,22 @@ class _ClientGone(Exception):
     supervisor's death detection or burn a retry."""
 
 
+class _RelayCtl:
+    """Migration handle for one in-flight classic relay: the rolling
+    reload's drain-by-migration path flips ``migrating`` and calls
+    ``fire`` (queue a sentinel on a mux relay, close the backend
+    connection on a jsonl relay); the relay then returns the
+    ``"migrate"`` outcome with the tokens it streamed this hop, and the
+    dispatch loop re-dispatches the request elsewhere with those tokens
+    folded in as a resume."""
+
+    __slots__ = ("fire", "migrating")
+
+    def __init__(self, fire):
+        self.fire = fire
+        self.migrating = False
+
+
 class _PooledConn:
     """One pooled backend connection plus the negotiation state it was
     created under. ``generation`` is the replica incarnation the
@@ -402,6 +418,8 @@ class Router:
         trace_capacity: int = 512,
         wire_mode: str = "auto",
         flush_interval_s: float = 0.0,
+        kv_prefill_timeout_s: float = 60.0,
+        min_handoff_tokens: int | None = None,
     ):
         if wire_mode not in ("auto", "jsonl"):
             raise ValueError(
@@ -443,10 +461,26 @@ class Router:
         # create_task result can be garbage-collected mid-flight).
         self._failover_tasks: set[asyncio.Task] = set()
         self._reload_lock = asyncio.Lock()
+        # Disaggregated serving: bound on one prefill-replica handoff
+        # (kv_prefill is a full prompt prefill — slower than a health
+        # verb, still bounded so a wedged prefill replica costs one
+        # timeout + fallback, never a hung dispatch), and the minimum
+        # prompt length worth handing off (shorter prompts can't fill
+        # one KV block; default: affinity_tokens).
+        self.kv_prefill_timeout_s = float(kv_prefill_timeout_s)
+        self.min_handoff_tokens = (self.affinity_tokens
+                                   if min_handoff_tokens is None
+                                   else int(min_handoff_tokens))
+        # In-flight classic relays per replica — what the rolling
+        # reload's drain-by-migration fires. rid -> set[_RelayCtl].
+        self._inflight: dict[str, set] = {}
         self.registry = registry
         self._c_requests = self._c_retries = self._c_affinity = None
         self._c_affinity_spill = self._c_lost = self._c_unavailable = None
         self._c_reloads = None
+        self._c_handoffs = self._c_handoff_fallbacks = None
+        self._c_migrations = None
+        self._h_handoff = None
         if registry is not None:
             self._c_requests = registry.counter(
                 "router_requests_total", help="generation requests routed")
@@ -470,6 +504,24 @@ class Router:
             self._c_reloads = registry.counter(
                 "router_rolling_reloads_total",
                 help="rolling weight reloads completed")
+            self._c_handoffs = registry.counter(
+                "router_kv_handoffs_total",
+                help="dispatches routed prefill-replica-first "
+                     "(disaggregated handoff arranged)")
+            self._c_handoff_fallbacks = registry.counter(
+                "router_kv_handoff_fallbacks_total",
+                help="dispatches that fell back to monolithic routing "
+                     "(no prefill replica, prefill failed/timed out)")
+            self._c_migrations = registry.counter(
+                "router_stream_migrations_total",
+                help="live streams migrated off a draining replica "
+                     "(rolling reload drain-by-migration)")
+            self._h_handoff = registry.histogram(
+                "router_kv_prefill_seconds",
+                help="prefill-replica handoff latency (kv_prefill "
+                     "round trip)",
+                buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5,
+                         1.0, 2.5, 5.0, 10.0))
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -509,13 +561,32 @@ class Router:
             return 0
         return zlib.crc32(head.encode())
 
+    def _roles_enabled(self) -> bool:
+        """True when the fleet is disaggregated (any prefill replica
+        exists, alive or not — the role is a property of the slot)."""
+        return any(r.role == "prefill"
+                   for r in self.supervisor.replicas.values())
+
     def _pick(self, prompt, exclude: set[str]) -> ReplicaInfo | None:
+        # Prefill replicas never take generation dispatches — their job
+        # is kv_prefill + export; decode replicas (and monolithic ones)
+        # decode.
         ready = [r for r in self.supervisor.replicas.values()
-                 if r.status == READY and r.rid not in exclude]
+                 if r.status == READY and r.rid not in exclude
+                 and r.role != "prefill"]
         if not ready:
             return None
         if len(ready) == 1:
             return ready[0]
+        if self._roles_enabled():
+            # Cross-replica sharing supersedes affinity: a prompt
+            # family's blocks live on its PREFILL replica (prefilled
+            # once per fleet) and any decode replica adopts them, so a
+            # decode-side pin would only manufacture hotspots. The
+            # affinity_prefix is now purely a prefill-placement hint —
+            # decode picks go least-outstanding. (docs/serving.md
+            # "Disaggregated serving".)
+            return min(ready, key=lambda r: r.outstanding)
         fam = self._family(prompt)
         # Rendezvous (highest-random-weight) hash: each family ranks every
         # replica; the top-ranked READY one wins. Replica death/drain only
@@ -530,6 +601,26 @@ class Router:
             return least
         if self._c_affinity is not None:
             self._c_affinity.inc()
+        return preferred
+
+    def _pick_prefill(self, prompt) -> ReplicaInfo | None:
+        """The prefill replica for a prompt family: rendezvous-pinned so
+        a hot prefix is prefilled ONCE per fleet (this is where the
+        ``affinity_prefix`` placement hint now earns its keep), spilling
+        to least-outstanding past ``affinity_slack`` like decode picks
+        used to."""
+        ready = [r for r in self.supervisor.replicas.values()
+                 if r.status == READY and r.role == "prefill"]
+        if not ready:
+            return None
+        if len(ready) == 1:
+            return ready[0]
+        fam = self._family(prompt)
+        preferred = max(
+            ready, key=lambda r: zlib.crc32(f"{fam}:{r.rid}".encode()))
+        least = min(ready, key=lambda r: r.outstanding)
+        if preferred.outstanding - least.outstanding > self.affinity_slack:
+            return least
         return preferred
 
     async def _pick_wait(self, prompt, exclude: set[str]):
@@ -756,8 +847,13 @@ class Router:
                         # (first contact with a replica, tracing on,
                         # nothing READY).
                         if ready is None:
+                            # Roles fleets always take the dispatch
+                            # task: the handoff (kv_prefill before
+                            # dispatch) and drain-by-migration both
+                            # need the classic path's machinery.
                             ready = ([] if self.trace_store is not None
-                                     or self.wire_mode == "jsonl" else
+                                     or self.wire_mode == "jsonl"
+                                     or self._roles_enabled() else
                                      [r for r in
                                       self.supervisor.replicas.values()
                                       if r.status == READY])
@@ -939,6 +1035,17 @@ class Router:
         hops: list[str] = []
         exclude = set(exclude or ())
         try:
+            # Disaggregated handoff: prefill the prompt on a PREFILL
+            # replica first, then point the decode dispatch at its
+            # blocks (spec["kv_from"]). Any failure simply skips the
+            # hint — the decode replica prefills itself (monolithic),
+            # so disaggregation can only help. A spec that already
+            # carries kv_from (a migrating stream pulling from its
+            # draining replica) keeps it.
+            if (self._roles_enabled() and "kv_from" not in spec
+                    and isinstance(prompt, (list, tuple))
+                    and len(prompt) >= self.min_handoff_tokens):
+                await self._prefill_handoff(spec, trace)
             while True:
                 info = await self._pick_wait(prompt, exclude)
                 if info is None:
@@ -958,6 +1065,48 @@ class Router:
                                 outstanding=info.outstanding)
                 outcome, streamed, rec = await self._relay_any(
                     info, spec, sink)
+                if outcome == "migrate":
+                    # The replica is draining and this stream was asked
+                    # to move: fold the tokens the client already has
+                    # into a resume, point the next replica at the
+                    # draining one's pool (its cancel path ADOPTED the
+                    # slot's blocks, so the resume prefill is a KV pull
+                    # + tail, not a recompute), and re-dispatch. Not a
+                    # failure: no retry budget burned.
+                    hop = (rec or {}).get("tokens") or []
+                    spec = dict(spec)
+                    resume = (list(spec.get("resume_tokens") or ())
+                              + list(hop))
+                    if self._c_migrations is not None:
+                        self._c_migrations.inc()
+                    if trace is not None:
+                        trace.event("migrate", replica=info.rid,
+                                    streamed=len(hop))
+                    try:
+                        max_new = int(spec.get("max_new_tokens"))
+                    except (TypeError, ValueError):
+                        max_new = None
+                    if max_new is not None and len(resume) >= max_new:
+                        # The poke raced the stream's LAST token: the
+                        # client already holds the complete output and
+                        # only the done record was lost with the
+                        # connection — synthesize it instead of
+                        # re-dispatching a resume the engine would
+                        # rightly reject as having nothing to decode.
+                        done_rec = {
+                            "done": True, "tokens": resume,
+                            "trace_id": trace_id,
+                            "tenant": spec.get("tenant") or "default",
+                            "migrated_final": True}
+                        if trace is not None:
+                            trace.data["status"] = "ok"
+                        await sink.final(done_rec)
+                        return
+                    spec["resume_tokens"] = resume
+                    spec["kv_from"] = {"host": info.host,
+                                       "port": info.port}
+                    exclude.add(info.rid)
+                    continue
                 if outcome == "terminal":
                     if trace is not None:
                         trace.event("terminal", replica=info.rid,
@@ -1007,6 +1156,85 @@ class Router:
                 trace.data["retries"] = attempts
                 self.trace_store.put(trace)
 
+    async def _prefill_handoff(self, spec: dict, trace) -> None:
+        """Arrange the disaggregated handoff for one dispatch: run
+        ``kv_prefill`` on the prompt family's prefill replica (ONE
+        prefill per fleet for a hot prefix — repeats are trie hits
+        there), then stamp ``spec["kv_from"]`` so the decode replica
+        pulls the blocks instead of prefilling. Every failure mode
+        falls back silently to monolithic dispatch."""
+
+        def fallback(reason: str) -> None:
+            if self._c_handoff_fallbacks is not None:
+                self._c_handoff_fallbacks.inc()
+            if trace is not None:
+                trace.event("kv_handoff_fallback", reason=reason)
+
+        info = self._pick_prefill(spec["prompt"])
+        if info is None:
+            fallback("no_prefill_replica")
+            return
+        # Count the prefill against the replica's outstanding work:
+        # prefill load-balancing (the slack spill) and drain waits must
+        # see it.
+        info.outstanding += 1
+        t0 = time.monotonic()
+        try:
+            rep = await self._backend_control(
+                info, {"cmd": "kv_prefill", "prompt": spec["prompt"],
+                       "trace_id": spec.get("trace_id"),
+                       "tenant": spec.get("tenant"),
+                       "priority": spec.get("priority", 0)},
+                timeout=self.kv_prefill_timeout_s)
+        except (OSError, ValueError, asyncio.TimeoutError,
+                _BackendLost) as e:
+            self.supervisor.note_failure(info.rid)
+            fallback(f"{type(e).__name__}: {e}")
+            return
+        finally:
+            info.outstanding -= 1
+        if "error" in rep:
+            fallback(str(rep.get("code") or rep["error"]))
+            return
+        dur = time.monotonic() - t0
+        spec["kv_from"] = {"host": info.host, "port": info.port}
+        if self._c_handoffs is not None:
+            self._c_handoffs.inc()
+        if self._h_handoff is not None:
+            self._h_handoff.observe(dur, exemplar=spec.get("trace_id"))
+        if trace is not None:
+            trace.event("kv_prefill", replica=info.rid,
+                        dur_s=round(dur, 9))
+
+    # -- drain-by-migration -------------------------------------------------
+    def _register_relay(self, rid: str, ctl: _RelayCtl) -> None:
+        self._inflight.setdefault(rid, set()).add(ctl)
+
+    def _unregister_relay(self, rid: str, ctl: _RelayCtl) -> None:
+        ctls = self._inflight.get(rid)
+        if ctls is not None:
+            ctls.discard(ctl)
+            if not ctls:
+                self._inflight.pop(rid, None)
+
+    def migrate_streams(self, rid: str) -> int:
+        """Ask every in-flight classic relay on ``rid`` to move NOW:
+        each returns the ``"migrate"`` outcome and its dispatch loop
+        re-sends the request elsewhere with the streamed tokens folded
+        in (and the KV pulled from ``rid``'s pool, which adopted the
+        cancelled slots' blocks). Returns how many streams were asked.
+        Fast-path streams don't register here — roles fleets (the only
+        ones that migrate) route everything through the classic path."""
+        fired = 0
+        for ctl in list(self._inflight.get(rid, ())):
+            ctl.migrating = True
+            try:
+                ctl.fire()
+            except Exception:
+                pass  # one stream's poke must not strand the rest
+            fired += 1
+        return fired
+
     async def _relay_any(self, info: ReplicaInfo, spec: dict, sink):
         """One attempt through ``info`` over the best protocol it
         speaks: the multiplexed bin1 connection when negotiated, the
@@ -1027,6 +1255,8 @@ class Router:
         terminal = False
         sid = None
         q: asyncio.Queue = asyncio.Queue()
+        hop_tokens: list[int] = []  # this hop's streamed token VALUES
+        ctl = _RelayCtl(lambda: q.put_nowait(("migrate", None)))
 
         def handler(ftype, payload):
             # Callback -> queue adapter (the slow path keeps its awaitable
@@ -1041,6 +1271,7 @@ class Router:
                 q.put_nowait(("err", wire.decode_json(payload)))
 
         info.outstanding += 1
+        self._register_relay(info.rid, ctl)
         try:
             try:
                 sid = mux.open(handler)
@@ -1060,6 +1291,7 @@ class Router:
                 kind, payload = await q.get()
                 if kind == "tok":
                     streamed += len(payload)
+                    hop_tokens.extend(payload)
                     await sink.tokens(payload)
                 elif kind == "done":
                     terminal = True
@@ -1073,9 +1305,21 @@ class Router:
                     terminal = True
                     await sink.final(payload)
                     return "terminal", streamed, payload
+                elif kind == "migrate":
+                    # Drain-by-migration: stop here (the finally's
+                    # CANCEL frees the slot — its blocks are adopted)
+                    # and hand the streamed tokens back for the resume.
+                    # Tokens queued behind the sentinel are dropped;
+                    # the resume re-decodes them (greedy: identically).
+                    return "migrate", streamed, {"tokens": hop_tokens}
                 else:  # lost
+                    if ctl.migrating:
+                        # The poke raced the connection teardown: still
+                        # a migration, not a replica_lost.
+                        return "migrate", streamed, {"tokens": hop_tokens}
                     return "lost", streamed, None
         finally:
+            self._unregister_relay(info.rid, ctl)
             if sid is not None:
                 if terminal:
                     mux.release(sid)
@@ -1096,11 +1340,19 @@ class Router:
         the backend work by closing the backend connection."""
         streamed = 0
         info.outstanding += 1
+        hop_tokens: list[int] = []
         try:
             try:
                 conn = await self._acquire(info)
             except OSError:
                 return "lost", streamed, None
+            # Drain-by-migration poke: closing the backend connection
+            # interrupts the readline below AND cancels the replica-side
+            # request (its handler sees the reset; the cancel path
+            # adopts the slot's blocks) — ctl.migrating tells the
+            # failure handlers this was a migration, not a loss.
+            ctl = _RelayCtl(conn.writer.close)
+            self._register_relay(info.rid, ctl)
             healthy = False
             try:
                 with span("route", replica=info.rid,
@@ -1111,10 +1363,14 @@ class Router:
                     while True:
                         line = await conn.reader.readline()
                         if not line:
+                            if ctl.migrating:
+                                return "migrate", streamed, {
+                                    "tokens": hop_tokens}
                             return "lost", streamed, None
                         rec = json.loads(line)
                         if "token" in rec:
                             streamed += 1
+                            hop_tokens.append(rec["token"])
                             await sink.tokens([rec["token"]])
                             continue
                         if rec.get("done"):
@@ -1135,8 +1391,11 @@ class Router:
                 # OSError and propagates — closing the (unpooled, if
                 # mid-stream) backend connection cancels the request
                 # server-side instead of decoding for nobody.
+                if ctl.migrating:
+                    return "migrate", streamed, {"tokens": hop_tokens}
                 return "lost", streamed, None
             finally:
+                self._unregister_relay(info.rid, ctl)
                 self._release(info, conn, healthy=healthy)
         finally:
             info.outstanding -= 1
@@ -1168,6 +1427,7 @@ class Router:
                 self._fetch_verb(info, "healthz") for _, info in infos))
             replicas = {}
             versions: dict[str, int] = {}
+            migration_totals: dict[str, int] = {}
             for (rid, info), sub in zip(infos, fetched):
                 entry = info.public()
                 if sub is not None:
@@ -1182,6 +1442,13 @@ class Router:
                     if isinstance(wv, dict):
                         key = f"{wv.get('version')}:{wv.get('digest')}"
                         versions[key] = versions.get(key, 0) + 1
+                    km = (sub.get("kv_migrations")
+                          if isinstance(sub, dict) else None)
+                    if isinstance(km, dict):
+                        for k, v in km.items():
+                            if isinstance(v, (int, float)):
+                                migration_totals[k] = (
+                                    migration_totals.get(k, 0) + int(v))
                 replicas[rid] = entry
             router = {
                 "replicas_total": len(self.supervisor.replicas),
@@ -1190,6 +1457,16 @@ class Router:
                     r.outstanding
                     for r in self.supervisor.replicas.values()),
             }
+            roles: dict[str, int] = {}
+            for r in self.supervisor.replicas.values():
+                roles[r.role] = roles.get(r.role, 0) + 1
+            if set(roles) != {"monolithic"}:
+                # Disaggregated fleet: role census + fleet-summed
+                # migration counters, so "are handoffs landing" is one
+                # router healthz away.
+                router["roles"] = roles
+                if migration_totals:
+                    router["kv_migrations"] = migration_totals
             if versions:
                 router["weight_versions"] = versions
                 router["mixed_weight_versions"] = len(versions) > 1
@@ -1308,6 +1585,20 @@ class Router:
             # same stance as ServingServer's bad_request paths.
             return {"error": f"bad reload timeout: {e}",
                     "code": "bad_request"}
+        # Drain-by-migration: instead of waiting out every in-flight
+        # stream on the draining replica (a long generation holds the
+        # roll hostage for its whole decode), actively MIGRATE them —
+        # each classic relay is poked, its request re-dispatches to a
+        # peer with the streamed tokens folded in as a resume and the
+        # KV pulled from the draining replica's pool (the cancelled
+        # slot's blocks were adopted there). The client stream is never
+        # cut. Opt-in per reload (``migrate: true``); a migrated
+        # stream's continuation runs under whatever weights its NEW
+        # replica serves, so mid-roll migrations may hop onto the
+        # candidate weights — the drain-wait default keeps strict
+        # same-weights completion instead.
+        migrate = bool(spec.get("migrate"))
+        migrated = 0
         reloaded: list[str] = []
         failed: dict[str, str] = {}
         replicas: dict[str, dict] = {}
@@ -1335,6 +1626,8 @@ class Router:
                     info.status = DRAINING
                     try:
                         with span("reload_replica", replica=rid):
+                            if migrate:
+                                migrated += self.migrate_streams(rid)
                             deadline = time.monotonic() + drain_timeout
                             while info.outstanding > 0:
                                 if time.monotonic() > deadline:
@@ -1373,9 +1666,12 @@ class Router:
                             info.status = READY
         if not failed and self._c_reloads is not None:
             self._c_reloads.inc()
-        return {"reload": {"weights": path, "reloaded": reloaded,
-                           "failed": failed, "ok": not failed,
-                           "replicas": replicas}}
+        out = {"reload": {"weights": path, "reloaded": reloaded,
+                          "failed": failed, "ok": not failed,
+                          "replicas": replicas}}
+        if migrate:
+            out["reload"]["migrated_streams"] = migrated
+        return out
 
     @staticmethod
     async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:
@@ -1405,9 +1701,11 @@ class ServingCluster:
     def __init__(self, factory, n: int, *, host: str = "127.0.0.1",
                  port: int = 0, registry=None,
                  supervisor_kwargs: dict | None = None,
-                 router_kwargs: dict | None = None):
+                 router_kwargs: dict | None = None,
+                 roles=None):
         self.supervisor = ReplicaSupervisor(
-            factory, n, registry=registry, **(supervisor_kwargs or {}))
+            factory, n, registry=registry, roles=roles,
+            **(supervisor_kwargs or {}))
         self.router = Router(self.supervisor, host=host, port=port,
                              registry=registry, **(router_kwargs or {}))
         self._health_task: asyncio.Task | None = None

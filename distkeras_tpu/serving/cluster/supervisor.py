@@ -45,7 +45,35 @@ from distkeras_tpu.serving.cluster.replicas import (
     send_control,
 )
 
-__all__ = ["ReplicaSupervisor"]
+__all__ = ["ReplicaSupervisor", "parse_roles"]
+
+
+def parse_roles(spec: str | None) -> list[str] | None:
+    """``"prefill=N,decode=M"`` into the index-aligned role list the
+    supervisor takes (prefill replicas first) — THE parser behind
+    ``run.py cluster --roles`` and both benches' ``--roles`` flags, so
+    the accepted grammar can never drift between them. Raises
+    ``ValueError`` on bad input (CLI front ends map it to a typed
+    exit); ``None``/empty means no roles (a monolithic fleet)."""
+    if not spec:
+        return None
+    counts = {"prefill": 0, "decode": 0}
+    for part in str(spec).split(","):
+        name, sep, value = part.partition("=")
+        name = name.strip()
+        if not sep or name not in counts:
+            raise ValueError(
+                f"roles need prefill=N,decode=M, got {part!r}")
+        try:
+            counts[name] = int(value)
+        except ValueError:
+            raise ValueError(f"bad role count in {part!r}") from None
+    if counts["prefill"] < 1 or counts["decode"] < 1:
+        raise ValueError("roles need at least one prefill and one "
+                         "decode replica (omit roles for a monolithic "
+                         "fleet)")
+    return (["prefill"] * counts["prefill"]
+            + ["decode"] * counts["decode"])
 
 
 class ReplicaSupervisor:
@@ -75,9 +103,20 @@ class ReplicaSupervisor:
         max_delay_s: float = 30.0,
         stable_after_s: float = 5.0,
         registry=None,
+        roles=None,
     ):
         if n < 1:
             raise ValueError(f"need at least 1 replica, got {n}")
+        if roles is not None:
+            if len(roles) != n:
+                raise ValueError(
+                    f"roles names {len(roles)} replicas for a fleet of "
+                    f"{n}")
+            bad = sorted({r for r in roles
+                          if r not in ("prefill", "decode", "monolithic")})
+            if bad:
+                raise ValueError(f"unknown replica roles {bad}; valid: "
+                                 f"prefill/decode/monolithic")
         self._factory = factory
         self.health_interval_s = float(health_interval_s)
         self.health_timeout_s = float(health_timeout_s)
@@ -85,8 +124,13 @@ class ReplicaSupervisor:
         self.base_delay_s = float(base_delay_s)
         self.max_delay_s = float(max_delay_s)
         self.stable_after_s = float(stable_after_s)
+        # Disaggregated fleets: per-index role ("prefill"/"decode"),
+        # default "monolithic". A role is a stable property of the
+        # SLOT, not the incarnation — restarts keep it.
         self.replicas: dict[str, ReplicaInfo] = {
-            f"r{i}": ReplicaInfo(rid=f"r{i}", index=i, handle=factory(i))
+            f"r{i}": ReplicaInfo(
+                rid=f"r{i}", index=i, handle=factory(i),
+                role=(roles[i] if roles is not None else "monolithic"))
             for i in range(n)
         }
         self._stopping = asyncio.Event()

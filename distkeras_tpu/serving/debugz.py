@@ -144,6 +144,17 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
             f"{kp.get('families')} prefix families, "
             f"{kp.get('preemptions', 0)} preemptions, "
             f"{kp.get('oom_rejections', 0)} oom rejects)")
+        if kp.get("kv_migrations") or kp.get("kv_migration_fallbacks") \
+                or kp.get("kv_exports"):
+            # Disaggregated serving: this replica's block-migration
+            # traffic (imports adopted / fallbacks to monolithic
+            # prefill / bytes moved / chains exported to peers).
+            lines.append(
+                f"{indent}kv_migration: "
+                f"{kp.get('kv_migrations', 0)} adopted, "
+                f"{kp.get('kv_migration_fallbacks', 0)} fallbacks, "
+                f"{_mb(kp.get('kv_migration_bytes', 0)) or '0.0'} MB "
+                f"moved, {kp.get('kv_exports', 0)} exports")
         fams = kp.get("top_families", [])
         if fams:
             for ln in _table(fams, [("family_head", "family_head"),
@@ -180,9 +191,11 @@ def format_debugz(payload: dict) -> str:
             f"{r.get('pooled_connections', 0)} pooled conns")
         for rid in sorted(payload["replicas"]):
             info = payload["replicas"][rid]
+            role = info.get("role")
             lines.append(
                 f"replica {rid}: {info.get('status')} "
-                f"{info.get('host')}:{info.get('port')} "
+                + (f"[{role}] " if role and role != "monolithic" else "")
+                + f"{info.get('host')}:{info.get('port')} "
                 f"outstanding={info.get('outstanding')} "
                 f"restarts={info.get('restarts')} "
                 f"fails={info.get('consecutive_failures')} "

@@ -264,6 +264,26 @@ def _paged_decode_fn(module, top_k, params, pools, tokens, temps, positions,
     return mut["cache"], nxt
 
 
+def _kv_gather_fn(cache, ids):
+    """Gather pool rows ``ids`` from every KV leaf — the device half of
+    a KV block EXPORT (:mod:`distkeras_tpu.serving.kv_transfer`).
+    ``ids`` is pow2-padded so compiles stay bounded; the padding rows'
+    garbage is sliced off host-side."""
+    return jax.tree.map(lambda p: p[ids] if p.ndim > 1 else p[:0], cache)
+
+
+def _kv_scatter_fn(cache, data, ids):
+    """Scatter imported block rows ``data`` into the pool at rows
+    ``ids`` — the device half of a KV block IMPORT. ``ids`` pads its
+    pow2 bucket with out-of-range ids; ``mode="drop"`` discards those
+    writes (the same OOB discipline as the pool's store path). Donates
+    the pool."""
+    return jax.tree.map(
+        lambda p, d: (p.at[ids].set(d.astype(p.dtype), mode="drop")
+                      if p.ndim > 1 else p),
+        cache, data)
+
+
 def _spec_draft_fn(module, K, params, cache, prev, tokens, start):
     """Fixed-K greedy draft scan: ONE dispatch proposes K tokens per row.
 
@@ -1024,6 +1044,21 @@ class ServingEngine:
                 functools.partial(_paged_decode_fn, self._module, top_k),
                 (psh, csh, rep, rep, rep, rep, rep), (csh, rep),
                 donate=(1, 2))
+            # KV block migration (serving/kv_transfer.py): gather rows
+            # for an export (output replicated — it is host-fetched
+            # immediately, and on a sharded engine the all-gather IS
+            # the full-heads serialization contract), scatter imported
+            # rows back in (upload replicated, pool keeps its
+            # heads-sharded layout — the kv_pytree_shardings reshard
+            # seam). Both run between ticks via the engine loop's
+            # pending-op queue, so they can never race a donated cache.
+            self._kv_gather = _sharded_jit(
+                _kv_gather_fn, (csh, rep), rep, donate=())
+            self._kv_scatter = _sharded_jit(
+                _kv_scatter_fn, (csh, rep, rep), csh, donate=(0,))
+            # Pending export/import operations, serviced by the run
+            # loop between iterations: (kind, arg, event, result).
+            self._pending_kv: list[tuple] = []
         else:
             rsh = self._row_shardings
             self._prefill = _sharded_jit(
@@ -1076,6 +1111,14 @@ class ServingEngine:
         if auditor is not None:
             self._prefill = auditor.wrap(self._prefill, "serving_prefill")
             self._admit_jit = auditor.wrap(self._admit_jit, "serving_admit")
+            if self._paged:
+                # Report-only (never armed): export/import are rare
+                # control-path operations, but their compile counts
+                # still belong in the audit report.
+                self._kv_gather = auditor.wrap(
+                    self._kv_gather, "serving_kv_gather")
+                self._kv_scatter = auditor.wrap(
+                    self._kv_scatter, "serving_kv_scatter")
             self._decode_step = auditor.wrap(
                 self._decode_step, "serving_decode")
             if self._spec:
@@ -1343,6 +1386,11 @@ class ServingEngine:
                 "blocks_free": self.kv_pool.blocks_free,
                 "preemptions": self.metrics.preemptions,
                 "oom_rejections": self.metrics.oom_rejections,
+                "kv_migrations": self.metrics.kv_migrations,
+                "kv_migration_fallbacks":
+                    self.metrics.kv_migration_fallbacks,
+                "kv_migration_bytes": self.metrics.kv_migration_bytes,
+                "kv_exports": self.metrics.kv_exports,
             }
         if self.flight_recorder is not None:
             out["flight_recorder"] = self.flight_recorder.stats()
@@ -1364,10 +1412,20 @@ class ServingEngine:
         trace_id: str | None = None,
         speculate: bool = True,
         tenant: str = "default",
+        resume_tokens=None,
     ) -> Request:
         """Validation half of submission: everything that can reject a
         request typed BEFORE it touches the scheduler — shared by
-        :meth:`submit` and the batched :meth:`submit_many`."""
+        :meth:`submit` and the batched :meth:`submit_many`.
+
+        ``resume_tokens``: output tokens the client ALREADY received on
+        another replica (live slot migration off a draining peer): they
+        pre-seed ``out_tokens``, so admission prefills prompt + resume
+        and the first sampled token CONTINUES the stream instead of
+        restarting it — the same fold-streamed-tokens-into-prefill
+        contract paged preemption uses in-process, applied over the
+        wire. They count against ``max_new_tokens`` and are never
+        re-streamed."""
         if self._stopping:
             raise EngineStopped("engine is shutting down; not admitting")
         prompt_arr = np.asarray(prompt, np.int32)
@@ -1403,6 +1461,20 @@ class ServingEngine:
             priority=priority, timeout=timeout, trace_id=trace_id,
             speculate=speculate, tenant=tenant,
         )
+        if resume_tokens:
+            try:
+                resume = [int(t) for t in resume_tokens]
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"bad resume_tokens: {e}") from None
+            if len(resume) >= max_new_tokens:
+                raise ValueError(
+                    f"resume_tokens ({len(resume)}) >= max_new_tokens "
+                    f"({max_new_tokens}): nothing left to decode")
+            # Pre-seed the streamed prefix: _resident_tokens, the resume
+            # prefill, quota cost, and the slot's remaining budget all
+            # read prompt + out_tokens — the resumed request is
+            # indistinguishable from a locally preempted one.
+            req.out_tokens = resume
         if self._trace_requests:
             req.trace = TimelineRecord(req.trace_id, "engine",
                                        self.trace_source)
@@ -1422,6 +1494,7 @@ class ServingEngine:
         trace_id: str | None = None,
         speculate: bool = True,
         tenant: str = "default",
+        resume_tokens=None,
     ) -> Request:
         """Validate and enqueue a request; returns the streaming handle.
 
@@ -1434,7 +1507,8 @@ class ServingEngine:
         req = self._build_request(
             prompt, max_new_tokens, temperature=temperature,
             priority=priority, timeout=timeout, trace_id=trace_id,
-            speculate=speculate, tenant=tenant)
+            speculate=speculate, tenant=tenant,
+            resume_tokens=resume_tokens)
         try:
             self.scheduler.submit(req)
         except ServingError:
@@ -1462,6 +1536,7 @@ class ServingEngine:
                     trace_id=spec.get("trace_id"),
                     speculate=bool(spec.get("speculate", True)),
                     tenant=str(spec.get("tenant") or "default"),
+                    resume_tokens=spec.get("resume_tokens"),
                 ))
             except (ServingError, KeyError, TypeError, ValueError) as e:
                 built.append(e)
@@ -1565,6 +1640,165 @@ class ServingEngine:
             self._pending_swap = None
             return True
         return False
+
+    # -- KV block migration (serving/kv_transfer.py) -------------------------
+    def request_kv_export(self, prompt):
+        """Queue a KV block export: serialize the pool's longest
+        complete-block chain for ``prompt`` (prefix trie hit — a slot
+        that finished or preempted has ADOPTED its blocks there, so
+        "export a slot's blocks" and "export a cached prefix" are one
+        walk). Serviced by the run loop between iterations; returns
+        ``(event, result)`` — await the event, then read ``result``
+        (``payload`` bytes + ``matched_tokens``, or ``error``). Raises
+        :class:`~distkeras_tpu.serving.kv_transfer.KVTransferError`
+        immediately on a dense engine (blocks only exist paged)."""
+        from distkeras_tpu.serving.kv_transfer import KVTransferError
+
+        if not self._paged:
+            raise KVTransferError(
+                "KV export requires a paged engine (--paged / "
+                "--kv-pool-mb): dense caches have no block bookkeeping")
+        event: asyncio.Event = asyncio.Event()
+        result: dict = {}
+        self._pending_kv.append(("export", prompt, event, result))
+        self.scheduler.kick()
+        return event, result
+
+    def request_kv_import(self, payload: bytes):
+        """Queue a KV block import: validate a peer's KVX1 payload
+        (geometry + weight provenance), adopt its block chain into the
+        pool's trie, and upload the rows — after which an admission for
+        the same prompt is a zero-copy prefix hit. Same ``(event,
+        result)`` contract as :meth:`request_kv_export`; a pool-dry
+        receiver adopts what fits (possibly nothing) and reports it in
+        ``result`` rather than failing — import must only ever help."""
+        from distkeras_tpu.serving.kv_transfer import KVTransferError
+
+        if not self._paged:
+            raise KVTransferError(
+                "KV import requires a paged engine (--paged / "
+                "--kv-pool-mb)")
+        event: asyncio.Event = asyncio.Event()
+        result: dict = {}
+        self._pending_kv.append(("import", payload, event, result))
+        self.scheduler.kick()
+        return event, result
+
+    def _kv_export_sync(self, prompt) -> dict:
+        """Executor-thread export: pin the chain, gather its pool rows,
+        serialize. The pin only needs to span this call — the engine
+        loop serializes every pool mutation."""
+        from distkeras_tpu.serving.kv_transfer import (
+            MAX_TRANSFER_BYTES,
+            KVTransferError,
+            serialize_blocks,
+        )
+
+        tokens = [int(t) for t in prompt]
+        match = self.kv_pool.match_blocks(tokens)
+        try:
+            n = len(match.ids)
+            if n == 0:
+                return {"matched_tokens": 0, "blocks": 0, "payload": None}
+            padded = self._pad_kv_ids(match.ids, fill=0)
+            rows = self._kv_gather(self._cache, jnp.asarray(padded))
+            leaves = [np.asarray(l)[:n] for l in jax.tree.leaves(rows)
+                      if l.ndim > 1]
+            payload = serialize_blocks(
+                tokens[:n * self.kv_block_tokens], leaves,
+                block_tokens=self.kv_block_tokens,
+                provenance=self.weight_version)
+        finally:
+            self.kv_pool.release(match)
+        if len(payload) > MAX_TRANSFER_BYTES:
+            raise KVTransferError(
+                f"serialized blocks ({len(payload)} bytes) exceed one "
+                f"KVBLK frame ({MAX_TRANSFER_BYTES}); receiver falls "
+                f"back to monolithic prefill")
+        self.metrics.record_kv_export(len(payload))
+        return {"matched_tokens": n * self.kv_block_tokens, "blocks": n,
+                "bytes": len(payload), "payload": payload}
+
+    def _kv_import_sync(self, payload) -> dict:
+        """Executor-thread import: validate geometry + provenance
+        (typed rejects), adopt the chain, scatter the new rows."""
+        from distkeras_tpu.serving.kv_transfer import (
+            KVTransferError,
+            deserialize_blocks,
+        )
+
+        header, leaves = deserialize_blocks(payload)
+        if int(header["block_tokens"]) != self.kv_block_tokens:
+            raise KVTransferError(
+                f"block geometry mismatch: peer blocks hold "
+                f"{header['block_tokens']} tokens, this pool "
+                f"{self.kv_block_tokens}")
+        mine = [l for l in jax.tree.leaves(self._cache) if l.ndim > 1]
+        theirs = header.get("leaves", [])
+        if len(theirs) != len(mine):
+            raise KVTransferError(
+                f"KV leaf count mismatch: payload has {len(theirs)}, "
+                f"this pool {len(mine)}")
+        for i, (meta, leaf) in enumerate(zip(theirs, mine)):
+            want = (tuple(int(s) for s in meta["shape"][1:]),
+                    str(meta["dtype"]))
+            have = (tuple(leaf.shape[1:]), np.dtype(leaf.dtype).name)
+            if want != have:
+                raise KVTransferError(
+                    f"KV leaf {i} geometry mismatch: payload "
+                    f"{want[1]}{want[0]}, this pool {have[1]}{have[0]}")
+        prov = header.get("provenance") or {}
+        mine_prov = self.weight_version
+        if (int(prov.get("version") or 0), prov.get("digest")) != (
+                int(mine_prov.get("version") or 0),
+                mine_prov.get("digest")):
+            # KV is a pure function of (weights, tokens): adopting
+            # blocks computed under other weights would poison every
+            # later hit. Typed reject; the caller prefills monolithic.
+            raise KVTransferError(
+                f"weight provenance mismatch: blocks computed under "
+                f"v{prov.get('version')}/{prov.get('digest')}, serving "
+                f"v{mine_prov.get('version')}/{mine_prov.get('digest')}")
+        tokens = [int(t) for t in header.get("tokens", [])]
+        n_blocks = int(header.get("n_blocks") or 0)
+        uploads, resident = self.kv_pool.adopt_foreign(tokens, n_blocks)
+        if uploads:
+            idxs = [i for i, _ in uploads]
+            rows = np.asarray([r for _, r in uploads], np.int32)
+            padded = self._pad_kv_ids(rows, fill=self.kv_pool.capacity)
+            b = len(padded)
+            treedef = jax.tree.structure(self._cache)
+            data_leaves = []
+            src = iter(leaves)
+            for leaf in jax.tree.leaves(self._cache):
+                if leaf.ndim <= 1:
+                    data_leaves.append(jnp.zeros((b, 0), leaf.dtype))
+                    continue
+                arr = next(src)[idxs]
+                if len(idxs) < b:  # pad to the pow2 bucket (dropped)
+                    pad = np.zeros((b - len(idxs),) + arr.shape[1:],
+                                   arr.dtype)
+                    arr = np.concatenate([arr, pad], axis=0)
+                data_leaves.append(jnp.asarray(arr))
+            data = jax.tree.unflatten(treedef, data_leaves)
+            self._cache = self._kv_scatter(self._cache, data,
+                                           jnp.asarray(padded))
+        return {"adopted_blocks": len(uploads),
+                "resident_blocks": resident,
+                "matched_tokens": resident * self.kv_block_tokens,
+                "bytes": len(payload)}
+
+    def _pad_kv_ids(self, ids, fill: int) -> np.ndarray:
+        """Pow2-pad a pool row-id vector so the KV gather/scatter
+        programs compile once per bucket (the pool's _pad_ids rule,
+        applied to transfer ops)."""
+        n = len(ids)
+        b = 1
+        while b < n:
+            b *= 2
+        out = np.full((b,), fill, np.int32)
+        out[:n] = ids
+        return out
 
     def _swap_sync(self, params) -> None:
         """Executor-thread half of the swap: transfer, flush, rewarm.
@@ -1689,6 +1923,25 @@ class ServingEngine:
                                 res["error"] = ServingError(
                                     "engine died mid-swap")
                             ev.set()
+                # 3c. KV block transfers (export to / import from a
+                # peer replica): serviced between iterations, so the
+                # gather/scatter can never race a decode step's donated
+                # cache buffers. Device work in the executor, event
+                # resolution on the loop thread.
+                if self._paged and self._pending_kv:
+                    ops, self._pending_kv = self._pending_kv, []
+                    for kind, arg, ev, res in ops:
+                        with span("kv_transfer", kind=kind):
+                            try:
+                                res.update(await self._in_executor(
+                                    loop,
+                                    (self._kv_export_sync
+                                     if kind == "export"
+                                     else self._kv_import_sync), arg))
+                            except Exception as e:
+                                res["error"] = e
+                            finally:
+                                ev.set()
                 # 4. Admission: prefill queued requests into free slots.
                 # Device work runs in the executor; stream/metrics
                 # bookkeeping stays on the loop thread (asyncio queues and
@@ -1944,6 +2197,13 @@ class ServingEngine:
                 self._pending_swap = None
                 res["error"] = err
                 ev.set()
+            # Same for pending KV transfers: a peer awaiting an export
+            # must get its typed failure now, not a hung timeout.
+            if self._paged and self._pending_kv:
+                ops, self._pending_kv = self._pending_kv, []
+                for _, _, ev, res in ops:
+                    res["error"] = err
+                    ev.set()
             self._stopping = True
             # Last words: the black box hits disk BEFORE the exception
             # propagates — a chaos-killed (task-cancelled) or device-

@@ -1,0 +1,237 @@
+"""KV block migration: move a prompt's paged KV blocks between replicas.
+
+The paged engine's :class:`~distkeras_tpu.serving.prefix_cache.
+KVBlockPool` keeps exact per-block bookkeeping — which pool rows hold
+which token blocks' K/V — which makes a slot's (or a cached prefix's)
+KV **serializable**: gather the rows, stamp them with the block
+geometry, the exact token chain they cover, and the weight provenance
+they were computed under, and any other replica holding the SAME
+weights can adopt them into its own pool and skip the prefill compute
+entirely. That one primitive is what disaggregated prefill/decode
+serving, cross-replica prefix-cache sharing, and live slot migration
+off a draining replica are all built from (docs/serving.md
+"Disaggregated serving").
+
+Wire format (``KVX1``), designed for bitwise round trips:
+
+    [4s magic "KVX1"] [u32 header_len] [header JSON] [leaf 0 bytes]
+    [leaf 1 bytes] ...
+
+The header carries ``block_tokens``, the exact token list the blocks
+cover (``n_blocks * block_tokens`` tokens — adoption is keyed by token
+content, so a corrupt or mismatched chain can never alias a different
+prompt), the sender's weight provenance stamp (version + digest; KV is
+a pure function of (weights, tokens), so the receiver REJECTS a stamp
+that differs from its own — typed, before any device work), and each
+KV leaf's per-block shape + dtype (the compatibility check between
+pools). Leaf bytes are raw C-order ``[n_blocks, block_tokens, H, D]``
+arrays in ``jax.tree.leaves`` order — the same prompt serialized twice
+from the same pool is byte-identical, and a same-geometry receiver
+re-uploads them bit-for-bit. A tensor-parallel receiver re-shards the
+heads dimension through the engine's existing ``kv_pytree_shardings``
+placement seam: the payload always carries FULL heads (the exporter
+gathers across its mesh), so any mesh whose tp divides the head count
+adopts compatibly; geometry that differs in shape/dtype/block size is
+a typed :class:`KVTransferError` reject.
+
+Blocks ship replica→replica as ONE bin1 ``KVBLK`` frame
+(:data:`~distkeras_tpu.serving.wire.T_KVBLK`) — binary end to end,
+never JSON through the router's event loop. :func:`fetch_blocks` is
+the pull client: connect to the peer, negotiate bin1, send the
+``kv_export`` verb, read back the KVBLK frame (or the typed miss /
+error reply). It is jax-free on purpose: the router-level handoff and
+fallback logic is exercised against :class:`~distkeras_tpu.serving.
+cluster.replicas.EchoServer` fleets without paying a jax import.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+import numpy as np
+
+__all__ = [
+    "KVTransferError",
+    "MAX_TRANSFER_BYTES",
+    "serialize_blocks",
+    "deserialize_blocks",
+    "peek_header",
+    "fetch_blocks",
+]
+
+_MAGIC = b"KVX1"
+_LEN = struct.Struct("<I")
+
+# One KVBLK payload must fit one bin1 frame (wire.MAX_FRAME, minus
+# header slack). Exports past this are a typed reject — the caller
+# falls back to monolithic prefill, which is the bounded outcome; a
+# multi-frame chunking protocol is not worth its failure modes until a
+# real model's prompt blocks outgrow 16 MB.
+MAX_TRANSFER_BYTES = 2 ** 24 - 64
+
+
+class KVTransferError(ValueError):
+    """A KV block transfer that cannot (or must not) be applied:
+    corrupt payload, incompatible pool geometry, weight-provenance
+    mismatch, or an export too large for one frame. Always mapped to a
+    typed reply and a MONOLITHIC fallback — never a client-visible
+    failure."""
+
+    code = "kv_transfer"
+
+
+def _dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes extras (bfloat16)
+    jax arrays carry — lazily, so the codec stays importable on
+    jax-free hosts (EchoServer, router-only tests)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_blocks(tokens, leaves, *, block_tokens: int,
+                     provenance: dict | None = None) -> bytes:
+    """Pack ``leaves`` — one ``[n_blocks, block_tokens, ...]`` numpy
+    array per KV leaf, ``jax.tree.leaves`` order — covering ``tokens``
+    (exactly ``n_blocks * block_tokens`` of them) into one KVX1
+    payload. ``provenance`` is the sender's weight stamp
+    (``{"version", "digest"}``)."""
+    tokens = [int(t) for t in tokens]
+    arrays = [np.ascontiguousarray(a) for a in leaves]
+    n_blocks = arrays[0].shape[0] if arrays else len(tokens) // block_tokens
+    if len(tokens) != n_blocks * int(block_tokens):
+        raise KVTransferError(
+            f"token count {len(tokens)} does not cover {n_blocks} "
+            f"blocks of {block_tokens} tokens")
+    for a in arrays:
+        if a.ndim < 2 or a.shape[0] != n_blocks \
+                or a.shape[1] != int(block_tokens):
+            raise KVTransferError(
+                f"leaf shape {a.shape} is not [{n_blocks}, "
+                f"{block_tokens}, ...]")
+    header = {
+        "block_tokens": int(block_tokens),
+        "n_blocks": int(n_blocks),
+        "tokens": tokens,
+        "provenance": {
+            "version": int((provenance or {}).get("version") or 0),
+            "digest": (provenance or {}).get("digest"),
+        },
+        "leaves": [{"shape": list(a.shape), "dtype": a.dtype.name}
+                   for a in arrays],
+    }
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    out = bytearray(_MAGIC)
+    out += _LEN.pack(len(hdr))
+    out += hdr
+    for a in arrays:
+        out += a.tobytes()
+    return bytes(out)
+
+
+def peek_header(payload) -> dict:
+    """The KVX1 header alone (stdlib only — no array decode): what a
+    receiver validates BEFORE touching bytes, and what the jax-free
+    Echo emulation answers from."""
+    buf = bytes(payload)
+    if len(buf) < 8 or buf[:4] != _MAGIC:
+        raise KVTransferError("not a KVX1 payload (bad magic)")
+    (hlen,) = _LEN.unpack_from(buf, 4)
+    if len(buf) < 8 + hlen:
+        raise KVTransferError("truncated KVX1 header")
+    try:
+        header = json.loads(buf[8:8 + hlen])
+    except ValueError as e:
+        raise KVTransferError(f"bad KVX1 header JSON: {e}") from None
+    if not isinstance(header, dict) or "block_tokens" not in header:
+        raise KVTransferError("malformed KVX1 header")
+    return header
+
+
+def deserialize_blocks(payload) -> tuple[dict, list[np.ndarray]]:
+    """Inverse of :func:`serialize_blocks`: ``(header, leaves)``. Every
+    length is validated against the header before a single
+    ``np.frombuffer`` — a truncated or lying payload is a typed
+    :class:`KVTransferError`, never an out-of-bounds read."""
+    buf = bytes(payload)
+    header = peek_header(buf)
+    (hlen,) = _LEN.unpack_from(buf, 4)
+    pos = 8 + hlen
+    leaves: list[np.ndarray] = []
+    for meta in header.get("leaves", []):
+        shape = tuple(int(s) for s in meta["shape"])
+        dt = _dtype(str(meta["dtype"]))
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        if pos + nbytes > len(buf):
+            raise KVTransferError(
+                f"truncated KVX1 leaf: header declares {nbytes} bytes, "
+                f"{len(buf) - pos} remain")
+        leaves.append(np.frombuffer(buf, dtype=dt, count=int(np.prod(shape)),
+                                    offset=pos).reshape(shape))
+        pos += nbytes
+    if pos != len(buf):
+        raise KVTransferError(
+            f"KVX1 payload has {len(buf) - pos} trailing bytes")
+    return header, leaves
+
+
+async def fetch_blocks(host: str, port: int, tokens, *,
+                       timeout: float = 10.0,
+                       trace_id: str | None = None) -> bytes | None:
+    """Pull the peer's cached KV blocks for ``tokens``' longest resident
+    prefix: negotiate bin1, send the ``kv_export`` control verb, read
+    back ONE ``KVBLK`` frame. Returns the raw KVX1 payload, or ``None``
+    when the peer holds no blocks for this prompt (a miss, not a
+    failure). Raises :class:`KVTransferError` on a typed peer-side
+    reject and ``OSError``/``asyncio.TimeoutError`` on transport
+    failure — callers treat every raise as "fall back to monolithic
+    prefill"."""
+    from distkeras_tpu.serving import wire
+
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port, limit=2 ** 24), timeout)
+    try:
+        writer.write(wire.hello_line())
+        await writer.drain()
+        line = await asyncio.wait_for(reader.readline(), timeout)
+        try:
+            rec = json.loads(line) if line else {}
+        except ValueError:
+            rec = {}
+        if wire.parse_hello(rec) != wire.PROTO_BIN1:
+            raise KVTransferError(
+                f"peer {host}:{port} does not speak bin1 (KVBLK frames "
+                f"need the binary protocol)")
+        spec = {"cmd": "kv_export", "prompt": [int(t) for t in tokens]}
+        if trace_id:
+            spec["trace_id"] = str(trace_id)
+        writer.write(wire.encode_json_frame(wire.T_CTRL, 1, spec))
+        await writer.drain()
+        decoder = wire.FrameDecoder()
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            data = await asyncio.wait_for(
+                reader.read(2 ** 18),
+                max(0.001, deadline - asyncio.get_running_loop().time()))
+            if not data:
+                raise ConnectionError(
+                    f"peer {host}:{port} closed during kv_export")
+            for ftype, _sid, payload in decoder.feed(data):
+                if ftype == wire.T_KVBLK:
+                    return bytes(payload)
+                if ftype == wire.T_CTRLR:
+                    rep = wire.decode_json(payload)
+                    if "error" in rep:
+                        raise KVTransferError(str(rep["error"]))
+                    return None  # typed miss: peer has no blocks
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass

@@ -159,6 +159,31 @@ class ServingMetrics:
             "kv_oom_rejections_total",
             help="requests rejected because their full context can "
                  "never fit the KV block pool")
+        # KV block migration (disaggregated prefill/decode + cross-
+        # replica prefix sharing, serving/kv_transfer.py): adoptions of
+        # a peer's blocks, bytes moved, fallbacks to monolithic
+        # prefill, and the pull latency with trace exemplars — the
+        # family the "decode fleet starving" runbook triages first.
+        self._c_kv_migrations = reg.counter(
+            "kv_migrations_total",
+            help="KV block chains adopted from a peer replica "
+                 "(disaggregated handoff / cross-replica prefix share "
+                 "/ slot migration)")
+        self._c_kv_migration_fallbacks = reg.counter(
+            "kv_migration_fallbacks_total",
+            help="KV migrations that fell back to monolithic prefill "
+                 "(peer unreachable/miss, provenance mismatch, pool "
+                 "dry) — never a client-visible error")
+        self._c_kv_migration_bytes = reg.counter(
+            "kv_migration_bytes_total",
+            help="serialized KV block bytes adopted from peers")
+        self._c_kv_exports = reg.counter(
+            "kv_exports_total",
+            help="KV block chains serialized and shipped to a peer")
+        self._h["kv_migration"] = reg.histogram(
+            "kv_migration_seconds",
+            help="peer pull + adopt latency per KV migration",
+            buckets=_LATENCY_BUCKETS)
         self._g_slo = reg.gauge(
             "serving_slo_seconds",
             help="configured request-latency SLO (0 = no SLO armed)")
@@ -377,6 +402,36 @@ class ServingMetrics:
 
     def record_oom_reject(self) -> None:
         self._c_oom_rejections.inc()
+
+    def record_kv_migration(self, nbytes: int, latency_s: float,
+                            trace_id: str | None = None) -> None:
+        """One adopted KV block migration: bytes moved + pull-to-adopt
+        latency, exemplar'd with the request it served."""
+        self._c_kv_migrations.inc()
+        self._c_kv_migration_bytes.inc(int(nbytes))
+        self._h["kv_migration"].observe(latency_s, exemplar=trace_id)
+
+    def record_kv_migration_fallback(self) -> None:
+        self._c_kv_migration_fallbacks.inc()
+
+    def record_kv_export(self, nbytes: int) -> None:
+        self._c_kv_exports.inc()
+
+    @property
+    def kv_migrations(self) -> int:
+        return int(self._c_kv_migrations.value)
+
+    @property
+    def kv_migration_fallbacks(self) -> int:
+        return int(self._c_kv_migration_fallbacks.value)
+
+    @property
+    def kv_migration_bytes(self) -> int:
+        return int(self._c_kv_migration_bytes.value)
+
+    @property
+    def kv_exports(self) -> int:
+        return int(self._c_kv_exports.value)
 
     @property
     def preemptions(self) -> int:

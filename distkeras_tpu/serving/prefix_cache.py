@@ -310,6 +310,29 @@ class _BlockTrie:
         return PrefixMatch(
             chain, np.asarray([n.slot for n in chain], np.int32), matched)
 
+    def match_blocks(self, tokens) -> PrefixMatch:
+        """Longest cached chain over ALL complete blocks of ``tokens``,
+        pinned like :meth:`match` but WITHOUT the last-block holdback
+        (:meth:`_match_cap`) and without touching the hit/miss stats —
+        this is the EXPORT walk (kv_transfer): a peer adopting the
+        chain wants the full resident prefix, and an export lookup is
+        not an admission, so it must not skew the cache-efficiency
+        series operators alert on."""
+        node, chain = self._root, []
+        for key in self._blocks(tokens, len(tokens) // self.block_tokens):
+            nxt = node.children.get(key)
+            if nxt is None:
+                break
+            chain.append(nxt)
+            node = nxt
+        now = next(self._clock)
+        for n in chain:
+            n.refs += 1
+            self._touch(n, now)
+        return PrefixMatch(
+            chain, np.asarray([n.slot for n in chain], np.int32),
+            len(chain) * self.block_tokens)
+
     def release(self, match: PrefixMatch | None) -> None:
         if match is None or match.released:
             return
@@ -729,6 +752,48 @@ class KVBlockPool(_BlockTrie):
                 self._metrics["inserts"].inc(adopted)
             self._note_occupancy()
         return adopted
+
+    def adopt_foreign(self, tokens, n_blocks: int):
+        """Receiving half of a KV block migration (kv_transfer): chain
+        the first ``n_blocks`` complete blocks of ``tokens`` into the
+        trie, allocating a fresh pool row for each block not already
+        resident. Returns ``(uploads, resident_blocks)``: ``uploads``
+        is the ``(block_index, pool_row)`` list the engine must scatter
+        the payload's data into (already-cached duplicates need no
+        upload — the resident copy is bit-identical by the provenance
+        contract), and ``resident_blocks`` is the contiguous prefix now
+        matchable. A dry pool stops the walk early — the contiguous
+        prefix adopted so far still serves, and adoption NEVER evicts a
+        decode slot's blocks or preempts local work (foreign blocks
+        must only ever help): only unreferenced trie leaves may be
+        reclaimed, exactly like a local insert."""
+        keys = list(self._blocks(tokens, int(n_blocks)))
+        node = self._root
+        now = next(self._clock)
+        uploads: list[tuple[int, int]] = []
+        resident = 0
+        for i, key in enumerate(keys):
+            child = node.children.get(key)
+            if child is None:
+                slot = self._alloc(protect=node)
+                if slot is None:
+                    break  # pool dry: keep the contiguous prefix
+                child = _Node(slot, node, key)
+                node.children[key] = child
+                self._by_slot[slot] = child
+                self.inserted_blocks += 1
+                uploads.append((i, slot))
+            self._touch(child, now)
+            node = child
+            resident += 1
+        if uploads:
+            self.peak_blocks_used = max(self.peak_blocks_used,
+                                        self.blocks_used)
+            self.version += 1
+            if self._metrics is not None:
+                self._metrics["inserts"].inc(len(uploads))
+                self._note_occupancy()
+        return uploads, resident
 
     def _note_occupancy(self) -> None:
         if self._metrics is not None:

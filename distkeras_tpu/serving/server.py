@@ -38,9 +38,11 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 
 from distkeras_tpu.serving import wire
 from distkeras_tpu.serving.engine import ServingEngine
+from distkeras_tpu.serving.kv_transfer import KVTransferError, fetch_blocks
 from distkeras_tpu.serving.scheduler import Request, ServingError
 from distkeras_tpu.telemetry.request_trace import sanitize_trace_id
 
@@ -65,7 +67,8 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, *, wire_mode: str = "auto",
-                 flush_interval_s: float = 0.0):
+                 flush_interval_s: float = 0.0,
+                 kv_transfer_timeout_s: float = 10.0):
         if wire_mode not in ("auto", "jsonl"):
             raise ValueError(
                 f"wire_mode must be 'auto' or 'jsonl', got {wire_mode!r}")
@@ -73,6 +76,10 @@ class ServingServer:
         self.host = host
         self.wire_mode = wire_mode
         self.flush_interval_s = float(flush_interval_s)
+        # Bound on one KV block migration (peer pull + local adopt):
+        # past it the request simply prefills monolithic — a slow link
+        # must cost latency once, never wedge admission.
+        self.kv_transfer_timeout_s = float(kv_transfer_timeout_s)
         self._requested_port = port
         self._server: asyncio.AbstractServer | None = None
         self._engine_task: asyncio.Task | None = None
@@ -132,7 +139,78 @@ class ServingServer:
             trace_id=spec.get("trace_id"),
             speculate=bool(spec.get("speculate", True)),
             tenant=str(spec.get("tenant") or "default"),
+            resume_tokens=spec.get("resume_tokens"),
         )
+
+    async def _import_from_peer(self, spec: dict) -> dict | None:
+        """Disaggregated handoff, receiving side: a spec carrying
+        ``kv_from`` names the replica whose pool already holds this
+        prompt's prefilled KV blocks (the router prefilled it there, or
+        a draining replica adopted a migrating slot's blocks). Pull
+        them (ONE KVBLK frame) and adopt them into our pool, so the
+        admission that follows is a zero-copy prefix hit and the
+        decode batch never pays the prefill.
+
+        EVERY failure — peer unreachable/miss, slow link, provenance
+        mismatch, pool-dry receiver — returns a ``fallback`` info dict
+        and the request prefills monolithic: disaggregation can only
+        help, never surface a client-visible error. Returns None when
+        the spec has no ``kv_from``."""
+        src = spec.pop("kv_from", None)
+        if not isinstance(src, dict):
+            return None
+        eng = self.engine
+        info: dict = {"from": f"{src.get('host')}:{src.get('port')}"}
+        # The peer holds blocks for the full resident sequence — for a
+        # migrated slot that includes the tokens already streamed.
+        tokens = list(spec.get("prompt") or ())
+        tokens += list(spec.get("resume_tokens") or ())
+        t0 = time.monotonic()
+        try:
+            payload = await asyncio.wait_for(
+                fetch_blocks(str(src.get("host")), int(src.get("port")),
+                             tokens, timeout=self.kv_transfer_timeout_s,
+                             trace_id=spec.get("trace_id")),
+                self.kv_transfer_timeout_s)
+            if payload is None:
+                info["fallback"] = "peer_miss"
+            else:
+                event, result = eng.request_kv_import(payload)
+                await asyncio.wait_for(event.wait(),
+                                       self.kv_transfer_timeout_s)
+                err = result.get("error")
+                if err is not None:
+                    info["fallback"] = str(err)
+                elif not result.get("resident_blocks"):
+                    info["fallback"] = "pool_dry"
+                else:
+                    info["bytes"] = result["bytes"]
+                    info["matched_tokens"] = result["matched_tokens"]
+                    info["adopted_blocks"] = result["adopted_blocks"]
+                    info["latency_s"] = round(time.monotonic() - t0, 6)
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                KVTransferError, wire.WireError, TypeError,
+                ValueError) as e:
+            info["fallback"] = f"{type(e).__name__}: {e}"
+        if "fallback" in info:
+            eng.metrics.record_kv_migration_fallback()
+        else:
+            eng.metrics.record_kv_migration(
+                info["bytes"], info["latency_s"],
+                trace_id=spec.get("trace_id"))
+        return info
+
+    @staticmethod
+    def _note_migration(req: Request, info: dict | None) -> None:
+        """Stamp migration info onto the request: the done line carries
+        it back to the router (fleet accounting), and the engine's
+        timeline gains a ``kv_import`` hop under the request's
+        trace_id."""
+        if info is None:
+            return
+        req.kv_migration = info
+        if req.trace is not None:
+            req.trace.event("kv_import", **info)
 
     @staticmethod
     def _done_record(req: Request) -> dict:
@@ -149,6 +227,12 @@ class ServingServer:
             # digest) the serving params came from — a bad answer
             # names its weights.
             done["weight_version"] = req.weight_version
+        migration = getattr(req, "kv_migration", None)
+        if migration is not None:
+            # The router's fleet rollup (and the disagg bench) read
+            # migration outcomes off done lines — bytes moved, matched
+            # tokens, or the fallback reason.
+            done["kv_migration"] = migration
         return done
 
     async def _handle(self, reader: asyncio.StreamReader,
@@ -178,7 +262,11 @@ class ServingServer:
                     if isinstance(spec, dict) and "cmd" in spec:
                         await self._send(writer, await self._control(spec))
                         continue
+                    kv_info = None
+                    if isinstance(spec, dict) and "kv_from" in spec:
+                        kv_info = await self._import_from_peer(spec)
                     req = self._submit_spec(spec)
+                    self._note_migration(req, kv_info)
                 except ServingError as e:
                     await self._send(writer, self._error(e, spec))
                     continue
@@ -226,6 +314,8 @@ class ServingServer:
         live: dict[int, Request] = {}
         pumps: set[asyncio.Task] = set()
         ctrls: set[asyncio.Task] = set()
+        kv_wait: set[int] = set()       # sids whose REQ is pulling KV
+        kv_cancelled: set[int] = set()  # cancels that raced a pull
         try:
             while True:
                 data = await reader.read(2 ** 18)
@@ -250,6 +340,11 @@ class ServingServer:
                         req = live.get(sid)
                         if req is not None:
                             req.cancel()
+                        elif sid in kv_wait:
+                            # The REQ is mid-KV-pull in a deferred
+                            # admission task — remember the cancel for
+                            # when it submits.
+                            kv_cancelled.add(sid)
                         else:
                             # The REQ may sit in THIS read's batch,
                             # not yet submitted — remember, or a
@@ -265,28 +360,41 @@ class ServingServer:
                             self._ctrl_bin1(sid, payload, sink))
                         ctrls.add(ctrl)
                         ctrl.add_done_callback(ctrls.discard)
+                    elif ftype == wire.T_KVBLK:
+                        # A pushed KV block chain: adopting it IS the
+                        # kv_import verb. As a task — the adopt waits
+                        # for the engine loop's next iteration.
+                        ctrl = asyncio.get_running_loop().create_task(
+                            self._kv_import_frame(sid, payload, sink))
+                        ctrls.add(ctrl)
+                        ctrl.add_done_callback(ctrls.discard)
                     else:
                         sink.send_error(sid, {
                             "error": f"unexpected frame type {ftype}",
                             "code": "bad_request"})
                 if batch:
-                    results = self.engine.submit_many(
-                        [spec for _, spec in batch])
-                    for (sid, spec), res in zip(batch, results):
-                        if isinstance(res, Request):
-                            live[sid] = res
-                            if sid in precancelled:
-                                res.cancel()
-                            task = asyncio.get_running_loop().create_task(
-                                self._pump_bin1(sid, res, sink, live))
-                            pumps.add(task)
-                            task.add_done_callback(pumps.discard)
-                        else:
-                            code = ("bad_request"
-                                    if not isinstance(res, ServingError)
-                                    else None)
-                            sink.send_error(sid, self._error(
-                                res, spec, code=code))
+                    # Disaggregated handoff: specs naming a KV source
+                    # pull + adopt their blocks BEFORE admission — in a
+                    # DEFERRED task (all of one read batch's pulls run
+                    # concurrently there), so a slow or dead peer can
+                    # never head-of-line-block this read loop: other
+                    # streams' REQ/CANCEL frames keep processing while
+                    # the pull waits out its timeout. Plain specs admit
+                    # inline through ONE submit_many as before.
+                    plain = [(sid, spec) for sid, spec in batch
+                             if "kv_from" not in spec]
+                    kv_batch = [(sid, spec) for sid, spec in batch
+                                if "kv_from" in spec]
+                    self._admit_bin1(plain, precancelled, {},
+                                     live, pumps, sink)
+                    if kv_batch:
+                        kv_wait.update(sid for sid, _ in kv_batch)
+                        task = asyncio.get_running_loop().create_task(
+                            self._kv_admit_bin1(kv_batch, kv_wait,
+                                                kv_cancelled, live,
+                                                pumps, sink))
+                        ctrls.add(task)
+                        task.add_done_callback(ctrls.discard)
         finally:
             # Client gone (EOF, reset, or corrupt framing): release every
             # in-flight slot instead of decoding for nobody.
@@ -304,14 +412,123 @@ class ServingServer:
             except (ConnectionResetError, BrokenPipeError, OSError):
                 pass
 
+    def _admit_bin1(self, batch, cancelled, kv_infos, live, pumps,
+                    sink) -> None:
+        """Admit one bin1 read batch's decoded specs through ONE
+        ``submit_many`` and start their pumps. ``cancelled`` holds sids
+        whose CANCEL raced admission; ``kv_infos`` maps batch index ->
+        migration info for specs that pulled KV first."""
+        if not batch:
+            return
+        loop = asyncio.get_running_loop()
+        results = self.engine.submit_many([spec for _, spec in batch])
+        for i, ((sid, spec), res) in enumerate(zip(batch, results)):
+            if isinstance(res, Request):
+                live[sid] = res
+                self._note_migration(res, kv_infos.get(i))
+                if sid in cancelled:
+                    res.cancel()
+                task = loop.create_task(
+                    self._pump_bin1(sid, res, sink, live))
+                pumps.add(task)
+                task.add_done_callback(pumps.discard)
+            else:
+                code = ("bad_request"
+                        if not isinstance(res, ServingError) else None)
+                sink.send_error(sid, self._error(res, spec, code=code))
+
+    async def _kv_admit_bin1(self, batch, kv_wait, kv_cancelled, live,
+                             pumps, sink) -> None:
+        """Deferred admission for specs carrying ``kv_from``: pull every
+        peer's blocks concurrently, then admit the batch. Off the read
+        loop by design — a dead peer costs THESE requests one timeout
+        (then monolithic fallback), never the connection's other
+        streams."""
+        try:
+            infos = await asyncio.gather(*(
+                self._import_from_peer(spec) for _, spec in batch))
+            self._admit_bin1(batch, kv_cancelled,
+                             dict(enumerate(infos)), live, pumps, sink)
+        finally:
+            for sid, _ in batch:
+                kv_wait.discard(sid)
+                kv_cancelled.discard(sid)
+
     async def _ctrl_bin1(self, sid: int, payload,
                          sink: "wire.FrameSink") -> None:
-        """One control verb off a bin1 connection, as its own task."""
+        """One control verb off a bin1 connection, as its own task.
+        ``kv_export`` is special-cased here because its success reply is
+        a BINARY ``KVBLK`` frame (the serialized blocks), not a JSON
+        control reply — the reason the verb needs bin1 at all."""
         try:
-            rep = await self._control(wire.decode_json(payload))
+            spec = wire.decode_json(payload)
         except wire.WireError as e:
-            rep = {"error": str(e), "code": "bad_request"}
-        sink.send_json(wire.T_CTRLR, sid, rep)
+            sink.send_json(wire.T_CTRLR, sid,
+                           {"error": str(e), "code": "bad_request"})
+            return
+        if spec.get("cmd") == "kv_export":
+            rep = await self._kv_export_verb(spec)
+            blob = rep.pop("payload", None)
+            if blob:
+                sink.send_raw(wire.T_KVBLK, sid, blob)
+            else:
+                sink.send_json(wire.T_CTRLR, sid, rep)
+            return
+        sink.send_json(wire.T_CTRLR, sid, await self._control(spec))
+
+    async def _kv_export_verb(self, spec: dict) -> dict:
+        """Serialize the pool's blocks for a prompt. Success returns
+        ``{"payload": bytes, ...}`` (the bin1 handler ships it as a
+        KVBLK frame); a miss or typed failure returns a JSON reply."""
+        prompt = spec.get("prompt") or []
+        try:
+            event, result = self.engine.request_kv_export(prompt)
+        except (KVTransferError, TypeError, ValueError) as e:
+            return {"error": str(e),
+                    "code": getattr(e, "code", "bad_request")}
+        try:
+            await asyncio.wait_for(event.wait(),
+                                   self.kv_transfer_timeout_s)
+        except asyncio.TimeoutError:
+            return {"error": "kv_export timed out waiting for the "
+                             "engine loop", "code": "busy"}
+        err = result.get("error")
+        if err is not None:
+            return {"error": str(err),
+                    "code": getattr(err, "code", "kv_transfer")}
+        if not result.get("payload"):
+            return {"kv_export": {"matched_tokens": 0, "blocks": 0}}
+        return {"payload": result["payload"],
+                "kv_export": {"matched_tokens": result["matched_tokens"],
+                              "blocks": result["blocks"],
+                              "bytes": result["bytes"]}}
+
+    async def _kv_import_frame(self, sid: int, payload,
+                               sink: "wire.FrameSink") -> None:
+        """Adopt a pushed KVBLK frame (the kv_import verb's frame form);
+        reply with the adopt outcome as a control reply."""
+        try:
+            event, result = self.engine.request_kv_import(bytes(payload))
+            await asyncio.wait_for(event.wait(),
+                                   self.kv_transfer_timeout_s)
+        except (KVTransferError, TypeError, ValueError) as e:
+            sink.send_json(wire.T_CTRLR, sid, {
+                "error": str(e), "code": getattr(e, "code", "bad_request")})
+            return
+        except asyncio.TimeoutError:
+            sink.send_json(wire.T_CTRLR, sid, {
+                "error": "kv_import timed out waiting for the engine "
+                         "loop", "code": "busy"})
+            return
+        err = result.get("error")
+        if err is not None:
+            sink.send_json(wire.T_CTRLR, sid, {
+                "error": str(err),
+                "code": getattr(err, "code", "kv_transfer")})
+            return
+        sink.send_json(wire.T_CTRLR, sid, {"kv_import": {
+            k: result[k] for k in ("adopted_blocks", "resident_blocks",
+                                   "matched_tokens", "bytes")}})
 
     async def _pump_bin1(self, sid: int, req: Request,
                          sink: "wire.FrameSink",
@@ -346,6 +563,15 @@ class ServingServer:
         cmd = spec.get("cmd")
         if cmd == "reload":
             return await self._reload(spec)
+        if cmd == "kv_prefill":
+            return await self._kv_prefill(spec)
+        if cmd == "kv_export":
+            # Reachable only over JSONL (the bin1 handler intercepts it
+            # to ship a binary KVBLK frame): the blocks cannot ride a
+            # JSON line.
+            return {"error": "kv_export needs a bin1 connection (the "
+                             "reply is a binary KVBLK frame)",
+                    "code": "bad_request"}
         if cmd == "debugz":
             return {"debugz": self.engine.debugz()}
         if cmd == "tracez":
@@ -386,6 +612,15 @@ class ServingServer:
                 health["prefix_cache"] = engine.prefix_cache.stats()
             if engine.kv_pool is not None:
                 health["kv_pool"] = engine.kv_pool.stats()
+                # Block-migration rollup (the router sums these across
+                # the fleet; the "decode fleet starving" runbook reads
+                # them here first).
+                health["kv_migrations"] = {
+                    "migrations": engine.metrics.kv_migrations,
+                    "fallbacks": engine.metrics.kv_migration_fallbacks,
+                    "bytes": engine.metrics.kv_migration_bytes,
+                    "exports": engine.metrics.kv_exports,
+                }
             if engine.auditor is not None:
                 health["recompile_audit"] = engine.auditor.report()
             if engine.slo_s is not None:
@@ -416,6 +651,49 @@ class ServingServer:
             return {"error": f"bad n {spec.get('n')!r}",
                     "code": "bad_request"}
         return {"tracez": {"recent": store.recent(n), **store.stats()}}
+
+    async def _kv_prefill(self, spec: dict) -> dict:
+        """``{"cmd": "kv_prefill", "prompt": [...]}``: the PREFILL
+        replica's half of a disaggregated handoff. Run the prompt
+        through admission with ``max_new_tokens=1`` — prefill writes
+        its KV blocks into the pool, the slot's teardown ADOPTS every
+        complete block into the prefix trie (shareable, exportable),
+        and the one sampled token is discarded (the decode replica
+        samples its own, token-identically: same weights, same greedy
+        rule). A repeated prompt (the cross-replica prefix-share case)
+        is a trie hit here and costs only the uncached tail. The reply
+        carries what became exportable; failures are typed — the
+        router falls back to monolithic dispatch."""
+        if self.engine.kv_pool is None:
+            return {"error": "kv_prefill requires a paged engine "
+                             "(--paged / --kv-pool-mb): only pooled "
+                             "blocks are exportable",
+                    "code": "kv_transfer"}
+        prompt = spec.get("prompt") or []
+        try:
+            req = self.engine.submit(
+                prompt, 1, speculate=False,
+                priority=int(spec.get("priority", 0)),
+                timeout=spec.get("timeout"),
+                trace_id=spec.get("trace_id"),
+                tenant=str(spec.get("tenant") or "default"))
+        except ServingError as e:
+            return self._error(e, spec if isinstance(spec, dict) else {})
+        except (KeyError, TypeError, ValueError) as e:
+            return self._error(e, spec, code="bad_request")
+        try:
+            await req.result()
+        except ServingError as e:
+            return {"error": str(e), "code": e.code,
+                    "trace_id": req.trace_id}
+        bt = getattr(self.engine, "kv_block_tokens", 0)
+        return {"kv_prefill": {
+            "ok": True,
+            "prompt_tokens": len(req.prompt),
+            "blocks": (len(req.prompt) // bt) if bt else 0,
+            "trace_id": req.trace_id,
+            "weight_version": req.weight_version,
+        }}
 
     async def _reload(self, spec: dict) -> dict:
         """``{"cmd": "reload", "weights": path}``: hot-swap the engine's

@@ -29,7 +29,15 @@ Frame types:
 - ``T_CTRL`` / ``T_CTRLR`` — control verbs and their replies, JSON
   payload (``metricsz``/``healthz``/... ride the same mux);
 - ``T_CANCEL`` — client abandons one stream (a mux peer can't signal
-  cancellation by closing the shared connection).
+  cancellation by closing the shared connection);
+- ``T_KVBLK`` — one serialized KV block chain (the ``KVX1`` payload of
+  :mod:`distkeras_tpu.serving.kv_transfer`): sent by a replica
+  answering the ``kv_export`` verb, and adopted (the ``kv_import``
+  operation) by a replica that receives one. This is how paged KV
+  blocks ship replica→replica for disaggregated prefill/decode —
+  binary end to end, never JSON through the router's event loop. The
+  native ``fw_scan_frames`` receive scan is frame-type-agnostic, so
+  KVBLK frames ride the same batched read path as every other type.
 
 **Negotiation** is an upgrade from JSONL, so unknown peers keep today's
 protocol byte-for-byte: a bin1-capable client's FIRST line is JSON
@@ -77,6 +85,7 @@ __all__ = [
     "T_CTRL",
     "T_CTRLR",
     "T_CANCEL",
+    "T_KVBLK",
     "WireError",
     "native_available",
     "hello_line",
@@ -111,6 +120,7 @@ T_ERR = 4
 T_CTRL = 5
 T_CTRLR = 6
 T_CANCEL = 7
+T_KVBLK = 8  # serialized KV block chain (kv_transfer KVX1 payload)
 
 # Frame header AFTER the u32 length prefix: type byte + stream id.
 _HDR = struct.Struct("<IBI")  # len, type, stream — one pack per frame
@@ -131,9 +141,21 @@ _SMALL_PROMPT_TOKENS = 64
 # 28-byte offset so np.frombuffer reads it without a copy.
 _REQ = struct.Struct("<IfidBBHI")
 # fields: max_new_tokens u32, temperature f32, priority i32, timeout f64
-# (NaN = none), flags u8 (bit0 = speculate), tenant_len u8,
-# trace_len u16, prompt_len u32.
+# (NaN = none), flags u8 (bit0 = speculate, bit1 = extras present),
+# tenant_len u8, trace_len u16, prompt_len u32.
 _F_SPECULATE = 1
+# Extras (bit1): a trailing [u32 len][JSON] blob after the trace string,
+# for the RARE spec fields the fixed header has no slot for — the
+# router's disaggregation hints (``kv_from``: which replica holds the
+# prompt's prefilled KV blocks) and migration resumes
+# (``resume_tokens``: tokens the client already received on a previous
+# replica, folded into the resume prefill). Absent on every ordinary
+# request, so the hot-path frame stays byte-identical to pre-extras
+# senders; a pre-extras DECODER rejects an extras frame typed
+# (length-mismatch WireError) — extras are only ever produced inside a
+# roles-enabled fleet, whose replicas all speak them.
+_F_EXTRAS = 2
+_EXTRA_KEYS = ("kv_from", "resume_tokens")
 
 
 class WireError(ValueError):
@@ -294,6 +316,15 @@ def encode_request(spec: dict) -> bytes:
         raise WireError("trace_id too long")
     timeout = spec.get("timeout")
     flags = _F_SPECULATE if spec.get("speculate", True) else 0
+    extras = {k: spec[k] for k in _EXTRA_KEYS if spec.get(k)}
+    extra_bytes = b""
+    if extras:
+        flags |= _F_EXTRAS
+        try:
+            blob = json.dumps(extras).encode()
+        except (TypeError, ValueError) as e:
+            raise WireError(f"bad request extras: {e}") from None
+        extra_bytes = _LEN.pack(len(blob)) + blob
     try:
         head = _REQ.pack(
             int(spec.get("max_new_tokens", 0)),
@@ -307,7 +338,7 @@ def encode_request(spec: dict) -> bytes:
         # an untyped struct.error here would kill the router's whole
         # client connection instead of failing one stream.
         raise WireError(f"bad request field: {e}") from None
-    return head + prompt_bytes + tenant + trace
+    return head + prompt_bytes + tenant + trace + extra_bytes
 
 
 def decode_request(payload) -> dict:
@@ -321,7 +352,23 @@ def decode_request(payload) -> dict:
     (max_new, temp, prio, timeout, flags, tenant_len, trace_len,
      prompt_len) = _REQ.unpack_from(buf)
     need = _REQ.size + 4 * prompt_len + tenant_len + trace_len
-    if len(buf) != need:
+    extras = None
+    if flags & _F_EXTRAS:
+        if len(buf) < need + 4:
+            raise WireError("request frame declares extras but has no "
+                            "extras length")
+        (elen,) = _LEN.unpack_from(buf, need)
+        if len(buf) != need + 4 + elen:
+            raise WireError(
+                f"request frame length mismatch: payload {len(buf)} "
+                f"bytes, header declares {need + 4 + elen}")
+        try:
+            extras = json.loads(buf[need + 4:need + 4 + elen])
+        except ValueError as e:
+            raise WireError(f"bad request extras JSON: {e}") from None
+        if not isinstance(extras, dict):
+            raise WireError("request extras must be a JSON object")
+    elif len(buf) != need:
         raise WireError(
             f"request frame length mismatch: payload {len(buf)} bytes, "
             f"header declares {need}")
@@ -347,6 +394,10 @@ def decode_request(payload) -> dict:
         spec["tenant"] = tenant
     if trace:
         spec["trace_id"] = trace
+    if extras:
+        for k in _EXTRA_KEYS:
+            if extras.get(k):
+                spec[k] = extras[k]
     return spec
 
 

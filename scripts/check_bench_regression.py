@@ -73,7 +73,7 @@ def load_history(path: str) -> dict:
 # they keep higher-is-better.
 _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     "queue_wait", "latency", "staleness",
-                    "deploy_latency")
+                    "deploy_latency", "fallback")
 
 
 def lower_is_better(key: str) -> bool:
