@@ -182,14 +182,14 @@ def _draft(args, model, variables):
 
 def _make_engine(args, model, variables, metrics=None, trace_store=None,
                  slots=None, tenant_quotas=None, tenant_weights=None,
-                 quota_burst_s=2.0):
+                 quota_burst_s=2.0, pipeline_depth=None, arm=False):
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
     paged = args.paged or args.kv_pool_mb > 0
     draft_model, draft_variables = _draft(args, model, variables)
     mesh = _mesh(args)
     auditor = None
-    if draft_model is not None or mesh is not None:
+    if arm or draft_model is not None or mesh is not None:
         # Speculative AND sharded runs arm the auditor: the acceptance
         # bar is not just the throughput/parity number but "while every
         # callable (draft/verify/fallback decode, sharded layouts
@@ -211,6 +211,8 @@ def _make_engine(args, model, variables, metrics=None, trace_store=None,
         max_context=args.max_context,
         draft_model=draft_model, draft_variables=draft_variables,
         spec_k=args.spec_k, mesh=mesh,
+        pipeline_depth=(args.pipeline_depth if pipeline_depth is None
+                        else pipeline_depth),
         auditor=auditor, arm_auditor_after_warmup=auditor is not None,
         trace_store=trace_store,
         tenant_quotas=tenant_quotas, tenant_weights=tenant_weights,
@@ -799,6 +801,125 @@ _SWEEP_METRICS = (
 )
 
 
+async def _pipeline_ab(args, model, variables, report):
+    """Depth-0 vs depth-1 A/B on the same saturated closed-loop
+    workload: one fresh engine per depth (pipelining is run-loop
+    structure, not compiled state — but a fresh engine keeps the two
+    measurements symmetric, warmup included), identical prompts, armed
+    auditor both sides, and every stream joins the parity cross-check.
+    The depth-1 win is the host gap: goodput up by roughly the depth-0
+    ``device_idle_ratio`` (the recorded ``host_gap_fraction``)."""
+    from distkeras_tpu.serving import ServingMetrics
+
+    out: dict = {}
+    all_results = []
+    depth_results: dict[int, list] = {}
+    prompts = _prompts(args, args.requests, salt=0)
+    for depth in (0, 1):
+        engine = _make_engine(args, model, variables,
+                              pipeline_depth=depth, arm=True)
+        # Warmup pass: pay every prefill-bucket + decode compile OUTSIDE
+        # the measured window, then measure on fresh metrics — the A/B's
+        # goodput and host-gap fraction must describe the steady state,
+        # not one-time compilation (which the gap tracker would honestly
+        # book as device idle).
+        task = asyncio.create_task(engine.run())
+        warm = list(prompts[:min(4, len(prompts))])
+        await _closed_loop(engine, warm, args)
+        engine.shutdown(drain=True)
+        await task
+        engine.reopen()
+        engine.metrics = ServingMetrics()
+        task = asyncio.create_task(engine.run())
+        t0 = time.monotonic()
+        results = await _closed_loop(engine, list(prompts), args)
+        elapsed = time.monotonic() - t0
+        engine.shutdown(drain=True)
+        await task
+        summary = engine.metrics.summary()
+        done_tokens = sum(len(t) for _, t in results)
+        compiles = engine.decode_compile_count()
+        assert compiles in (1, -1), (
+            f"pipeline depth {depth} retraced the decode step: "
+            f"{compiles} executables")
+        out[f"depth{depth}"] = {
+            "completed": len(results),
+            "wall_s": round(elapsed, 3),
+            "goodput_tokens_per_sec": round(done_tokens / elapsed, 2),
+            "inter_token_p99_s": round(
+                summary.get("inter_token_p99_s", 0.0), 6),
+            "ttft_p99_s": round(summary.get("ttft_p99_s", 0.0), 6),
+            "host_gap_p50_s": round(summary.get("host_gap_p50_s", 0.0), 9),
+            "host_gap_fraction": round(
+                summary.get("device_idle_ratio", 0.0), 4),
+            "decode_compile_count": compiles,
+        }
+        all_results.extend(results)
+        depth_results[depth] = results
+    # THE pipeline invariant, engine-vs-engine: identical prompts must
+    # stream identical greedy tokens at both depths (this pair is exempt
+    # from the documented slots>1 batch-width tie envelope that can
+    # separate EITHER engine from one-shot generate() — same ticks, same
+    # order, only the harvest deferred). Buckets come straight from each
+    # depth's own result list; a prompt depth 1 never completed counts
+    # as a mismatch, not a silent pass.
+    per_depth = []
+    for depth in (0, 1):
+        bucket: dict = {}
+        for p, toks in depth_results[depth]:
+            bucket.setdefault(tuple(p), toks)
+        per_depth.append(bucket)
+    depth_mismatches = sum(
+        1 for key, toks in per_depth[0].items()
+        if per_depth[1].get(key) != toks)
+    out["depth_parity_mismatches"] = depth_mismatches
+    assert depth_mismatches == 0, (
+        f"{depth_mismatches} prompts streamed different tokens at "
+        f"depth 1 than depth 0")
+    g0 = out["depth0"]["goodput_tokens_per_sec"]
+    g1 = out["depth1"]["goodput_tokens_per_sec"]
+    if g0 > 0:
+        out["speedup_x"] = round(g1 / g0, 3)
+    report["pipeline_ab"] = out
+    return all_results
+
+
+def _record_pipeline_history(args, report):
+    """``serving/pipeline_*`` rows for the strict CI gate: per-depth
+    goodput + saturated p99 ITL, the depth-0 host-gap fraction the
+    pipeline exists to hide, the depth-1 residue, and the A/B speedup
+    (higher-is-better by name; host_gap* regresses UP)."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    sec = report.get("pipeline_ab") or {}
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    paged = args.paged or args.kv_pool_mb > 0
+    model_tag = f"paged_{args.model}" if paged else args.model
+    base = (f"serving/pipeline_{model_tag}/slots{args.slots}"
+            f"/clients{args.clients}")
+    rows: dict = {"speedup_x": sec.get("speedup_x")}
+    for depth in (0, 1):
+        d = sec.get(f"depth{depth}") or {}
+        rows[f"depth{depth}/goodput_tokens_per_sec"] = (
+            d.get("goodput_tokens_per_sec"))
+        rows[f"depth{depth}/inter_token_p99_s"] = d.get("inter_token_p99_s")
+        rows[f"depth{depth}/host_gap_fraction"] = d.get("host_gap_fraction")
+    for metric, v in rows.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            key = f"{base}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
 def _record_history(args, report):
     """Append this run's headline numbers to ``bench_history.json`` under
     ``serving/...`` keys, via ``bench.py``'s shared ``history_entry`` /
@@ -1093,6 +1214,22 @@ def main():
                     help="assert the others' flood/baseline p99-TTFT "
                          "ratio stays <= this (acceptance: 1.25); 0 = "
                          "report only")
+    ap.add_argument("--pipeline-depth", type=int, choices=(0, 1), default=1,
+                    help="decode pipeline depth: 1 (default) dispatches "
+                         "tick N+1 before consuming tick N's tokens so "
+                         "host bookkeeping hides behind device compute; "
+                         "0 serializes dispatch+harvest")
+    ap.add_argument("--pipeline-ab", action="store_true",
+                    help="A/B the decode pipeline: run the closed-loop "
+                         "workload at depth 0 then depth 1 (fresh armed "
+                         "engine each), report per-depth goodput / p99 "
+                         "ITL / host-gap fraction and the speedup, and "
+                         "join every stream into the parity cross-check; "
+                         "records serving/pipeline_* history rows")
+    ap.add_argument("--min-speedup", type=float, default=0.0,
+                    help="--pipeline-ab: assert depth-1 goodput is at "
+                         "least this factor of depth-0 (acceptance: "
+                         "strictly above 1.0); 0 = report only")
     ap.add_argument("--record-history", action="store_true",
                     help="append serving/* rows to bench_history.json for "
                          "scripts/check_bench_regression.py")
@@ -1136,6 +1273,7 @@ def main():
         "kv_block": args.kv_block,
         "max_context": args.max_context,
         "replicas": args.replicas,
+        "pipeline_depth": args.pipeline_depth,
         "speculate": _speculating(args),
         "draft_model": (args.draft_model or args.model
                         if _speculating(args) else None),
@@ -1143,6 +1281,32 @@ def main():
         "mesh": (dict(_mesh(args).shape)
                  if (args.mesh or args.mesh_shape) else None),
     }}
+
+    if args.pipeline_ab:
+        # Decode-pipeline A/B: its own phases, its own rows.
+        model, variables = _model(args)
+        try:
+            all_results = asyncio.run(
+                _pipeline_ab(args, model, variables, report))
+            if not args.skip_parity:
+                mism = _check_parity(model, variables, all_results,
+                                     args.new_tokens)
+                report["parity_mismatches"] = mism
+                assert mism == 0, (
+                    f"{mism} pipelined streams diverged from generate()")
+            if args.min_speedup > 0:
+                got = (report.get("pipeline_ab") or {}).get("speedup_x")
+                assert got is not None and got >= args.min_speedup, (
+                    f"pipeline speedup {got} < required "
+                    f"{args.min_speedup}")
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        if args.record_history:
+            _record_pipeline_history(args, report)
+        print(json.dumps(report, indent=1))
+        return
 
     if args.tenants >= 2:
         # Adversarial multi-tenant mode: its own phases, its own rows.
@@ -1284,7 +1448,7 @@ def main():
                    if k.startswith(("ttft", "inter_token", "queue", "slot",
                                     "tokens_per_sec", "requests",
                                     "prefill", "prefix", "slo", "kv_",
-                                    "spec_"))},
+                                    "spec_", "host_gap", "device_idle"))},
             }
             engine.reopen()
         return all_results

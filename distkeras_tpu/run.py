@@ -157,6 +157,15 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "tokens, one per decode tick — bounds the decode "
                          "stall (p99 ITL) a long prompt can cause; "
                          "default: monolithic prefill")
+    ap.add_argument("--pipeline-depth", type=int, choices=(0, 1), default=1,
+                    help="decode pipeline depth: 1 (default) dispatches "
+                         "tick N+1 before consuming tick N's tokens, so "
+                         "host bookkeeping (streaming, admission, socket "
+                         "reads) overlaps device compute; 0 serializes "
+                         "dispatch and harvest (the pre-pipeline "
+                         "behavior). Greedy output is token-identical "
+                         "either way — see docs/serving.md 'Decode "
+                         "pipeline'")
     ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
                     help="> 0 enables the device-resident prompt prefix "
                          "cache under this byte budget: shared prefixes "
@@ -419,6 +428,7 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         max_context=args.max_context,
         draft_model=draft_model, draft_variables=draft_variables,
         spec_k=args.spec_k, mesh=mesh,
+        pipeline_depth=args.pipeline_depth,
         trace_store=trace_store, flight_recorder=recorder,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None,
         weight_version=weight_version,
@@ -534,6 +544,8 @@ def _serving_config_flags(args) -> list[str]:
         extra += ["--top-k", str(args.top_k)]
     if args.prefill_chunk is not None:
         extra += ["--prefill-chunk", str(args.prefill_chunk)]
+    if getattr(args, "pipeline_depth", None) is not None:
+        extra += ["--pipeline-depth", str(args.pipeline_depth)]
     if args.paged or args.kv_pool_mb:
         if args.paged:
             extra += ["--paged"]
@@ -763,6 +775,9 @@ def deploy_main(argv=None) -> int:
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="replica chunked-prefill size (tokens)")
+    ap.add_argument("--pipeline-depth", type=int, choices=(0, 1), default=1,
+                    help="replica decode pipeline depth (1 overlaps host "
+                         "bookkeeping with device compute; 0 serializes)")
     ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
                     help="replica prefix-cache byte budget (MB)")
     ap.add_argument("--prefix-block", type=int, default=16,

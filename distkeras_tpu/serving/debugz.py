@@ -48,6 +48,16 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
                  + (" SWAP-PENDING" if dz.get("pending_swap") else ""))
     if dz.get("slo_s") is not None:
         lines.append(f"{indent}slo={dz['slo_s']}s")
+    pl = dz.get("pipeline")
+    if isinstance(pl, dict):
+        gap = pl.get("host_gap_p50_s")
+        idle = pl.get("device_idle_ratio")
+        lines.append(
+            f"{indent}pipeline: depth={pl.get('depth')} "
+            f"inflight={pl.get('inflight') or '-'}"
+            + (f" host_gap_p50={gap * 1e3:.3f}ms" if gap is not None
+               else "")
+            + (f" device_idle={idle:.1%}" if idle is not None else ""))
     sp = dz.get("speculative")
     if sp:
         rate = sp.get("accept_rate")
@@ -389,6 +399,26 @@ def _fmt_event(ts: float, source: str, name: str, attrs) -> str:
     return line
 
 
+def _tick_lane(ticks) -> list[str]:
+    """The engine's dispatch→harvest tick timeline as its own lane:
+    per tick, kind, live rows, how long the harvest blocked on the
+    device (device-bound time the pipeline hid host work behind) and
+    the measured host gap (device-idle time it failed to hide)."""
+    if not ticks:
+        return []
+    lines = [f"tick lane ({len(ticks)} most recent):"]
+    for tk in ticks:
+        td, th = tk.get("t_dispatch"), tk.get("t_harvest")
+        span_s = (f" span={th - td:.6f}s"
+                  if isinstance(td, float) and isinstance(th, float)
+                  else "")
+        lines.append(
+            f"  {tk.get('kind', '?'):<6} rows={tk.get('rows', '-')}"
+            f" harvest_wait={tk.get('harvest_wait_s', '-')}s"
+            f" host_gap={tk.get('host_gap_s', '-')}s{span_s}")
+    return lines
+
+
 def format_tracez(payload: dict) -> str:
     """Pretty-print a tracez payload: a merged cross-process trace
     (router + engine hops), a single store's hop list, or a recent-
@@ -404,6 +434,7 @@ def format_tracez(payload: dict) -> str:
                 f"{rec.get('source')}  status={d.get('status', '?')} "
                 f"latency={d.get('latency_s', '-')}s "
                 f"tokens={d.get('tokens_out', '-')}")
+        lines.extend(_tick_lane(payload.get("ticks")))
         return "\n".join(lines)
     tid = payload.get("trace_id")
     lines.append(f"trace {tid}")

@@ -112,11 +112,52 @@ than one chip, served by the same engine:
 The draft model of a speculative engine stays **replicated** — it is
 small by definition, and replicating it trades a little memory for zero
 collectives in the latency-critical draft scan.
+
+**Overlapped decode pipeline** (``pipeline_depth=1``, the default): the
+run loop dispatches tick N+1 *before* consuming tick N's tokens. JAX
+dispatch is asynchronous — the jit call returns as soon as the work is
+enqueued — so the only point the host must wait for the device is the
+one D2H per tick (``np.asarray`` on the tick's token vector, the
+**harvest**). Serializing harvest right after dispatch (the old
+``_decode_sync``) made the accelerator idle through the FULL host gap
+between ticks: token streaming, slot teardown, admission bookkeeping,
+scheduler/metrics work, and the event-loop turn that reads sockets.
+Pipelined, all of that runs while the device executes the next tick:
+
+- ``self._tokens`` stays a device array end to end and is **double
+  buffered** — the decode step no longer donates its token operand, so
+  dispatching tick N+1 never invalidates the buffer tick N's harvest
+  is still going to read (16 bytes per tick of extra alloc, nothing);
+- a **pipeline barrier** (harvest + stream + teardown of the in-flight
+  tick) runs only at the events that change batch shape or content
+  mid-flight: admission, chunked-prefill progress, paged growth /
+  preemption, param swap (a swap still waits for zero in-flight
+  ticks), KV transfer, cancel/expire teardown, and engine idle/exit;
+- a slot that FINISHES at tick N is detected at N's harvest — after
+  N+1 was dispatched, so the in-flight tick ran one speculative row
+  for it. Its N+1 output is dropped exactly like a mid-prefill
+  garbage row, and (paged) the host watermark advance the dispatch
+  made for it is rolled back before teardown adopts its blocks, so
+  pool accounting never claims the speculative in-flight write;
+- speculative ticks dispatch asynchronously too, but the NEXT dispatch
+  needs their commit counts (host-side position bookkeeping), so a
+  spec tick is harvested before anything else is dispatched — spec
+  mode hides the inter-iteration host gap (steps 1–4 + socket reads),
+  while plain decode gets the full depth-1 overlap;
+- greedy output is **token-identical** to ``pipeline_depth=0`` in
+  every mode: the same ticks run in the same order over the same
+  state, only the host's read of each tick's result is deferred.
+
+Per-tick ``serving_host_gap_seconds`` / ``serving_device_idle_ratio``
+(:class:`~distkeras_tpu.serving.metrics.HostGapTracker`) measure what
+the pipeline hides, and :meth:`ServingEngine.tick_timeline` keeps a
+bounded dispatch→harvest lane for tracez/debugz.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextvars
 import dataclasses
 import functools
@@ -248,20 +289,29 @@ def _paged_admit_fn(tokens, temps, slot, tok, temp):
     return tokens.at[slot].set(tok), temps.at[slot].set(temp)
 
 
-def _paged_decode_fn(module, top_k, params, pools, tokens, temps, positions,
-                     tables, key):
+def _paged_decode_fn(module, top_k, sentinel, params, pools, tokens, temps,
+                     positions, tables, key):
     """Paged twin of :func:`_decode_fn`: K/V appends scatter into the
     pool at each row's (traced) position and attention gathers through
     the (traced) block tables — one compiled executable for every table
     layout, admission pattern, and context length, which is what keeps
     the armed ``RecompileAuditor`` silent while blocks chain, slots are
-    preempted, and long contexts grow."""
+    preempted, and long contexts grow.
+
+    Positions advance DEVICE-SIDE: each row that is live in the masked
+    table view (first table entry not the sentinel — exactly the rows
+    whose write lands) comes back at ``position + 1``, so steady-state
+    ticks re-feed the returned vector instead of rebuilding and
+    re-uploading a host array every tick. The host re-uploads from its
+    ``_lens`` truth only when the dirty flag says the decodable set or
+    a watermark changed — the same gating the block tables use."""
     logits, mut = module.apply(
         {"params": params, "cache": pools}, tokens[:, None], train=False,
         mutable=["cache"], positions=positions, block_tables=tables,
     )
     nxt = sample_rows(logits[:, -1], temps, key, top_k)
-    return mut["cache"], nxt
+    live = (tables[:, 0] != sentinel).astype(positions.dtype)
+    return mut["cache"], nxt, positions + live
 
 
 def _kv_gather_fn(cache, ids):
@@ -506,6 +556,40 @@ class _PrefillJob:
     device_s: float = 0.0         # prefill device time (TTFT's other half)
 
 
+def _tick_ready(tick) -> bool:
+    """True when every device buffer the tick's harvest will read has
+    already materialized — the harvest is then a plain memcpy, cheaper
+    run inline than through an executor round trip. Conservative on
+    jax versions without ``Array.is_ready`` (False → thread hop)."""
+    try:
+        if tick.kind == "spec":
+            return bool(tick.out.is_ready() and tick.commit.is_ready())
+        return bool(tick.tokens.is_ready())
+    except AttributeError:
+        return False
+
+
+@dataclasses.dataclass
+class _InflightTick:
+    """A dispatched-but-unharvested decode tick (``pipeline_depth=1``):
+    the device handles the harvest will read, the decodable rows the
+    dispatch covered (the stream targets — the slot table may gain or
+    lose entries before the harvest, and a row must stream iff it was
+    decodable AT DISPATCH and its slot is still alive), and — plain
+    paged ticks — the slots whose host ``_lens`` watermark the dispatch
+    optimistically advanced, so a teardown detected mid-flight can roll
+    the advance back before adopting blocks."""
+
+    kind: str                     # "decode" | "spec"
+    rows: tuple                   # decodable slots at dispatch
+    t_dispatch: float
+    tokens: object = None         # plain: device token vector to harvest
+    out: object = None            # spec: device [B, K] committed tokens
+    commit: object = None         # spec: device per-row commit counts
+    caps: object = None           # spec: host per-row draft budgets
+    advanced: set = dataclasses.field(default_factory=set)
+
+
 def _public_provenance(provenance: dict | None) -> dict:
     """The client-facing face of a weights stamp: version + digest
     ONLY. checkpoint.weights_provenance also carries the server-side
@@ -652,6 +736,7 @@ class ServingEngine:
         draft_variables=None,
         spec_k: int = 4,
         mesh=None,
+        pipeline_depth: int = 1,
         trace_store: TraceStore | None = None,
         flight_recorder: FlightRecorder | None = None,
         slo_s: float | None = None,
@@ -665,6 +750,24 @@ class ServingEngine:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
+        if pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"pipeline_depth must be 0 (serialized dispatch+harvest) "
+                f"or 1 (dispatch tick N+1 before consuming tick N), got "
+                f"{pipeline_depth}")
+        self.pipeline_depth = int(pipeline_depth)
+        # The dispatched-but-unharvested tick (depth 1) and a bounded
+        # dispatch->harvest timeline (the tracez tick lane).
+        self._inflight: _InflightTick | None = None
+        self._tick_log: collections.deque = collections.deque(maxlen=256)
+        # False until the first decode dispatch has run (and therefore
+        # compiled): the FIRST dispatch goes through the executor so a
+        # multi-second compile cannot freeze the event loop, every later
+        # one runs inline on the loop thread — dispatch is non-blocking
+        # by design (async jax dispatch), and the executor round trip
+        # it used to pay per tick is pure overhead that, on small
+        # models, can cost more than the host gap the pipeline hides.
+        self._dispatch_warm = False
         self.model = model
         self._spec = draft_model is not None
         self.draft_model = draft_model
@@ -899,8 +1002,17 @@ class ServingEngine:
             # EVERY tick just to conclude "unchanged" bt-1 times out of
             # bt. (Positions still upload every tick — they advance
             # with each decoded token.)
-            self._tables_dirty = True
+            self._mark_tables_dirty()
             self._tables_dev = None
+            # Device-side positions with the SAME dirty gating: the
+            # decode step returns each live row's position + 1, so the
+            # steady-state tick re-feeds the returned device vector and
+            # the per-tick host build + H2D upload only happens when the
+            # decodable set or a watermark actually changed (admission,
+            # growth, preemption, teardown, prefill completion, spec
+            # commits).
+            self._positions_dev = None
+            self._positions_dirty = True
             self.prefix_cache = None
             self.scheduler.cache_probe = self.kv_pool.probe
         else:
@@ -1009,10 +1121,16 @@ class ServingEngine:
         # One jit wrapper per engine so compile counts are per-instance:
         # the decode step must stay at exactly one executable for the
         # server's lifetime (see decode_compile_count()). The live batch
-        # cache/tokens are donated — the engine rebinds them from each
-        # call's outputs, and donation keeps the multi-MB KV caches
-        # updating in place instead of copying per decoded token. _temps
-        # is NOT donated in decode (it persists across iterations). The
+        # cache is donated — the engine rebinds it from each call's
+        # outputs, and donation keeps the multi-MB KV caches updating in
+        # place instead of copying per decoded token. The decode step's
+        # TOKEN operand is deliberately NOT donated (unlike the cache):
+        # that is the pipeline's double buffer — tick N's output tokens
+        # are the harvest handle the host reads AFTER tick N+1 has been
+        # dispatched with them as input, so the dispatch must not
+        # invalidate the buffer ([slots] int32 — the extra copy per tick
+        # is 4 bytes per slot). _temps is NOT donated either (it
+        # persists across iterations). The
         # prefill's incoming cache (single-row scratch in dense mode, the
         # shared pools in paged mode) is donated too: a chunk chain
         # threads it through every call, updating in place.
@@ -1041,9 +1159,10 @@ class ServingEngine:
                 _paged_admit_fn,
                 (rep, rep, rep, rep, rep), (rep, rep), donate=(0, 1))
             self._decode_step = _sharded_jit(
-                functools.partial(_paged_decode_fn, self._module, top_k),
-                (psh, csh, rep, rep, rep, rep, rep), (csh, rep),
-                donate=(1, 2))
+                functools.partial(_paged_decode_fn, self._module, top_k,
+                                  self._sentinel),
+                (psh, csh, rep, rep, rep, rep, rep), (csh, rep, rep),
+                donate=(1,))
             # KV block migration (serving/kv_transfer.py): gather rows
             # for an export (output replicated — it is host-fetched
             # immediately, and on a sharded engine the all-gather IS
@@ -1071,7 +1190,7 @@ class ServingEngine:
                 donate=(0, 1, 2))
             self._decode_step = _sharded_jit(
                 functools.partial(_decode_fn, self._module, top_k),
-                (psh, csh, rep, rep, rep), (csh, rep), donate=(1, 2))
+                (psh, csh, rep, rep, rep), (csh, rep), donate=(1,))
         if self._spec:
             # Draft cache donated; tokens are NOT (the verify consumes
             # them right after). Verify donates cache + tokens exactly
@@ -1181,6 +1300,9 @@ class ServingEngine:
             # the same reason free rows may decode garbage every tick.
             self._decode_sync()
             self._spec_sync()
+            # Every tick executable exists now: run-loop dispatches can
+            # go inline from the first iteration.
+            self._dispatch_warm = True
 
     # -- introspection ------------------------------------------------------
     def decode_compile_count(self) -> int:
@@ -1201,6 +1323,14 @@ class ServingEngine:
         if self.auditor is not None:
             return self.auditor.compiles("serving_decode")
         return -1
+
+    def tick_timeline(self, n: int | None = None) -> list[dict]:
+        """The bounded dispatch→harvest tick lane (most recent last):
+        per tick, its kind, dispatch/harvest stamps, how long the
+        harvest blocked on the device, and the measured host gap — the
+        tracez view of what the pipeline is (or is not) hiding."""
+        log = list(self._tick_log)
+        return log if n is None else log[-int(n):]
 
     def mesh_info(self) -> dict | None:
         """Static view of the engine's device mesh for healthz/debugz:
@@ -1363,6 +1493,14 @@ class ServingEngine:
             "pending_swap": self._pending_swap is not None,
             "decode_compile_count": self.decode_compile_count(),
             "weight_version": self.weight_version,
+            "pipeline": {
+                "depth": self.pipeline_depth,
+                "inflight": (self._inflight.kind
+                             if self._inflight is not None else None),
+                "ticks_logged": len(self._tick_log),
+                "host_gap_p50_s": self.metrics.host_gap.gap_p50,
+                "device_idle_ratio": self.metrics.host_gap.idle_ratio,
+            },
         }
         if self.mesh is not None:
             out["mesh"] = self.mesh_info()
@@ -1689,7 +1827,7 @@ class ServingEngine:
         serialize. The pin only needs to span this call — the engine
         loop serializes every pool mutation."""
         from distkeras_tpu.serving.kv_transfer import (
-            MAX_TRANSFER_BYTES,
+            MAX_TOTAL_TRANSFER_BYTES,
             KVTransferError,
             serialize_blocks,
         )
@@ -1710,11 +1848,14 @@ class ServingEngine:
                 provenance=self.weight_version)
         finally:
             self.kv_pool.release(match)
-        if len(payload) > MAX_TRANSFER_BYTES:
+        if len(payload) > MAX_TOTAL_TRANSFER_BYTES:
+            # Oversize chains split across sequenced KVBLK frames on
+            # the wire (kv_transfer.split_frames); only a chain past
+            # the TOTAL cap is refused typed.
             raise KVTransferError(
-                f"serialized blocks ({len(payload)} bytes) exceed one "
-                f"KVBLK frame ({MAX_TRANSFER_BYTES}); receiver falls "
-                f"back to monolithic prefill")
+                f"serialized blocks ({len(payload)} bytes) exceed the "
+                f"transfer cap ({MAX_TOTAL_TRANSFER_BYTES}); receiver "
+                f"falls back to monolithic prefill")
         self.metrics.record_kv_export(len(payload))
         return {"matched_tokens": n * self.kv_block_tokens, "blocks": n,
                 "bytes": len(payload), "payload": payload}
@@ -1863,9 +2004,21 @@ class ServingEngine:
                         self._finish_error(req, RequestTimeout(
                             f"deadline exceeded after {req.timeout}s in queue"))
                 # 2. Free active slots whose request died mid-decode.
-                for i, st in enumerate(self._slot_state):
+                # Teardown changes batch content — a pipeline barrier
+                # first, so no in-flight tick is reading the blocks the
+                # teardown releases. (The barrier may FINISH some of the
+                # candidates; re-check before tearing down.)
+                dead = [i for i, st in enumerate(self._slot_state)
+                        if st is not None
+                        and (st.request.cancelled
+                             or (st.request.deadline is not None
+                                 and now > st.request.deadline))]
+                if dead:
+                    await self._pipeline_barrier(loop)
+                for i in dead:
+                    st = self._slot_state[i]
                     if st is None:
-                        continue
+                        continue  # finished at the barrier
                     dl = st.request.deadline
                     if st.request.cancelled:
                         self._finish_error(st.request, RequestCancelled(
@@ -1897,6 +2050,11 @@ class ServingEngine:
                 if (self._pending_swap is not None
                         and self.active_slots == 0
                         and not self.scheduler.has_streamed()):
+                    # Zero ACTIVE slots can still mean one in-flight
+                    # tick (the speculative tick dispatched before its
+                    # rows' finishes were known): a swap waits for zero
+                    # in-flight ticks, full stop.
+                    await self._pipeline_barrier(loop)
                     params, ev, res, prov = self._pending_swap
                     self._pending_swap = None
                     if self.flight_recorder is not None:
@@ -1929,6 +2087,10 @@ class ServingEngine:
                 # cache buffers. Device work in the executor, event
                 # resolution on the loop thread.
                 if self._paged and self._pending_kv:
+                    # Barrier: the export gather / import scatter must
+                    # never interleave with a tick that is mid-flight
+                    # over the same pool rows.
+                    await self._pipeline_barrier(loop)
                     ops, self._pending_kv = self._pending_kv, []
                     for kind, arg, ev, res in ops:
                         with span("kv_transfer", kind=kind):
@@ -1946,6 +2108,24 @@ class ServingEngine:
                 # Device work runs in the executor; stream/metrics
                 # bookkeeping stays on the loop thread (asyncio queues and
                 # events are not thread-safe).
+                if (not self._stopping and len(self.scheduler)
+                        and self.free_slots
+                        and not (self._paged and self._parked_at_version
+                                 == self.kv_pool.version
+                                 and self.scheduler.peek()
+                                 is self._parked_req)):
+                    # Admission splices content into the batch (and,
+                    # paged, reserves/preempts pool blocks): barrier
+                    # first so the reserve can never race an in-flight
+                    # tick's reads, and so the admit splice lands on
+                    # harvested token state. The barrier may free MORE
+                    # slots (a finishing tick), which only helps. A
+                    # parked queue head (dry pool, nothing freed since)
+                    # admits nobody — the admission loop below breaks
+                    # on the same check — so it must NOT drain the
+                    # pipeline every iteration: that would pay the full
+                    # host gap per tick for the whole parked period.
+                    await self._pipeline_barrier(loop)
                 if not self._stopping:
                     while self.free_slots and len(self.scheduler):
                         if (self._paged and self._parked_at_version
@@ -2057,6 +2237,13 @@ class ServingEngine:
                     pending = [i for i, st in enumerate(self._slot_state)
                                if st is not None and st.prefill is not None]
                     if pending:
+                        # A completing chunk admit-splices into the
+                        # batch (and donates the token buffer): barrier
+                        # before the chunk runs. Chunked admission
+                        # phases therefore serialize with the decode
+                        # tick exactly as before — the pipeline's win is
+                        # the steady decode state between admissions.
+                        await self._pipeline_barrier(loop)
                         start = self._prefill_rr
                         i = min(pending,
                                 key=lambda s: (s - start) % self.slots)
@@ -2068,13 +2255,32 @@ class ServingEngine:
                                 loop, self._prefill_step, st, i)
                         if tok0 is not None:
                             self._finish_admission(st, i, tok0)
-                # 5. Nothing in flight?
+                # 5. Nothing active? Flush the pipeline (an in-flight
+                # tick whose every row finished leaves active == 0 with
+                # a garbage tick still pending) and wait.
                 if self.active_slots == 0:
+                    await self._pipeline_barrier(loop)
                     if self._stopping:
                         break
-                    await self.scheduler.wait_for_request(idle_poll_s)
+                    if (self._paged and self._parked_req is not None
+                            and self._parked_at_version
+                            == self.kv_pool.version
+                            and self.scheduler.peek() is self._parked_req):
+                        # Fully parked: the queue head is waiting on a
+                        # dry pool and NOTHING is running that could
+                        # free blocks — only an arrival, a cancel/kick,
+                        # or a pool-version move (a KV import kicks) can
+                        # change the picture. wait_for_request would
+                        # return immediately on the non-empty queue and
+                        # hot-spin the loop doing only the park check;
+                        # wait on the arrival event itself instead (the
+                        # timeout keeps deadline expiry responsive).
+                        await self.scheduler.wait_for_wake(idle_poll_s)
+                    else:
+                        await self.scheduler.wait_for_request(idle_poll_s)
                     continue
                 if self._stopping and not self._draining:
+                    await self._pipeline_barrier(loop)
                     for i, st in enumerate(self._slot_state):
                         if st is not None:
                             self._finish_error(st.request, EngineStopped(
@@ -2088,8 +2294,16 @@ class ServingEngine:
                 # block chains one more from the pool — preempting the
                 # lowest-priority youngest slot (possibly itself) when
                 # the pool is dry. Host bookkeeping only; the decode
-                # step itself never changes shape.
+                # step itself never changes shape. Growth mutates table
+                # rows (and may preempt = tear down): barrier first, but
+                # ONLY when some slot actually needs a block — the
+                # common tick crosses no block boundary and keeps the
+                # pipeline full.
                 if self._paged:
+                    if any(st is not None and st.prefill is None
+                           and self._needs_tail_block(i)
+                           for i, st in enumerate(self._slot_state)):
+                        await self._pipeline_barrier(loop)
                     for i in range(self.slots):
                         st = self._slot_state[i]
                         if st is not None and st.prefill is None:
@@ -2104,69 +2318,7 @@ class ServingEngine:
                 # the same batch commit their usual one token from the
                 # verify's position-0 logits. All-sampled batches (and
                 # the swap rewarm) take the one-token fallback step.
-                decodable = self._decodable()
-                if decodable:
-                    # A zero-accept row (every draft rejected last spec
-                    # tick) committed nothing; one interleaved fallback
-                    # tick guarantees it a token before speculation
-                    # resumes — re-speculating immediately would redraft
-                    # the same rejected proposal forever.
-                    spec_tick = (self._spec
-                                 and not self._spec_owe_fallback
-                                 and any(
-                                     self._slot_state[i].request.temperature
-                                     <= 0
-                                     and self._slot_state[i].request.speculate
-                                     for i in decodable))
-                    if spec_tick:
-                        if self._paged:
-                            for i in decodable:
-                                req = self._slot_state[i].request
-                                # Lookahead only for rows that will
-                                # actually speculate — a sampled or
-                                # opted-out row writes one real token
-                                # per tick and needs no window blocks.
-                                if req.temperature <= 0 and req.speculate:
-                                    self._alloc_lookahead(i)
-                        with span("spec_tick", active=self.active_slots,
-                                  k=self.spec_k):
-                            out, commit, caps = await self._in_executor(
-                                loop, self._spec_sync)
-                        self._spec_owe_fallback = any(
-                            int(commit[i]) == 0 for i in decodable
-                            if self._slot_state[i] is not None)
-                    else:
-                        with span("decode_tick", active=self.active_slots):
-                            nxt = await self._in_executor(
-                                loop, self._decode_sync)
-                        self._spec_owe_fallback = False
-                    if self._arm_after_warmup and self.auditor is not None:
-                        # First decode iteration IS the warmup: every
-                        # executable exists now (the ctor pre-compiled
-                        # the spec trio), so every later compile is a
-                        # violated invariant.
-                        self._arm_after_warmup = False
-                        self.auditor.arm(*(
-                            ("serving_decode", "serving_draft",
-                             "serving_verify") if self._spec
-                            else ("serving_decode",)))
-                    t = time.monotonic()
-                    with span("stream", active=self.active_slots):
-                        for i, st in enumerate(self._slot_state):
-                            if st is None or st.prefill is not None:
-                                # Mid-prefill rows decode garbage until
-                                # their finished cache is spliced in.
-                                continue
-                            if spec_tick:
-                                self._stream_spec(st, out[i],
-                                                  int(commit[i]),
-                                                  int(caps[i]), t)
-                            else:
-                                self._push_token(st, int(nxt[i]), t)
-                            if st.remaining == 0:
-                                self._finish_ok(st.request)
-                                self._free_slot_paged(i, st)
-                                self._slot_state[i] = None
+                await self._tick_step(loop)
                 self.metrics.sample(
                     len(self.scheduler), self.active_slots, self.slots)
                 # Yield so the server can read sockets between iterations.
@@ -2179,6 +2331,10 @@ class ServingEngine:
             # (otherwise server handlers block forever on streams nothing
             # will ever finish).
             err = ServingError(f"engine failure: {e!r}")
+            # Abandon any in-flight tick: its device buffers are
+            # dropped with the reference; nothing host-side depends on
+            # its result once every request below is errored out.
+            self._inflight = None
             for i, st in enumerate(self._slot_state):
                 if st is not None:
                     self._finish_error(st.request, err)
@@ -2213,6 +2369,173 @@ class ServingEngine:
             raise
         finally:
             self._running = False
+
+    # -- decode pipeline ----------------------------------------------------
+    async def _tick_step(self, loop) -> None:
+        """One decode (or speculative) tick, pipelined. Plain → plain is
+        the fully overlapped path: dispatch tick N+1 FIRST, then harvest
+        and stream tick N while N+1 executes — the host bookkeeping for
+        N (token pushes, teardown, metrics) plus the whole next loop
+        iteration's steps 1–4 and the event-loop turn hide behind N+1's
+        device time. A speculative tick (or ``pipeline_depth=0``)
+        harvests before the next dispatch, because the next tick's
+        position state depends on the commit counts only the harvest
+        knows."""
+        decodable = self._decodable()
+        if not decodable:
+            if self._inflight is not None:
+                # Every dispatched row disappeared (cancel barrier tore
+                # them down before the harvest): flush so the stale
+                # handles don't pin device buffers.
+                await self._pipeline_barrier(loop)
+            return
+
+        def want_spec() -> bool:
+            # A zero-accept row (every draft rejected last spec tick)
+            # committed nothing; one interleaved fallback tick
+            # guarantees it a token before speculation resumes —
+            # re-speculating immediately would redraft the same
+            # rejected proposal forever.
+            return (self._spec
+                    and not self._spec_owe_fallback
+                    and any(
+                        self._slot_state[i].request.temperature <= 0
+                        and self._slot_state[i].request.speculate
+                        for i in decodable))
+
+        spec_tick = want_spec()
+        if self._inflight is not None and (
+                spec_tick or self._inflight.kind == "spec"):
+            # Either the NEXT tick needs settled commit state (it is
+            # speculative), or the in-flight one is speculative (its
+            # commits gate every later dispatch). Harvest, then
+            # re-evaluate: the stream may have finished rows or flipped
+            # the owe-fallback state.
+            await self._pipeline_barrier(loop)
+            decodable = self._decodable()
+            if not decodable:
+                return
+            spec_tick = want_spec()
+        if spec_tick:
+            if self._paged:
+                for i in decodable:
+                    req = self._slot_state[i].request
+                    # Lookahead only for rows that will actually
+                    # speculate — a sampled or opted-out row writes one
+                    # real token per tick and needs no window blocks.
+                    # (_alloc_lookahead never preempts, so no barrier.)
+                    if req.temperature <= 0 and req.speculate:
+                        self._alloc_lookahead(i)
+            with span("spec_tick", active=self.active_slots,
+                      k=self.spec_k):
+                self._inflight = await self._dispatch(
+                    loop, self._spec_dispatch)
+        else:
+            prev, self._inflight = self._inflight, None
+            with span("decode_tick", active=self.active_slots):
+                self._inflight = await self._dispatch(
+                    loop, self._decode_dispatch)
+            if prev is not None:
+                # Tick N's harvest + stream, with N+1 already on the
+                # device: the one D2H waits for N only; everything after
+                # it overlaps N+1.
+                await self._complete_tick(loop, prev)
+        if self._arm_after_warmup and self.auditor is not None:
+            # The first dispatch IS the warmup: compilation is
+            # synchronous at the jit call (only execution is async), so
+            # every executable exists now (the ctor pre-compiled the
+            # spec trio) and every later compile is a violated
+            # invariant.
+            self._arm_after_warmup = False
+            self.auditor.arm(*(
+                ("serving_decode", "serving_draft",
+                 "serving_verify") if self._spec
+                else ("serving_decode",)))
+        if self.pipeline_depth == 0:
+            await self._pipeline_barrier(loop)
+
+    async def _dispatch(self, loop, fn) -> _InflightTick:
+        """Run one tick dispatch. The first ever goes to the executor
+        (it compiles — seconds the event loop must stay responsive
+        through); warm dispatches run inline on the loop thread, where
+        their only cost is arg prep + the async enqueue — saving the
+        executor round trip that would otherwise serialize every tick
+        behind a thread hop."""
+        if self._dispatch_warm:
+            return fn()
+        tick = await self._in_executor(loop, fn)
+        self._dispatch_warm = True
+        return tick
+
+    async def _pipeline_barrier(self, loop) -> None:
+        """Drain the pipeline: harvest, stream, and tear down the
+        in-flight tick (if any). Called before every event that mutates
+        batch shape or content — admission, chunked-prefill progress,
+        paged growth/preemption, param swap, KV transfer, cancel/expire
+        teardown, idle, shutdown — and as the depth-0 serializer."""
+        tick, self._inflight = self._inflight, None
+        if tick is not None:
+            await self._complete_tick(loop, tick)
+
+    async def _complete_tick(self, loop, tick: _InflightTick) -> None:
+        """Harvest one dispatched tick and do its host half: stream the
+        committed tokens of every row that was decodable at dispatch and
+        is still alive, then tear down rows that finished. A row whose
+        slot emptied between dispatch and harvest (a finish processed
+        while the next tick was already in flight) is dropped exactly
+        like a mid-prefill garbage row."""
+        # Readiness fast path: when the device already finished the
+        # tick (the pipelined steady state — the whole host iteration
+        # ran while it computed), the harvest is a ready-buffer memcpy
+        # and the executor round trip would cost more than the read.
+        # Only a harvest that would genuinely BLOCK takes the thread
+        # hop, keeping the event loop responsive through real waits.
+        if tick.kind == "spec":
+            if _tick_ready(tick):
+                out, commit, caps = self._harvest_spec(tick)
+            else:
+                out, commit, caps = await self._in_executor(
+                    loop, self._harvest_spec, tick)
+            self._spec_owe_fallback = any(
+                int(commit[i]) == 0 for i in tick.rows
+                if self._slot_state[i] is not None)
+        else:
+            if _tick_ready(tick):
+                nxt = self._harvest_decode(tick)
+            else:
+                nxt = await self._in_executor(
+                    loop, self._harvest_decode, tick)
+            self._spec_owe_fallback = False
+        t = time.monotonic()
+        with span("stream", active=self.active_slots):
+            for i in tick.rows:
+                st = self._slot_state[i]
+                if st is None or st.prefill is not None:
+                    # The slot emptied (or was recycled into a new
+                    # prefill) since dispatch: this tick's row output is
+                    # speculative garbage.
+                    continue
+                if tick.kind == "spec":
+                    self._stream_spec(st, out[i], int(commit[i]),
+                                      int(caps[i]), t)
+                else:
+                    self._push_token(st, int(nxt[i]), t)
+                if st.remaining == 0:
+                    if (self._paged and self._inflight is not None
+                            and i in self._inflight.advanced):
+                        # The just-dispatched tick optimistically
+                        # advanced this slot's watermark; the request is
+                        # finished, so roll the advance back BEFORE
+                        # adoption — the trie must never claim the
+                        # in-flight speculative write (its block is
+                        # freed instead, and the write lands before any
+                        # barrier-gated reuse can touch it).
+                        self._lens[i] -= 1
+                        self._inflight.advanced.discard(i)
+                        self._positions_dirty = True
+                    self._finish_ok(st.request)
+                    self._free_slot_paged(i, st)
+                    self._slot_state[i] = None
 
     # -- internals ----------------------------------------------------------
     @staticmethod
@@ -2339,6 +2662,11 @@ class ServingEngine:
         self._key, sub = jax.random.split(self._key)
         temp = jnp.float32(req.temperature)
         t0 = time.monotonic()
+        # The chunk counts in the host-gap tracker as dispatched device
+        # work: without this, admission phases would book their (device-
+        # busy) prefill time as "device idle" in the gap window between
+        # a decode harvest and the next decode dispatch.
+        hg = self.metrics.host_gap
         with span("prefill", bucket=P, offset=job.pos, prompt_len=s0):
             if self._paged:
                 self._cache, tok = self._prefill(
@@ -2349,7 +2677,10 @@ class ServingEngine:
                 job.cache, tok = self._prefill(
                     self._params, job.cache, jnp.asarray(padded),
                     jnp.int32(job.pos), jnp.int32(c), temp, sub)
+            hg.tick_dispatched()
+            hg.harvest_started()
             tok0 = int(tok)  # blocks: honest device time per chunk
+            hg.harvest_ended()
         chunk_s = time.monotonic() - t0
         job.device_s += chunk_s
         job.chunks_done += 1
@@ -2370,7 +2701,7 @@ class ServingEngine:
                     self._tokens, self._temps, jnp.int32(slot), tok, temp)
             # The slot joins the decodable set: the masked table view
             # gains its row, so the next tick must re-upload.
-            self._tables_dirty = True
+            self._mark_tables_dirty()
         else:
             # Store the complete blocks this prefill computed (future
             # requests sharing the prefix hit them), then splice the row
@@ -2411,6 +2742,14 @@ class ServingEngine:
                 if self._slot_state[i] is not None
                 and self._slot_state[i].prefill is None]
 
+    def _mark_tables_dirty(self) -> None:
+        """A table row (or the decodable set) changed: the next dispatch
+        must rebuild + re-upload both the masked device tables and the
+        device positions vector (they share the gating — every event
+        that mutates one invalidates the other's cached view)."""
+        self._tables_dirty = True
+        self._positions_dirty = True
+
     def _upload_tables(self, decodable):
         """Device view of the block tables, MASKED to the sentinel for
         rows that must not write (free slots, mid-prefill slots — their
@@ -2431,41 +2770,87 @@ class ServingEngine:
             self._tables_dirty = False
         return self._tables_dev
 
-    def _decode_sync(self) -> np.ndarray:
+    def _decode_dispatch(self) -> _InflightTick:
+        """Enqueue ONE plain decode tick (executor thread) and return
+        WITHOUT waiting for the device: JAX dispatch is asynchronous, so
+        the host is free the moment the work is queued. All host-side
+        bookkeeping that the tick's outcome does NOT depend on happens
+        here — position watermarks advance by exactly one per decodable
+        row, recorded in ``advanced`` so a teardown detected while the
+        tick is still in flight can roll its row back."""
         self._key, sub = jax.random.split(self._key)
+        rows = tuple(self._decodable())
         if self._paged:
-            decodable = self._decodable()
-            positions = np.zeros((self.slots,), np.int32)
-            for i in decodable:
-                positions[i] = self._lens[i]
-            tables_dev = self._upload_tables(decodable)
-            self._cache, self._tokens = self._decode_step(
-                self._params, self._cache, self._tokens, self._temps,
-                jnp.asarray(positions), tables_dev, sub)
-            # Each decodable row appended exactly one K/V vector.
-            for i in decodable:
+            tables_dev = self._upload_tables(rows)
+            if self._positions_dirty or self._positions_dev is None:
+                positions = np.zeros((self.slots,), np.int32)
+                for i in rows:
+                    positions[i] = self._lens[i]
+                # Sharded: commit the rebuilt vector to the replicated
+                # layout the decode step's out_shardings pins — jit
+                # cache entries key on actual argument shardings, so an
+                # uncommitted host upload here would occupy a DIFFERENT
+                # executable than the steady-state ticks that re-feed
+                # the committed jit output (same reason the ctor
+                # commits tokens/temps).
+                if self.mesh is not None:
+                    self._positions_dev = jax.device_put(
+                        np.asarray(positions), self._replicated)
+                else:
+                    self._positions_dev = jnp.asarray(positions)
+                self._positions_dirty = False
+            self._cache, self._tokens, self._positions_dev = (
+                self._decode_step(
+                    self._params, self._cache, self._tokens, self._temps,
+                    self._positions_dev, tables_dev, sub))
+            # Each decodable row appends exactly one K/V vector (the
+            # device advances its own positions copy identically).
+            for i in rows:
                 self._lens[i] += 1
         else:
             self._cache, self._tokens = self._decode_step(
                 self._params, self._cache, self._tokens, self._temps, sub)
             if self._spec:
-                for i in self._decodable():
+                for i in rows:
                     self._spec_pos[i] += 1
-        return np.asarray(self._tokens)
+        t = self.metrics.host_gap.tick_dispatched()
+        return _InflightTick(kind="decode", rows=rows, t_dispatch=t,
+                             tokens=self._tokens, advanced=set(rows))
+
+    def _harvest_decode(self, tick: _InflightTick) -> np.ndarray:
+        """The one D2H per plain tick (executor thread): blocks until
+        the device finishes the tick, then hands its token vector to
+        the loop thread for streaming."""
+        hg = self.metrics.host_gap
+        hg.harvest_started()
+        nxt = np.asarray(tick.tokens)
+        t = hg.harvest_ended()
+        self._tick_log.append({
+            "kind": tick.kind, "rows": len(tick.rows),
+            "t_dispatch": tick.t_dispatch, "t_harvest": t,
+            "harvest_wait_s": round(hg.last_harvest_wait, 9),
+            "host_gap_s": round(hg.last_gap, 9),
+        })
+        return nxt
+
+    def _decode_sync(self) -> np.ndarray:
+        """Serialized dispatch + harvest: the ``pipeline_depth=0`` tick
+        and the ctor-warmup / swap-rewarm path (both must complete on
+        the spot — a rewarm's whole job is proving the step ran)."""
+        return self._harvest_decode(self._decode_dispatch())
 
     # -- speculative decoding (draft/verify) --------------------------------
-    def _spec_sync(self):
-        """One speculative tick (executor thread; device work only):
-        fixed-K greedy draft scan, ONE batched K-position verify, masked
-        accept. Returns ``(out, commit, caps)`` — ``out[i, :commit[i]]``
-        are slot ``i``'s committed tokens this tick (0..K for live
-        greedy rows — 0 means every draft was rejected and the run loop
-        owes the batch a fallback tick — exactly 1 for temperature>0
-        rows riding the same batch, 0 for garbage rows) and ``caps[i]``
-        is the draft budget the row REALLY had (spec_k, minus paged
-        allocation pressure) for honest accept accounting. All shapes
-        are static in ``spec_k``, so the armed compile-count==1
-        contract holds per callable no matter how acceptance varies."""
+    def _spec_dispatch(self) -> _InflightTick:
+        """Enqueue one speculative tick (executor thread; device work
+        only): fixed-K greedy draft scan, ONE batched K-position verify,
+        masked accept — returned as an :class:`_InflightTick` whose
+        harvest reads ``out``/``commit`` off the device. Unlike a plain
+        tick, NO position bookkeeping advances here: the advance is the
+        commit count, which only the harvest knows — which is also why
+        the run loop never dispatches past an unharvested spec tick
+        (the next tick's positions depend on it). All shapes are static
+        in ``spec_k``, so the armed compile-count==1 contract holds per
+        callable no matter how acceptance varies."""
         self._key, sub = jax.random.split(self._key)
         decodable = self._decodable()
         spec_ok = np.zeros((self.slots,), bool)
@@ -2515,14 +2900,49 @@ class ServingEngine:
                 self._params, self._cache, self._tokens, drafts,
                 self._temps, jnp.asarray(spec_ok), jnp.asarray(remaining),
                 start, sub)
-        out = np.asarray(out)
-        commit = np.asarray(commit)
-        for i in decodable:
+        t = self.metrics.host_gap.tick_dispatched()
+        return _InflightTick(kind="spec", rows=tuple(decodable),
+                             t_dispatch=t, out=out, commit=commit,
+                             caps=caps)
+
+    def _harvest_spec(self, tick: _InflightTick):
+        """Spec-tick harvest (executor thread): the one D2H reads the
+        committed-token matrix and commit counts, then the position
+        watermarks advance by each row's ACTUAL commit — the part a
+        plain tick can do at dispatch and a spec tick cannot."""
+        hg = self.metrics.host_gap
+        hg.harvest_started()
+        out = np.asarray(tick.out)
+        commit = np.asarray(tick.commit)
+        t = hg.harvest_ended()
+        for i in tick.rows:
             if self._paged:
                 self._lens[i] += int(commit[i])
             else:
                 self._spec_pos[i] += int(commit[i])
-        return out, commit, caps
+        if self._paged:
+            # Commits are variable-width: the cached device positions
+            # no longer match _lens.
+            self._positions_dirty = True
+        self._tick_log.append({
+            "kind": tick.kind, "rows": len(tick.rows),
+            "t_dispatch": tick.t_dispatch, "t_harvest": t,
+            "harvest_wait_s": round(hg.last_harvest_wait, 9),
+            "host_gap_s": round(hg.last_gap, 9),
+        })
+        return out, commit, tick.caps
+
+    def _spec_sync(self):
+        """Serialized spec tick (ctor warmup / depth 0): dispatch and
+        harvest on the spot. Returns ``(out, commit, caps)`` —
+        ``out[i, :commit[i]]`` are slot ``i``'s committed tokens this
+        tick (0..K for live greedy rows — 0 means every draft was
+        rejected and the run loop owes the batch a fallback tick —
+        exactly 1 for temperature>0 rows riding the same batch, 0 for
+        garbage rows) and ``caps[i]`` is the draft budget the row
+        REALLY had (spec_k, minus paged allocation pressure) for honest
+        accept accounting."""
+        return self._harvest_spec(self._spec_dispatch())
 
     def _spec_room(self, i: int) -> int:
         """Contiguous allocated K/V positions from slot ``i``'s write
@@ -2561,7 +2981,7 @@ class ServingEngine:
                 return
             self._tables[i, blk] = ids[0]
             st.blocks.extend(ids)
-            self._tables_dirty = True
+            self._mark_tables_dirty()
 
     def _draft_prefill_slot(self, slot: int, tokens) -> None:
         """Build the draft's prompt K/V for a freshly admitted slot
@@ -2642,12 +3062,25 @@ class ServingEngine:
         row[:] = self._sentinel
         row[:first_block] = match.ids
         row[first_block:first_block + needed] = ids
-        self._tables_dirty = True
+        self._mark_tables_dirty()
         self._lens[slot] = m
         if req.trace is not None and m:
             req.trace.event("prefix_splice", tokens=m, blocks=first_block)
         job = _PrefillJob(cache=None, pos=m, match=None, matched_tokens=m)
         return job, ids, first_block, match
+
+    def _needs_tail_block(self, i: int) -> bool:
+        """True when slot ``i``'s next write position crosses into an
+        unallocated block. A row whose ``_lens`` already reached the
+        table's capacity needs nothing: that is a finishing row's
+        optimistic depth-1 advance (``submit`` caps prompt + max_new at
+        the context limit, so the limit is only reached on a row's
+        final tick) — its in-flight write landed in the LAST block and
+        the pending harvest tears it down; indexing the table one past
+        the end for it would be an engine-killing IndexError."""
+        blk = int(self._lens[i]) // self.kv_block_tokens
+        return (blk < self._table_blocks
+                and self._tables[i, blk] == self._sentinel)
 
     def _ensure_tail_block(self, i: int) -> bool:
         """Pre-tick growth: make sure slot ``i``'s next write position
@@ -2656,9 +3089,9 @@ class ServingEngine:
         ``i`` itself was the fairest victim (it is gone; the tick runs
         without it)."""
         st = self._slot_state[i]
-        blk = int(self._lens[i]) // self.kv_block_tokens
-        if self._tables[i, blk] != self._sentinel:
+        if not self._needs_tail_block(i):
             return True
+        blk = int(self._lens[i]) // self.kv_block_tokens
         ids = self.kv_pool.alloc(1)
         while ids is None:
             victims = [(j, s) for j, s in enumerate(self._slot_state)
@@ -2671,7 +3104,7 @@ class ServingEngine:
             ids = self.kv_pool.alloc(1)
         self._tables[i, blk] = ids[0]
         st.blocks.extend(ids)
-        self._tables_dirty = True
+        self._mark_tables_dirty()
         return True
 
     def _preempt_slot(self, i: int) -> None:
@@ -2692,7 +3125,7 @@ class ServingEngine:
         st.match = None
         st.prefill = None
         self._tables[i, :] = self._sentinel
-        self._tables_dirty = True
+        self._mark_tables_dirty()
         self._lens[i] = 0
         self._slot_state[i] = None
         self.metrics.record_preemption()
@@ -2726,7 +3159,7 @@ class ServingEngine:
         st.blocks = []
         st.match = None
         self._tables[i, :] = self._sentinel
-        self._tables_dirty = True
+        self._mark_tables_dirty()
         self._lens[i] = 0
 
     def _stream_spec(self, st: _SlotState, row_out, commit: int,

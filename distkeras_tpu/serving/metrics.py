@@ -37,7 +37,7 @@ from distkeras_tpu.telemetry.registry import (
 )
 from distkeras_tpu.tracing import MetricStream
 
-__all__ = ["ServingMetrics", "percentile"]
+__all__ = ["HostGapTracker", "ServingMetrics", "percentile"]
 
 # Decode ticks and inter-token gaps sit well under the default buckets'
 # upper range; keep a finer low end for them.
@@ -52,6 +52,110 @@ def percentile(values: Iterable[float], q: float) -> float:
     :func:`distkeras_tpu.telemetry.registry.percentile` — kept as a
     re-export because serving callers historically imported it here."""
     return _percentile(values, q)
+
+
+class HostGapTracker:
+    """Per-tick device-idle accounting for the decode pipeline.
+
+    Three observable instants exist per tick: **dispatch** (the jit call
+    returned — work is queued on the device), **harvest start** (the
+    host began the tick's one D2H read) and **harvest end** (the read
+    returned — the device has certainly finished the tick). From those:
+
+    - ``host gap``: how long the device queue sat EMPTY before a
+      dispatch. It is measurable exactly when the previous tick was
+      already harvested (the queue has been empty since at latest that
+      harvest's end): ``gap = max(0, t_dispatch - t_prev_harvest_end)``.
+      When a tick is still in flight at dispatch time (the pipelined
+      steady state) the queue was never empty and the gap is 0 by
+      construction. At ``pipeline_depth=0`` — harvest immediately after
+      every dispatch — the gap is the full serialized host window: every
+      microsecond of streaming/admission/socket work the device waited
+      through. (When the harvest returned instantly the device had
+      finished somewhere before harvest start, so the measured gap is a
+      slight *under*-estimate; it can never over-report idleness.)
+    - ``device idle ratio``: windowed ``sum(gaps) / sum(dispatch
+      intervals)`` — the fraction of wall time between ticks the device
+      was provably idle. The pipelined engine drives this toward 0.
+
+    ``clock`` is injectable (a fake clock makes the accounting exactly
+    testable); the optional histogram/gauge mirror the window into the
+    registry (``serving_host_gap_seconds`` /
+    ``serving_device_idle_ratio``)."""
+
+    def __init__(self, histogram=None, idle_gauge=None,
+                 clock=time.monotonic, window: int = 4096):
+        self._clock = clock
+        self._hist = histogram
+        self._gauge = idle_gauge
+        self._pending = 0           # dispatched, not yet harvested
+        self._last_dispatch: float | None = None
+        self._last_harvest_end: float | None = None
+        self._harvest_start: float | None = None
+        self.last_gap = 0.0
+        self.last_harvest_wait = 0.0
+        self.gaps = collections.deque(maxlen=window)
+        self.intervals = collections.deque(maxlen=window)
+
+    def tick_dispatched(self, t: float | None = None) -> float:
+        t = self._clock() if t is None else t
+        if self._pending == 0 and self._last_harvest_end is not None:
+            gap = max(0.0, t - self._last_harvest_end)
+        else:
+            # Either the first tick ever, or a tick was still in
+            # flight: the device queue was never observed empty.
+            gap = 0.0
+        self.last_gap = gap
+        self.gaps.append(gap)
+        if self._hist is not None:
+            self._hist.observe(gap)
+        if self._last_dispatch is not None:
+            self.intervals.append(max(0.0, t - self._last_dispatch))
+        self._last_dispatch = t
+        self._pending += 1
+        return t
+
+    def harvest_started(self, t: float | None = None) -> float:
+        self._harvest_start = self._clock() if t is None else t
+        return self._harvest_start
+
+    def harvest_ended(self, t: float | None = None) -> float:
+        t = self._clock() if t is None else t
+        self._pending = max(0, self._pending - 1)
+        self._last_harvest_end = t
+        self.last_harvest_wait = (max(0.0, t - self._harvest_start)
+                                  if self._harvest_start is not None
+                                  else 0.0)
+        self._harvest_start = None
+        if self._gauge is not None:
+            self._gauge.set(self.idle_ratio or 0.0)
+        return t
+
+    @property
+    def idle_ratio(self) -> float | None:
+        """Windowed device-idle fraction; None until two ticks ran."""
+        total = sum(self.intervals)
+        if total <= 0:
+            return None
+        # gaps has one more entry than intervals (the first dispatch
+        # has no interval); drop the first gap for a matched window.
+        gaps = list(self.gaps)[-len(self.intervals):]
+        return min(1.0, sum(gaps) / total)
+
+    @property
+    def gap_p50(self) -> float | None:
+        return percentile(self.gaps, 50) if self.gaps else None
+
+    def summary(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        if self.gaps:
+            out["host_gap_p50_s"] = percentile(self.gaps, 50)
+            out["host_gap_p99_s"] = percentile(self.gaps, 99)
+            out["host_gap_mean_s"] = sum(self.gaps) / len(self.gaps)
+        ratio = self.idle_ratio
+        if ratio is not None:
+            out["device_idle_ratio"] = ratio
+        return out
 
 
 class ServingMetrics:
@@ -126,6 +230,21 @@ class ServingMetrics:
                 "serving_request_latency_seconds",
                 help="submit-to-done latency", buckets=_LATENCY_BUCKETS),
         }
+        # Decode-pipeline accounting: the per-tick host gap (device
+        # provably idle before a dispatch) and the windowed device-idle
+        # fraction — what the overlapped pipeline exists to drive to 0.
+        self.host_gap = HostGapTracker(
+            histogram=reg.histogram(
+                "serving_host_gap_seconds",
+                help="host-side gap the device sat idle before a decode "
+                     "tick dispatch (pipeline_depth=0 pays this every "
+                     "tick; depth 1 hides it behind the in-flight tick)",
+                buckets=_LATENCY_BUCKETS),
+            idle_gauge=reg.gauge(
+                "serving_device_idle_ratio",
+                help="windowed fraction of inter-tick wall time the "
+                     "device was provably idle (host gap / dispatch "
+                     "interval)"))
         # Speculative decoding: proposed vs committed draft tokens (the
         # ratio is the accept rate — THE health signal for a draft
         # model: it falling means the draft stopped predicting the
@@ -499,6 +618,7 @@ class ServingMetrics:
                 out[f"{name}_p95_s"] = percentile(xs, 95)
                 out[f"{name}_p99_s"] = percentile(xs, 99)
                 out[f"{name}_mean_s"] = sum(xs) / len(xs)
+        out.update(self.host_gap.summary())
         if self.prefill_chunks:
             out["prefill_chunks_mean"] = (
                 sum(self.prefill_chunks) / len(self.prefill_chunks))

@@ -799,6 +799,23 @@ class Scheduler:
         except asyncio.TimeoutError:
             return False
 
+    async def wait_for_wake(self, timeout: float | None = None) -> bool:
+        """Like :meth:`wait_for_request` but WITHOUT the non-empty-queue
+        shortcut: block until the next submit/kick (or timeout) even
+        while requests are queued. The engine's fully-parked idle state
+        (paged pool dry, queue head parked, zero active slots) waits
+        here — ``wait_for_request`` would return immediately on the
+        non-empty queue and the loop would hot-spin doing nothing but
+        the park check. The clear-then-wait is race-free on one event
+        loop: submits happen on the same loop, and no await separates
+        the caller's park check from this clear."""
+        self._arrival.clear()
+        try:
+            await asyncio.wait_for(self._arrival.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
     def kick(self) -> None:
         """Wake any waiter (e.g. so the engine loop notices shutdown)."""
         self._arrival.set()

@@ -316,6 +316,7 @@ class ServingServer:
         ctrls: set[asyncio.Task] = set()
         kv_wait: set[int] = set()       # sids whose REQ is pulling KV
         kv_cancelled: set[int] = set()  # cancels that raced a pull
+        kv_joiners: dict[int, object] = {}  # per-stream chunk reassembly
         try:
             while True:
                 data = await reader.read(2 ** 18)
@@ -362,10 +363,29 @@ class ServingServer:
                         ctrl.add_done_callback(ctrls.discard)
                     elif ftype == wire.T_KVBLK:
                         # A pushed KV block chain: adopting it IS the
-                        # kv_import verb. As a task — the adopt waits
-                        # for the engine loop's next iteration.
+                        # kv_import verb. Multi-frame chains reassemble
+                        # through a per-stream FrameJoiner (a bare KVX1
+                        # payload passes straight through); the adopt
+                        # runs as a task — it waits for the engine
+                        # loop's next iteration.
+                        from distkeras_tpu.serving.kv_transfer import (
+                            FrameJoiner,
+                            KVTransferError,
+                        )
+
+                        try:
+                            whole = kv_joiners.setdefault(
+                                sid, FrameJoiner()).feed(payload)
+                        except KVTransferError as e:
+                            kv_joiners.pop(sid, None)
+                            sink.send_error(sid, {
+                                "error": str(e), "code": e.code})
+                            continue
+                        if whole is None:
+                            continue  # more chunk frames owed
+                        kv_joiners.pop(sid, None)
                         ctrl = asyncio.get_running_loop().create_task(
-                            self._kv_import_frame(sid, payload, sink))
+                            self._kv_import_frame(sid, whole, sink))
                         ctrls.add(ctrl)
                         ctrl.add_done_callback(ctrls.discard)
                     else:
@@ -470,7 +490,21 @@ class ServingServer:
             rep = await self._kv_export_verb(spec)
             blob = rep.pop("payload", None)
             if blob:
-                sink.send_raw(wire.T_KVBLK, sid, blob)
+                from distkeras_tpu.serving.kv_transfer import (
+                    KVTransferError,
+                    split_frames,
+                )
+
+                try:
+                    # A chain past one frame ships as sequenced KVXC
+                    # chunk frames with a terminal marker; a
+                    # single-frame chain stays byte-identical to the
+                    # pre-chunking wire.
+                    for fp in split_frames(blob):
+                        sink.send_raw(wire.T_KVBLK, sid, fp)
+                except KVTransferError as e:
+                    sink.send_json(wire.T_CTRLR, sid,
+                                   {"error": str(e), "code": e.code})
             else:
                 sink.send_json(wire.T_CTRLR, sid, rep)
             return
@@ -601,6 +635,14 @@ class ServingServer:
                 # counters — the "is one tenant starving the fleet"
                 # page (refreshes the labeled tenant gauges too).
                 "tenants": engine.tenant_snapshot(),
+                # Decode-pipeline vitals: configured depth + the
+                # windowed host-gap view (what depth 1 is hiding).
+                "pipeline": {
+                    "depth": engine.pipeline_depth,
+                    "host_gap_p50_s": engine.metrics.host_gap.gap_p50,
+                    "device_idle_ratio":
+                        engine.metrics.host_gap.idle_ratio,
+                },
             }
             mesh = engine.mesh_info()
             if mesh is not None:
@@ -650,7 +692,12 @@ class ServingServer:
         except (TypeError, ValueError):
             return {"error": f"bad n {spec.get('n')!r}",
                     "code": "bad_request"}
-        return {"tracez": {"recent": store.recent(n), **store.stats()}}
+        return {"tracez": {"recent": store.recent(n),
+                           # The engine's dispatch->harvest tick lane:
+                           # the per-tick view of what the decode
+                           # pipeline hides (and what it does not).
+                           "ticks": self.engine.tick_timeline(n),
+                           **store.stats()}}
 
     async def _kv_prefill(self, spec: dict) -> dict:
         """``{"cmd": "kv_prefill", "prompt": [...]}``: the PREFILL

@@ -73,7 +73,13 @@ def load_history(path: str) -> dict:
 # they keep higher-is-better.
 _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     "queue_wait", "latency", "staleness",
-                    "deploy_latency", "fallback")
+                    "deploy_latency", "fallback",
+                    # Decode-pipeline rows (serving/pipeline_*): the
+                    # host gap is the device-idle window the pipeline
+                    # hides — it regresses UP, while the A/B's goodput
+                    # and speedup_x regress DOWN (higher-is-better by
+                    # default).
+                    "host_gap", "device_idle")
 
 
 def lower_is_better(key: str) -> bool:

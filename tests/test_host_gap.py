@@ -1,0 +1,141 @@
+"""Host-gap accounting on a fake clock: HostGapTracker's arithmetic is
+deterministic given scripted dispatch/harvest instants, so every number
+the serving_host_gap_seconds family reports is asserted exactly here —
+including the one subtlety that makes the metric honest at depth 1: a
+dispatch issued while a tick is still in flight records a 0 gap (the
+device queue was never observed empty), never a bogus positive one.
+"""
+
+import pytest
+
+np = pytest.importorskip("numpy")  # noqa: F401  (parity with suite style)
+
+from distkeras_tpu.serving.metrics import (  # noqa: E402
+    HostGapTracker,
+    ServingMetrics,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_depth0_sequence_measures_full_host_gap():
+    """Serialized dispatch→harvest→(host work)→dispatch: the gap is
+    exactly the host window between harvest end and the next dispatch."""
+    clk = FakeClock()
+    hg = HostGapTracker(clock=clk)
+    # tick 0: dispatch at t=0, harvest 0.00..0.10 (device time 100ms)
+    clk.t = 0.0
+    hg.tick_dispatched()
+    clk.t = 0.001
+    hg.harvest_started()
+    clk.t = 0.101
+    hg.harvest_ended()
+    assert hg.last_harvest_wait == pytest.approx(0.1)
+    # host does 40ms of bookkeeping, then dispatches tick 1
+    clk.t = 0.141
+    hg.tick_dispatched()
+    assert hg.last_gap == pytest.approx(0.04)
+    clk.t = 0.142
+    hg.harvest_started()
+    clk.t = 0.241
+    hg.harvest_ended()
+    # gaps: [0.0 (first tick), 0.04]; intervals: [0.141]
+    assert list(hg.gaps) == [0.0, pytest.approx(0.04)]
+    assert hg.idle_ratio == pytest.approx(0.04 / 0.141)
+
+
+def test_depth1_pipelined_dispatch_records_zero_gap():
+    """Dispatch-before-harvest: at dispatch time a tick is still
+    pending, so the device queue was never empty — gap must be 0 no
+    matter what the clock says."""
+    clk = FakeClock()
+    hg = HostGapTracker(clock=clk)
+    clk.t = 0.0
+    hg.tick_dispatched()        # tick 0
+    clk.t = 0.05
+    hg.tick_dispatched()        # tick 1, tick 0 still in flight
+    assert hg.last_gap == 0.0
+    clk.t = 0.06
+    hg.harvest_started()
+    clk.t = 0.10
+    hg.harvest_ended()          # tick 0 harvested
+    clk.t = 0.11
+    hg.tick_dispatched()        # tick 2 — but tick 1 still pending
+    assert hg.last_gap == 0.0   # queue still never observed empty
+    clk.t = 0.12
+    hg.harvest_started()
+    clk.t = 0.13
+    hg.harvest_ended()          # tick 1
+    clk.t = 0.14
+    hg.harvest_started()
+    clk.t = 0.20
+    hg.harvest_ended()          # tick 2; pipe empty now
+    clk.t = 0.23
+    hg.tick_dispatched()        # tick 3, after a real 30ms idle window
+    assert hg.last_gap == pytest.approx(0.03)
+    assert list(hg.gaps) == [0.0, 0.0, 0.0, pytest.approx(0.03)]
+
+
+def test_idle_ratio_window_alignment_and_clamp():
+    """idle_ratio divides the matched window (gaps beyond the first
+    dispatch) by the dispatch intervals and clamps at 1.0."""
+    clk = FakeClock()
+    hg = HostGapTracker(clock=clk)
+    assert hg.idle_ratio is None  # no intervals yet
+    for t_d, t_h in ((0.0, 0.1), (1.0, 1.1), (2.0, 2.1)):
+        clk.t = t_d
+        hg.tick_dispatched()
+        clk.t = t_h - 0.09
+        hg.harvest_started()
+        clk.t = t_h
+        hg.harvest_ended()
+    # gaps: [0, 0.9, 0.9]; intervals: [1.0, 1.0] -> matched gaps [.9,.9]
+    assert hg.idle_ratio == pytest.approx(0.9)
+    s = hg.summary()
+    assert s["device_idle_ratio"] == pytest.approx(0.9)
+    assert s["host_gap_p99_s"] == pytest.approx(0.9)
+
+
+def test_tracker_publishes_histogram_and_gauge():
+    """The registry mirror: gap observations land in
+    serving_host_gap_seconds, the windowed ratio in
+    serving_device_idle_ratio."""
+    clk = FakeClock()
+    m = ServingMetrics()
+    m.host_gap = HostGapTracker(
+        histogram=m.registry.histogram("serving_host_gap_seconds"),
+        idle_gauge=m.registry.gauge("serving_device_idle_ratio"),
+        clock=clk)
+    hg = m.host_gap
+    clk.t = 0.0
+    hg.tick_dispatched()
+    clk.t = 0.01
+    hg.harvest_started()
+    clk.t = 0.02
+    hg.harvest_ended()
+    clk.t = 0.07
+    hg.tick_dispatched()
+    clk.t = 0.08
+    hg.harvest_started()
+    clk.t = 0.09
+    hg.harvest_ended()
+    snap = m.registry.snapshot()
+    hist = snap["serving_host_gap_seconds"]
+    assert hist["count"] == 2
+    gauge = snap["serving_device_idle_ratio"]
+    assert gauge["value"] == pytest.approx(0.05 / 0.07)
+    s = m.summary()
+    assert s["host_gap_p50_s"] >= 0.0
+    assert s["device_idle_ratio"] == pytest.approx(0.05 / 0.07)
+
+
+def test_summary_absent_before_any_tick():
+    hg = HostGapTracker(clock=FakeClock())
+    assert hg.summary() == {}
+    assert hg.gap_p50 is None
