@@ -182,7 +182,8 @@ def _draft(args, model, variables):
 
 def _make_engine(args, model, variables, metrics=None, trace_store=None,
                  slots=None, tenant_quotas=None, tenant_weights=None,
-                 quota_burst_s=2.0, pipeline_depth=None, arm=False):
+                 quota_burst_s=2.0, pipeline_depth=None, arm=False,
+                 kv_host_tier_mb=0.0):
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
     paged = args.paged or args.kv_pool_mb > 0
@@ -213,6 +214,7 @@ def _make_engine(args, model, variables, metrics=None, trace_store=None,
         spec_k=args.spec_k, mesh=mesh,
         pipeline_depth=(args.pipeline_depth if pipeline_depth is None
                         else pipeline_depth),
+        kv_host_tier_mb=kv_host_tier_mb,
         auditor=auditor, arm_auditor_after_warmup=auditor is not None,
         trace_store=trace_store,
         tenant_quotas=tenant_quotas, tenant_weights=tenant_weights,
@@ -920,6 +922,228 @@ def _record_pipeline_history(args, report):
     bench.write_history(path, hist)
 
 
+async def _kv_tier_ab(args, model, variables, report):
+    """Pool-only vs tiered A/B on an OVERSUBSCRIBED shared-prefix
+    workload: the prefix working set is laid out at ``--kv-tier-oversub``
+    times the pool's byte budget (so the pool alone MUST evict every
+    family before its revisit), revisited round-robin for
+    ``--kv-tier-rounds`` rounds. One fresh armed engine per side with the
+    SAME pool config — the only delta is ``--kv-host-tier-mb`` of host
+    tier. The tiered win is the re-admit: an evicted family's blocks come
+    back over PCIe instead of a recompute prefill, so prefix hit rate AND
+    p99 TTFT must both beat the pool-only side, with greedy output
+    token-identical between the two."""
+    from distkeras_tpu.serving import ServingMetrics
+
+    # Size the workload off the real pool: one probe engine (never run —
+    # nothing compiles) tells us blocks-per-prompt and pool capacity.
+    probe = _make_engine(args, model, variables)
+    pst = probe.kv_pool.stats()
+    del probe
+    bt = pst["block_tokens"]
+    cap_blocks = pst["capacity_blocks"]
+    plen = args.prompt_len or max(args.seq_len - args.new_tokens - 1, bt)
+    plen = min(plen, args.seq_len - args.new_tokens)
+    blocks_per_prompt = max(plen // bt, 1)
+    families = max(
+        -(-int(args.kv_tier_oversub * cap_blocks) // blocks_per_prompt), 2)
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(0, args.vocab, size=plen).tolist()
+               for _ in range(families)]
+    schedule = prompts * args.kv_tier_rounds
+    # Warmup = TWO full rounds of the real schedule: round one overflows
+    # the pool (every spill-gather bucket compiles), round two revisits
+    # (every re-admit scatter bucket compiles) — so the measured window
+    # sees only steady-state executions. Both sides get the same warmup;
+    # the pool-only engine just recomputes through it.
+    warm = prompts * 2
+
+    out: dict = {
+        "block_tokens": bt, "capacity_blocks": cap_blocks,
+        "families": families, "rounds": args.kv_tier_rounds,
+        "working_set_x_pool": round(
+            families * blocks_per_prompt / cap_blocks, 2),
+    }
+    all_results = []
+    side_tokens: dict[str, dict] = {}
+    for side, tier_mb in (("pool_only", 0.0),
+                          ("tiered", args.kv_host_tier_mb)):
+        engine = _make_engine(args, model, variables, arm=True,
+                              kv_host_tier_mb=tier_mb)
+        # Warmup: pay the prefill-bucket + decode compiles (and the
+        # tiered side's gather/scatter staging) outside the measured
+        # window, then measure on fresh metrics.
+        task = asyncio.create_task(engine.run())
+        await _closed_loop(engine, warm, args)
+        engine.shutdown(drain=True)
+        await task
+        engine.reopen()
+        engine.metrics = ServingMetrics()
+        task = asyncio.create_task(engine.run())
+        t0 = time.monotonic()
+        results = await _closed_loop(engine, list(schedule), args)
+        elapsed = time.monotonic() - t0
+        engine.shutdown(drain=True)
+        await task
+        summary = engine.metrics.summary()
+        compiles = engine.decode_compile_count()
+        assert compiles in (1, -1), (
+            f"kv-tier {side} side retraced the decode step: "
+            f"{compiles} executables")
+        done_tokens = sum(len(t) for _, t in results)
+        out[side] = {
+            "completed": len(results),
+            "wall_s": round(elapsed, 3),
+            "goodput_tokens_per_sec": round(done_tokens / elapsed, 2),
+            "ttft_p99_s": round(summary.get("ttft_p99_s", 0.0), 6),
+            "prefix_hit_rate": round(
+                summary.get("prefix_hit_rate", 0.0), 4),
+            "kv_spills": int(summary.get("kv_spills", 0)),
+            "kv_spill_bytes": int(summary.get("kv_spill_bytes", 0)),
+            "kv_readmits": int(summary.get("kv_readmits", 0)),
+            "kv_readmit_bytes": int(summary.get("kv_readmit_bytes", 0)),
+            "decode_compile_count": compiles,
+        }
+        for k in ("kv_spill_latency_p99_s", "kv_readmit_latency_p99_s"):
+            if k in summary:
+                out[side][k] = round(summary[k], 6)
+        if tier_mb:
+            out[side]["tier"] = engine.kv_tier.stats()
+        bucket: dict = {}
+        for p, toks in results:
+            bucket.setdefault(tuple(p), toks)
+        side_tokens[side] = bucket
+        all_results.extend(results)
+    # Same prompts, same greedy decode: the tier must be invisible in
+    # the tokens — a re-admitted block that decodes differently is a
+    # corrupted spill, not a cache win.
+    mismatches = sum(
+        1 for key, toks in side_tokens["pool_only"].items()
+        if side_tokens["tiered"].get(key) != toks)
+    out["tier_parity_mismatches"] = mismatches
+    assert mismatches == 0, (
+        f"{mismatches} prompts streamed different tokens with the host "
+        f"tier enabled")
+    t_pool = out["pool_only"]["ttft_p99_s"]
+    t_tier = out["tiered"]["ttft_p99_s"]
+    if t_tier > 0:
+        out["ttft_p99_speedup_x"] = round(t_pool / t_tier, 3)
+    out["hit_rate_gain"] = round(
+        out["tiered"]["prefix_hit_rate"]
+        - out["pool_only"]["prefix_hit_rate"], 4)
+    report["kv_tier_ab"] = out
+    return all_results
+
+
+async def _kv_tier_push_phase(args, report):
+    """Push-vs-pull migration bytes, jax-free: the SAME revisited-family
+    workload through a 1 prefill + 1 decode Echo fleet twice — adopt-time
+    pulls (every dispatch re-pulls the family's chain), then router push
+    scheduling (one push per family; revisits hit the fleet cache
+    directory and move nothing). The delta is the bytes the directory
+    saves the fabric."""
+    from distkeras_tpu.serving import ServingClient, ServingCluster
+    from distkeras_tpu.serving.cluster.replicas import EchoReplica
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    bt = args.kv_block
+    rng = np.random.default_rng(args.seed + 17)
+    prompts = [rng.integers(0, args.vocab, size=2 * bt).tolist()
+               for _ in range(4)]
+    schedule = prompts * 3
+    out: dict = {}
+    for mode, push in (("pull", False), ("push", True)):
+        registry = MetricsRegistry()
+        cluster = ServingCluster(
+            lambda i: EchoReplica(kv_block_tokens=bt), 2,
+            roles=["prefill", "decode"], registry=registry,
+            router_kwargs={"affinity_tokens": bt,
+                           "min_handoff_tokens": bt, "kv_push": push})
+        pulled = 0
+        async with cluster:
+            async with ServingClient("127.0.0.1", cluster.port,
+                                     wire_mode="auto") as c:
+                for p in schedule:
+                    done = await c.generate(p, 1)
+                    assert "error" not in done, done
+                    km = done.get("kv_migration") or {}
+                    pulled += int(km.get("bytes") or 0)
+            # Pushes are scheduled off the dispatch path — drain them
+            # before reading the counters.
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while (cluster.router._push_tasks
+                   and asyncio.get_running_loop().time() < deadline):
+                await asyncio.sleep(0.02)
+            snap = registry.snapshot()
+        rec = {"requests": len(schedule), "pulled_bytes": pulled}
+        if push:
+            rec.update({
+                "pushes": int(
+                    snap["router_kv_pushes_total"]["value"]),
+                "pushed_bytes": int(
+                    snap["router_kv_push_bytes_total"]["value"]),
+                "push_fallbacks": int(
+                    snap["router_kv_push_fallbacks_total"]["value"]),
+                "directory_hits": int(
+                    snap["router_kv_directory_hits_total"]["value"]),
+                "directory_bytes_saved": int(
+                    snap["router_kv_push_bytes_saved_total"]["value"]),
+            })
+        out[mode] = rec
+    moved_pull = out["pull"]["pulled_bytes"]
+    moved_push = out["push"]["pushed_bytes"] + out["push"]["pulled_bytes"]
+    out["migration_bytes_saved"] = moved_pull - moved_push
+    report["kv_tier_push_vs_pull"] = out
+
+
+def _record_kvtier_history(args, report):
+    """``serving/kvtier_*`` rows for the strict CI gate: per-side prefix
+    hit rate + p99 TTFT (the tiered side must beat pool-only on BOTH),
+    the tiered side's spill/readmit traffic and latency tails
+    (``*_latency_*`` regresses UP), and the push-vs-pull bytes the fleet
+    directory saves."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    sec = report.get("kv_tier_ab") or {}
+    push = report.get("kv_tier_push_vs_pull") or {}
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    base = (f"serving/kvtier_{args.model}/slots{args.slots}"
+            f"/clients{args.clients}")
+    rows: dict = {
+        "ttft_p99_speedup_x": sec.get("ttft_p99_speedup_x"),
+        "hit_rate_gain": sec.get("hit_rate_gain"),
+        "migration_bytes_saved": push.get("migration_bytes_saved"),
+        "directory_bytes_saved": (push.get("push") or {}).get(
+            "directory_bytes_saved"),
+    }
+    for side in ("pool_only", "tiered"):
+        d = sec.get(side) or {}
+        rows[f"{side}/prefix_hit_rate"] = d.get("prefix_hit_rate")
+        rows[f"{side}/ttft_p99_s"] = d.get("ttft_p99_s")
+        rows[f"{side}/goodput_tokens_per_sec"] = d.get(
+            "goodput_tokens_per_sec")
+    d = sec.get("tiered") or {}
+    rows["tiered/spill_bytes"] = d.get("kv_spill_bytes")
+    rows["tiered/readmit_bytes"] = d.get("kv_readmit_bytes")
+    rows["tiered/spill_latency_p99_s"] = d.get("kv_spill_latency_p99_s")
+    rows["tiered/readmit_latency_p99_s"] = d.get(
+        "kv_readmit_latency_p99_s")
+    for metric, v in rows.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            key = f"{base}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
 def _record_history(args, report):
     """Append this run's headline numbers to ``bench_history.json`` under
     ``serving/...`` keys, via ``bench.py``'s shared ``history_entry`` /
@@ -1230,6 +1454,31 @@ def main():
                     help="--pipeline-ab: assert depth-1 goodput is at "
                          "least this factor of depth-0 (acceptance: "
                          "strictly above 1.0); 0 = report only")
+    ap.add_argument("--kv-tier", action="store_true",
+                    help="tiered-KV A/B: run an oversubscribed "
+                         "shared-prefix workload (working set "
+                         "--kv-tier-oversub x the pool bytes, revisited "
+                         "for --kv-tier-rounds rounds) on a pool-only "
+                         "engine then the SAME pool + --kv-host-tier-mb "
+                         "of host tier; report per-side prefix hit rate "
+                         "/ p99 TTFT / spill+readmit traffic, assert "
+                         "token parity between the sides, then measure "
+                         "push-vs-pull migration bytes on an Echo "
+                         "fleet; records serving/kvtier_* history rows")
+    ap.add_argument("--kv-host-tier-mb", type=float, default=8.0,
+                    help="--kv-tier: host-RAM tier byte budget (MB) for "
+                         "the tiered side")
+    ap.add_argument("--kv-tier-oversub", type=float, default=10.0,
+                    help="--kv-tier: prefix working set as a multiple "
+                         "of the pool's byte budget")
+    ap.add_argument("--kv-tier-rounds", type=int, default=3,
+                    help="--kv-tier: times each prefix family is "
+                         "revisited")
+    ap.add_argument("--kv-tier-strict", action="store_true",
+                    help="--kv-tier: assert the tiered side beats "
+                         "pool-only on BOTH prefix hit rate and p99 "
+                         "TTFT (the acceptance gate); default is "
+                         "report-only")
     ap.add_argument("--record-history", action="store_true",
                     help="append serving/* rows to bench_history.json for "
                          "scripts/check_bench_regression.py")
@@ -1305,6 +1554,41 @@ def main():
                     args.trace_out)
         if args.record_history:
             _record_pipeline_history(args, report)
+        print(json.dumps(report, indent=1))
+        return
+
+    if args.kv_tier:
+        # Tiered-KV A/B: its own phases, its own rows. Tiering needs the
+        # paged pool under it.
+        if not (args.paged or args.kv_pool_mb > 0):
+            args.paged = True
+        report["config"]["paged"] = True
+        report["config"]["kv_host_tier_mb"] = args.kv_host_tier_mb
+        model, variables = _model(args)
+        try:
+            all_results = asyncio.run(
+                _kv_tier_ab(args, model, variables, report))
+            if not args.skip_parity:
+                mism = _check_parity(model, variables, all_results,
+                                     args.new_tokens)
+                report["parity_mismatches"] = mism
+                assert mism == 0, (
+                    f"{mism} tiered streams diverged from generate()")
+            asyncio.run(_kv_tier_push_phase(args, report))
+            if args.kv_tier_strict:
+                sec = report["kv_tier_ab"]
+                assert sec["hit_rate_gain"] > 0, (
+                    f"tiered prefix hit rate did not beat pool-only: "
+                    f"gain {sec['hit_rate_gain']}")
+                assert sec.get("ttft_p99_speedup_x", 0) > 1.0, (
+                    f"tiered p99 TTFT did not beat pool-only: "
+                    f"speedup {sec.get('ttft_p99_speedup_x')}")
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        if args.record_history:
+            _record_kvtier_history(args, report)
         print(json.dumps(report, indent=1))
         return
 

@@ -186,6 +186,26 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "--paged. See docs/serving.md 'KV pool sizing'")
     ap.add_argument("--kv-block-tokens", type=int, default=16,
                     help="paged-KV block granularity in tokens")
+    ap.add_argument("--kv-host-tier-mb", type=float, default=0.0,
+                    help="tiered KV cache: host-RAM spill tier byte "
+                         "budget (MB). > 0: unreferenced hot blocks "
+                         "evicted from the device pool spill to host "
+                         "RAM (exact serialized KV bytes) and re-admit "
+                         "on the next prefix hit instead of "
+                         "re-prefilling. Requires --paged/--kv-pool-mb. "
+                         "See docs/serving.md 'Tiered KV cache'")
+    ap.add_argument("--kv-disk-tier-dir", default=None, metavar="DIR",
+                    help="tiered KV cache: optional disk tier under the "
+                         "host tier — host-tier evictions demote to "
+                         "files in DIR instead of being dropped")
+    ap.add_argument("--kv-disk-tier-mb", type=float, default=0.0,
+                    help="disk tier byte budget (MB); must be > 0 for "
+                         "the disk tier to hold anything")
+    ap.add_argument("--kv-tier-watermark", type=float, default=0.8,
+                    help="tier eviction low-watermark: an over-budget "
+                         "tier evicts LRU entries down to this fraction "
+                         "of its budget (batched eviction, not "
+                         "per-put thrash)")
     ap.add_argument("--max-context", type=int, default=None,
                     help="cap per-request context below the trained "
                          "length; in dense mode also shrinks the "
@@ -269,6 +289,18 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "transfer failure falls back to monolithic "
                          "serving. See docs/serving.md 'Disaggregated "
                          "serving'")
+    ap.add_argument("--kv-push", action="store_true",
+                    help="disaggregated cluster (--roles): the router "
+                         "push-schedules prefill→decode KV transfers — "
+                         "right after each prefill handoff it tells the "
+                         "prefill replica to PUSH the blocks at the "
+                         "picked decode replica while that replica "
+                         "works on earlier requests, replacing the "
+                         "adopt-time pull; the fleet cache directory "
+                         "skips the transfer entirely when the decode "
+                         "replica already holds the prefix family. "
+                         "Every miss falls back to pull, then "
+                         "monolithic — counted, never a client error")
     ap.add_argument("--affinity-slack", type=int, default=4,
                     help="cluster mode: max outstanding-request imbalance "
                          "the prefix-affinity pin may create before plain "
@@ -384,6 +416,10 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
     # --paged with no explicit budget gets a sane default pool; an
     # explicit --kv-pool-mb implies --paged.
     kv_pool_mb = args.kv_pool_mb or (64.0 if args.paged else 0.0)
+    if args.kv_host_tier_mb and not kv_pool_mb:
+        raise SystemExit("--kv-host-tier-mb requires --paged or "
+                         "--kv-pool-mb: the host tier spills paged-KV "
+                         "blocks")
     draft_model = draft_variables = None
     if args.draft_model:
         draft_kwargs = json.loads(args.draft_args)
@@ -425,6 +461,10 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         prefix_block_tokens=args.prefix_block,
         kv_pool_mb=kv_pool_mb,
         kv_block_tokens=args.kv_block_tokens,
+        kv_host_tier_mb=args.kv_host_tier_mb,
+        kv_disk_tier_dir=args.kv_disk_tier_dir,
+        kv_disk_tier_mb=args.kv_disk_tier_mb,
+        kv_tier_watermark=args.kv_tier_watermark,
         max_context=args.max_context,
         draft_model=draft_model, draft_variables=draft_variables,
         spec_k=args.spec_k, mesh=mesh,
@@ -551,6 +591,14 @@ def _serving_config_flags(args) -> list[str]:
             extra += ["--paged"]
         extra += ["--kv-pool-mb", str(args.kv_pool_mb),
                   "--kv-block-tokens", str(args.kv_block_tokens)]
+        if getattr(args, "kv_host_tier_mb", 0.0):
+            extra += ["--kv-host-tier-mb", str(args.kv_host_tier_mb),
+                      "--kv-tier-watermark", str(args.kv_tier_watermark)]
+            if getattr(args, "kv_disk_tier_dir", None):
+                # One shared dir is safe: spill file names carry the
+                # replica pid.
+                extra += ["--kv-disk-tier-dir", args.kv_disk_tier_dir,
+                          "--kv-disk-tier-mb", str(args.kv_disk_tier_mb)]
     if args.max_context is not None:
         extra += ["--max-context", str(args.max_context)]
     if args.draft_model:
@@ -618,6 +666,9 @@ def cluster_main(args) -> int:
                 "migration (the prefill->decode handoff) only exists "
                 "on the paged pool")
         args.replicas = len(roles)
+    if getattr(args, "kv_push", False) and roles is None:
+        raise SystemExit("--kv-push requires --roles: push scheduling "
+                         "rides the prefill->decode handoff")
 
     from distkeras_tpu.serving.cluster import ProcessReplica, ServingCluster
     from distkeras_tpu.telemetry import MetricsRegistry
@@ -697,6 +748,8 @@ def cluster_main(args) -> int:
             # round trips for a guaranteed peer_miss.
             **({"min_handoff_tokens": args.kv_block_tokens}
                if roles is not None else {}),
+            **({"kv_push": True} if getattr(args, "kv_push", False)
+               else {}),
         })
 
     async def go():

@@ -276,6 +276,7 @@ class EchoServer:
         self.kv_prefills = 0
         self.kv_exports = 0
         self.kv_imports = 0
+        self.kv_pushes = 0
         self.kv_fallbacks = 0
         self._requested_port = port
         self._server: asyncio.AbstractServer | None = None
@@ -380,6 +381,45 @@ class EchoServer:
             self.kv_fallbacks += 1
         return info
 
+    async def _push_kv(self, spec: dict) -> dict:
+        """Router-scheduled P→D push, Echo edition: serialize the
+        prompt's chain (leafless) and deliver it to the named peer with
+        the REAL :func:`~distkeras_tpu.serving.kv_transfer.push_blocks`
+        client — so router push scheduling and its fallback accounting
+        run jax-free."""
+        from distkeras_tpu.serving import kv_transfer
+
+        if self.kv_fail:
+            self.kv_fallbacks += 1
+            return {"error": "kv_push disabled (kv_fail)",
+                    "code": "kv_transfer",
+                    "trace_id": spec.get("trace_id")}
+        prompt = list(spec.get("prompt") or ())
+        blob = self._kv_export_payload(prompt)
+        if blob is None:
+            return {"kv_push": {"pushed": False, "matched_tokens": 0,
+                                "blocks": 0, "echo": True}}
+        try:
+            rep = await asyncio.wait_for(
+                kv_transfer.push_blocks(
+                    str(spec.get("to_host")), int(spec.get("to_port")),
+                    blob, timeout=5.0),
+                5.0)
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                TypeError, ValueError,
+                kv_transfer.KVTransferError) as e:
+            self.kv_fallbacks += 1
+            return {"error": f"kv_push failed: {type(e).__name__}: {e}",
+                    "code": "kv_transfer",
+                    "trace_id": spec.get("trace_id")}
+        self.kv_pushes += 1
+        n = len(prompt) // self.kv_block_tokens
+        return {"kv_push": {
+            "pushed": True, "echo": True, "bytes": len(blob),
+            "blocks": n, "matched_tokens": n * self.kv_block_tokens,
+            "adopted_blocks": rep.get("adopted_blocks"),
+            "trace_id": spec.get("trace_id")}}
+
     def _kv_export_payload(self, prompt) -> bytes | None:
         """A leafless KVX1 payload over the prompt's complete blocks —
         wire-real (magic, header, token chain, provenance stamp), KV
@@ -425,8 +465,13 @@ class EchoServer:
                 if (isinstance(spec, dict) and "kv_from" in spec
                         and "cmd" not in spec):
                     kv_info = await self._pull_kv(spec)
-                recs = self._reply(spec if isinstance(spec, dict)
-                                   else {})
+                if (isinstance(spec, dict)
+                        and spec.get("cmd") == "kv_push"):
+                    # Async verb: can't live in the sync _reply table.
+                    recs = [await self._push_kv(spec)]
+                else:
+                    recs = self._reply(spec if isinstance(spec, dict)
+                                       else {})
                 if kv_info is not None and recs and recs[-1].get("done"):
                     recs[-1]["kv_migration"] = kv_info
                 for rec in recs:
@@ -445,6 +490,7 @@ class EchoServer:
         from distkeras_tpu.serving import wire
 
         decoder = wire.FrameDecoder()
+        kv_joiners: dict = {}  # sid -> FrameJoiner (chunked pushes)
         while True:
             data = await reader.read(2 ** 18)
             if not data:
@@ -509,17 +555,37 @@ class EchoServer:
                             else:
                                 out += wire.encode_frame(
                                     wire.T_KVBLK, sid, blob)
+                    elif ctrl.get("cmd") == "kv_push":
+                        out += wire.encode_json_frame(
+                            wire.T_CTRLR, sid, await self._push_kv(ctrl))
                     else:
                         out += wire.encode_json_frame(
                             wire.T_CTRLR, sid, self._reply(ctrl)[0])
                 elif ftype == wire.T_KVBLK:
-                    # A pushed chain: acknowledge the adopt (kv_import).
+                    # A pushed chain: reassemble KVXC chunks (a bare
+                    # KVX1 payload passes straight through), then
+                    # acknowledge the adopt (kv_import).
+                    from distkeras_tpu.serving import kv_transfer
+
+                    try:
+                        whole = kv_joiners.setdefault(
+                            sid,
+                            kv_transfer.FrameJoiner()).feed(payload)
+                    except kv_transfer.KVTransferError as e:
+                        kv_joiners.pop(sid, None)
+                        out += wire.encode_json_frame(
+                            wire.T_CTRLR, sid,
+                            {"error": str(e), "code": e.code})
+                        continue
+                    if whole is None:
+                        continue  # more chunk frames owed
+                    kv_joiners.pop(sid, None)
                     self.kv_imports += 1
                     out += wire.encode_json_frame(wire.T_CTRLR, sid, {
                         "kv_import": {"adopted_blocks": 0,
                                       "resident_blocks": 0,
                                       "matched_tokens": 0,
-                                      "bytes": len(payload),
+                                      "bytes": len(whole),
                                       "echo": True}})
                 elif ftype == wire.T_CANCEL:
                     pass

@@ -420,6 +420,7 @@ class Router:
         flush_interval_s: float = 0.0,
         kv_prefill_timeout_s: float = 60.0,
         min_handoff_tokens: int | None = None,
+        kv_push: bool = False,
     ):
         if wire_mode not in ("auto", "jsonl"):
             raise ValueError(
@@ -471,6 +472,21 @@ class Router:
         self.min_handoff_tokens = (self.affinity_tokens
                                    if min_handoff_tokens is None
                                    else int(min_handoff_tokens))
+        # Fleet cache directory: prefix family -> which replica OWNS
+        # the family's KV (its prefill/tier home) and which replicas
+        # already HOLD a copy (adopted via push or pull). Entries
+        # record the incarnation generation they were learned under;
+        # lookups validate lazily against the supervisor (a dead or
+        # restarted replica's claims are dropped, counted). With
+        # ``kv_push`` on, the router schedules a P→D push right after
+        # each handoff — the decode dispatch carries ``kv_wait``
+        # instead of ``kv_from`` and the transfer overlaps the decode
+        # replica's work on earlier requests; a decode replica that
+        # already holds the family skips the transfer entirely.
+        self.kv_push = bool(kv_push)
+        self._kv_directory: dict[int, dict] = {}
+        self._push_tasks: set[asyncio.Task] = set()
+        supervisor.on_replica_death.append(self._forget_replica)
         # In-flight classic relays per replica — what the rolling
         # reload's drain-by-migration fires. rid -> set[_RelayCtl].
         self._inflight: dict[str, set] = {}
@@ -480,6 +496,9 @@ class Router:
         self._c_reloads = None
         self._c_handoffs = self._c_handoff_fallbacks = None
         self._c_migrations = None
+        self._c_pushes = self._c_push_fallbacks = None
+        self._c_push_bytes = self._c_push_saved_bytes = None
+        self._c_dir_hits = self._c_dir_evictions = None
         self._h_handoff = None
         if registry is not None:
             self._c_requests = registry.counter(
@@ -516,6 +535,32 @@ class Router:
                 "router_stream_migrations_total",
                 help="live streams migrated off a draining replica "
                      "(rolling reload drain-by-migration)")
+            self._c_pushes = registry.counter(
+                "router_kv_pushes_total",
+                help="P→D push transfers scheduled and acked "
+                     "(blocks resident on the decode replica before "
+                     "admission)")
+            self._c_push_fallbacks = registry.counter(
+                "router_kv_push_fallbacks_total",
+                help="scheduled pushes that failed or missed (decode "
+                     "side pulls or re-prefills — counted, never a "
+                     "client error)")
+            self._c_push_bytes = registry.counter(
+                "router_kv_push_bytes_total",
+                help="serialized KV bytes moved by push transfers")
+            self._c_push_saved_bytes = registry.counter(
+                "router_kv_push_bytes_saved_total",
+                help="transfer bytes avoided because the fleet cache "
+                     "directory showed the decode replica already "
+                     "holding the prefix family")
+            self._c_dir_hits = registry.counter(
+                "router_kv_directory_hits_total",
+                help="dispatches where the directory found the family "
+                     "already resident on the picked decode replica")
+            self._c_dir_evictions = registry.counter(
+                "router_kv_directory_evictions_total",
+                help="directory entries dropped as stale (owner dead "
+                     "or restarted under a new generation)")
             self._h_handoff = registry.histogram(
                 "router_kv_prefill_seconds",
                 help="prefill-replica handoff latency (kv_prefill "
@@ -548,6 +593,9 @@ class Router:
         for mux in list(self._muxes.values()):
             await mux.close()
         self._muxes.clear()
+        for task in list(self._push_tasks):
+            task.cancel()
+        self._push_tasks.clear()
 
     # -- replica choice -----------------------------------------------------
     def _family(self, prompt) -> int:
@@ -1042,10 +1090,12 @@ class Router:
             # so disaggregation can only help. A spec that already
             # carries kv_from (a migrating stream pulling from its
             # draining replica) keeps it.
+            handoff_src = None
             if (self._roles_enabled() and "kv_from" not in spec
+                    and "kv_wait" not in spec
                     and isinstance(prompt, (list, tuple))
                     and len(prompt) >= self.min_handoff_tokens):
-                await self._prefill_handoff(spec, trace)
+                handoff_src = await self._prefill_handoff(spec, trace)
             while True:
                 info = await self._pick_wait(prompt, exclude)
                 if info is None:
@@ -1063,6 +1113,8 @@ class Router:
                     trace.event("dispatch", replica=info.rid,
                                 attempt=attempts,
                                 outstanding=info.outstanding)
+                if handoff_src is not None:
+                    self._plan_kv_transfer(spec, handoff_src, info, trace)
                 outcome, streamed, rec = await self._relay_any(
                     info, spec, sink)
                 if outcome == "migrate":
@@ -1156,24 +1208,28 @@ class Router:
                 trace.data["retries"] = attempts
                 self.trace_store.put(trace)
 
-    async def _prefill_handoff(self, spec: dict, trace) -> None:
+    async def _prefill_handoff(self, spec: dict, trace):
         """Arrange the disaggregated handoff for one dispatch: run
         ``kv_prefill`` on the prompt family's prefill replica (ONE
         prefill per fleet for a hot prefix — repeats are trie hits
         there), then stamp ``spec["kv_from"]`` so the decode replica
         pulls the blocks instead of prefilling. Every failure mode
-        falls back silently to monolithic dispatch."""
+        falls back silently to monolithic dispatch. On success the
+        family's fleet-cache-directory entry records this replica as
+        OWNER and the prefill replica is returned (the dispatch loop
+        plans the P→D transfer against the decode pick); fallback
+        returns None."""
 
-        def fallback(reason: str) -> None:
+        def fallback(reason: str):
             if self._c_handoff_fallbacks is not None:
                 self._c_handoff_fallbacks.inc()
             if trace is not None:
                 trace.event("kv_handoff_fallback", reason=reason)
+            return None
 
         info = self._pick_prefill(spec["prompt"])
         if info is None:
-            fallback("no_prefill_replica")
-            return
+            return fallback("no_prefill_replica")
         # Count the prefill against the replica's outstanding work:
         # prefill load-balancing (the slack spill) and drain waits must
         # see it.
@@ -1189,13 +1245,11 @@ class Router:
         except (OSError, ValueError, asyncio.TimeoutError,
                 _BackendLost) as e:
             self.supervisor.note_failure(info.rid)
-            fallback(f"{type(e).__name__}: {e}")
-            return
+            return fallback(f"{type(e).__name__}: {e}")
         finally:
             info.outstanding -= 1
         if "error" in rep:
-            fallback(str(rep.get("code") or rep["error"]))
-            return
+            return fallback(str(rep.get("code") or rep["error"]))
         dur = time.monotonic() - t0
         spec["kv_from"] = {"host": info.host, "port": info.port}
         if self._c_handoffs is not None:
@@ -1205,6 +1259,152 @@ class Router:
         if trace is not None:
             trace.event("kv_prefill", replica=info.rid,
                         dur_s=round(dur, 9))
+        # Directory: this replica now owns the family's warm chain
+        # (its device trie, or — evicted later — its host tier, which
+        # exports transparently).
+        fam = self._family(spec["prompt"])
+        entry = self._kv_directory.setdefault(fam, {"holders": {}})
+        entry["owner"] = info.rid
+        entry["generation"] = info.generation
+        entry["holders"][info.rid] = info.generation
+        entry["blocks"] = (rep.get("kv_prefill") or {}).get("blocks")
+        return info
+
+    # -- fleet cache directory ----------------------------------------------
+    def _forget_replica(self, rid: str) -> None:
+        """Supervisor death hook: drop every directory claim the dead
+        incarnation made — entries it owned and copies it held. Lazy
+        lookup validation catches generation bumps; this catches death
+        promptly so dispatches stop steering adoptions at a corpse."""
+        dropped = 0
+        for fam in list(self._kv_directory):
+            entry = self._kv_directory[fam]
+            if entry["holders"].pop(rid, None) is not None:
+                dropped += 1
+            if entry.get("owner") == rid or not entry["holders"]:
+                del self._kv_directory[fam]
+                dropped += 1
+        if dropped and self._c_dir_evictions is not None:
+            self._c_dir_evictions.inc(dropped)
+
+    def _dir_holds(self, fam: int, info: ReplicaInfo) -> bool:
+        """True when the directory shows THIS incarnation of ``info``
+        holding family ``fam``. Stale claims (replica restarted under a
+        new generation) are dropped on sight, counted."""
+        entry = self._kv_directory.get(fam)
+        if entry is None:
+            return False
+        gen = entry["holders"].get(info.rid)
+        if gen is None:
+            return False
+        if gen != info.generation:
+            del entry["holders"][info.rid]
+            if self._c_dir_evictions is not None:
+                self._c_dir_evictions.inc()
+            return False
+        return True
+
+    def _plan_kv_transfer(self, spec: dict, src: ReplicaInfo,
+                          dst: ReplicaInfo, trace) -> None:
+        """Decide how the decode pick ``dst`` gets the family's blocks
+        from prefill owner ``src`` — called per dispatch attempt (a
+        retry re-plans against the new pick). Three outcomes, best
+        first: the directory shows ``dst`` already holding the family
+        (skip the transfer, count the bytes saved); push mode schedules
+        an overlapped P→D push and stamps ``kv_wait`` (the decode side
+        parks on its tier-arrival event, pulling only if the push
+        misses); otherwise keep the classic adopt-time pull
+        (``kv_from``)."""
+        fam = self._family(spec.get("prompt") or [])
+        # Re-plan from a clean slate: a previous attempt may have
+        # stamped kv_wait for a different pick.
+        spec.pop("kv_wait", None)
+        spec["kv_from"] = {"host": src.host, "port": src.port}
+        if self._dir_holds(fam, dst):
+            spec.pop("kv_from", None)
+            if self._c_dir_hits is not None:
+                self._c_dir_hits.inc()
+            if self._c_push_saved_bytes is not None:
+                entry = self._kv_directory.get(fam) or {}
+                self._c_push_saved_bytes.inc(int(entry.get("bytes") or 0))
+            if trace is not None:
+                trace.event("kv_directory_hit", replica=dst.rid,
+                            family=fam)
+            return
+        if not self.kv_push or src.rid == dst.rid:
+            return
+        spec.pop("kv_from", None)
+        spec["kv_wait"] = {"host": src.host, "port": src.port}
+        task = asyncio.get_running_loop().create_task(
+            self._push_to(fam, src, dst, list(spec.get("prompt") or ()),
+                          spec.get("trace_id"), trace))
+        self._push_tasks.add(task)
+        task.add_done_callback(self._push_tasks.discard)
+
+    async def _push_to(self, fam: int, src: ReplicaInfo,
+                       dst: ReplicaInfo, prompt, trace_id, trace) -> None:
+        """Fire one P→D push (``kv_push`` verb on the owner) and record
+        the outcome in the directory. Runs as its own task so the
+        transfer overlaps the decode replica's work on earlier chunks;
+        the dispatched request is already parked on ``kv_wait`` and
+        wakes the moment the pushed import lands. Failures only count —
+        the decode side's timeout pull (then monolithic prefill) is the
+        fallback chain."""
+        try:
+            rep = await self._backend_control(
+                src, {"cmd": "kv_push", "prompt": prompt,
+                      "to_host": dst.host, "to_port": dst.port,
+                      "trace_id": trace_id},
+                timeout=self.kv_prefill_timeout_s)
+        except (OSError, ValueError, asyncio.TimeoutError,
+                _BackendLost) as e:
+            if self._c_push_fallbacks is not None:
+                self._c_push_fallbacks.inc()
+            if trace is not None:
+                trace.event("kv_push_fallback",
+                            reason=f"{type(e).__name__}: {e}")
+            return
+        out = rep.get("kv_push") or {}
+        if "error" in rep or not out.get("pushed"):
+            if self._c_push_fallbacks is not None:
+                self._c_push_fallbacks.inc()
+            if trace is not None:
+                trace.event("kv_push_fallback",
+                            reason=str(rep.get("error")
+                                       or "nothing_resident"))
+            return
+        entry = self._kv_directory.setdefault(fam, {"holders": {}})
+        entry["holders"][dst.rid] = dst.generation
+        if out.get("bytes"):
+            entry["bytes"] = int(out["bytes"])
+        if self._c_pushes is not None:
+            self._c_pushes.inc()
+        if self._c_push_bytes is not None:
+            self._c_push_bytes.inc(int(out.get("bytes") or 0))
+        if trace is not None:
+            trace.event("kv_push", replica=dst.rid,
+                        bytes=out.get("bytes"),
+                        blocks=out.get("blocks"))
+
+    def kv_directory_stats(self) -> dict:
+        """Directory rollup for healthz/debugz: family count, copy
+        count, and the push counters."""
+        holders = sum(len(e["holders"]) for e in
+                      self._kv_directory.values())
+        out = {
+            "families": len(self._kv_directory),
+            "holders": holders,
+            "push_enabled": self.kv_push,
+        }
+        for name, c in (("pushes", self._c_pushes),
+                        ("push_fallbacks", self._c_push_fallbacks),
+                        ("push_bytes", self._c_push_bytes),
+                        ("push_bytes_saved", self._c_push_saved_bytes),
+                        ("directory_hits", self._c_dir_hits),
+                        ("directory_evictions", self._c_dir_evictions)):
+            if c is not None:
+                out[name] = int(c.value)
+        return out
 
     # -- drain-by-migration -------------------------------------------------
     def _register_relay(self, rid: str, ctl: _RelayCtl) -> None:
@@ -1467,6 +1667,8 @@ class Router:
                 router["roles"] = roles
                 if migration_totals:
                     router["kv_migrations"] = migration_totals
+                if self._kv_directory or self.kv_push:
+                    router["kv_directory"] = self.kv_directory_stats()
             if versions:
                 router["weight_versions"] = versions
                 router["mixed_weight_versions"] = len(versions) > 1
@@ -1519,6 +1721,8 @@ class Router:
             }
             if self.trace_store is not None:
                 out["router"]["trace_store"] = self.trace_store.stats()
+            if self._kv_directory or self.kv_push:
+                out["router"]["kv_directory"] = self.kv_directory_stats()
             return {"debugz": out}
         if cmd == "tracez":
             return await self._tracez(spec)

@@ -135,6 +135,12 @@ class ReplicaSupervisor:
         }
         self._stopping = asyncio.Event()
         self._restart_tasks: set[asyncio.Task] = set()
+        # Death observers: ``cb(rid)`` fired when a replica is declared
+        # DEAD (before its restart begins). The router registers its
+        # fleet-cache-directory invalidation here — a dead tier owner's
+        # entries must stop steering adoptions at it. Callbacks must be
+        # cheap and must not raise.
+        self.on_replica_death: list = []
         # Bounded death/restart log: one entry per replica death, with a
         # reference to (and summary of) the dead replica's flight-
         # recorder "last words" dump when its handle exposes one — the
@@ -339,6 +345,11 @@ class ReplicaSupervisor:
                  "prior_restarts": info.restarts}
         self._collect_last_words(info, entry)
         self.restart_log.append(entry)
+        for cb in list(self.on_replica_death):
+            try:
+                cb(info.rid)
+            except Exception:  # observers must never block a restart
+                pass
         task = asyncio.get_running_loop().create_task(
             self._restart(info), name=f"restart-{info.rid}")
         self._restart_tasks.add(task)

@@ -173,6 +173,29 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
                                     ("pins", "pinned_refs"),
                                     ("depth", "max_chain_depth")]):
                 lines.append(f"{indent}  {ln}")
+    kt = dz.get("kv_tier")
+    if kt:
+        # Tiered KV cache: host/disk residency under the device pool,
+        # plus the spill/readmit/push traffic that crossed the tiers.
+        lines.append(
+            f"{indent}kv_tier: "
+            f"{_mb(kt.get('resident_bytes', 0)) or '0.0'} MB device, "
+            f"{kt.get('host_entries', 0)} host blocks "
+            f"({_mb(kt.get('host_bytes', 0)) or '0.0'}/"
+            f"{_mb(kt.get('host_budget_bytes', 0)) or '0.0'} MB)"
+            + (f", {kt.get('disk_entries', 0)} disk blocks "
+               f"({_mb(kt.get('disk_bytes', 0)) or '0.0'} MB)"
+               if kt.get("disk_budget_bytes") else ""))
+        lines.append(
+            f"{indent}kv_tier_traffic: "
+            f"{kt.get('spills', 0)} spills "
+            f"({_mb(kt.get('spill_bytes', 0)) or '0.0'} MB), "
+            f"{kt.get('readmits', 0)} readmits "
+            f"({_mb(kt.get('readmit_bytes', 0)) or '0.0'} MB), "
+            f"{kt.get('hits', 0)} hits / {kt.get('misses', 0)} misses, "
+            f"{kt.get('evictions', 0)} evictions, "
+            f"{kt.get('pushes', 0)} pushes "
+            f"({kt.get('push_fallbacks', 0)} fallbacks)")
     fr = dz.get("flight_recorder")
     if fr:
         lines.append(

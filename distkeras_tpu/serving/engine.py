@@ -731,6 +731,10 @@ class ServingEngine:
         kv_pool_mb: float = 0.0,
         kv_block_tokens: int = 16,
         kv_pool_blocks: int | None = None,
+        kv_host_tier_mb: float = 0.0,
+        kv_disk_tier_dir: str | None = None,
+        kv_disk_tier_mb: float = 0.0,
+        kv_tier_watermark: float = 0.8,
         max_context: int | None = None,
         draft_model=None,
         draft_variables=None,
@@ -972,6 +976,7 @@ class ServingEngine:
         self._slot_state: list[_SlotState | None] = [None] * self.slots
 
         self.kv_pool: KVBlockPool | None = None
+        self.kv_tier = None
         if self._paged:
             self.kv_pool = KVBlockPool(
                 capacity, self.kv_block_tokens,
@@ -1015,6 +1020,27 @@ class ServingEngine:
             self._positions_dirty = True
             self.prefix_cache = None
             self.scheduler.cache_probe = self.kv_pool.probe
+            # Host-RAM (optionally disk-backed) spill tier under the
+            # pool: eviction victims spill D2H as exact KVX1 bytes and
+            # re-admit H2D on a trie miss during admission — see
+            # serving/kv_tier.py. The spill hook fires inside
+            # _BlockTrie._alloc, which only runs on the engine loop (or
+            # the executor while the loop awaits it) and always after a
+            # pipeline barrier, so the gather never races a donated
+            # in-flight tick.
+            if kv_host_tier_mb > 0:
+                from distkeras_tpu.serving.kv_tier import HostKVTier
+
+                self.kv_tier = HostKVTier(
+                    int(kv_host_tier_mb * 2**20), bt,
+                    disk_dir=kv_disk_tier_dir,
+                    disk_budget_bytes=int(kv_disk_tier_mb * 2**20),
+                    watermark=kv_tier_watermark,
+                    registry=self.metrics.registry)
+                self.kv_pool.spill_hook = self._spill_block
+            # Trace context for spill exemplars: the admission /
+            # growth / import currently driving allocations.
+            self._tier_trace_id: str | None = None
         else:
             # Single-row cache geometry, captured ONCE: eval_shape traces
             # the module's init, far too slow to re-run per admission.
@@ -1382,6 +1408,10 @@ class ServingEngine:
             kv_bytes = self.kv_pool.capacity * self.kv_pool.bytes_per_block
             kv_peak = (self.kv_pool.peak_blocks_used
                        * self.kv_pool.bytes_per_block)
+            # Device-tier occupancy of the KV hierarchy (host/disk
+            # gauges are kept live by the tier itself).
+            self.metrics.set_kv_tier_resident_bytes(
+                self.kv_pool.blocks_used * self.kv_pool.bytes_per_block)
         params_by_dev = kv_by_dev = None
         if self.mesh is not None:
             try:
@@ -1530,6 +1560,26 @@ class ServingEngine:
                 "kv_migration_bytes": self.metrics.kv_migration_bytes,
                 "kv_exports": self.metrics.kv_exports,
             }
+            if self.kv_tier is not None:
+                # Tier section on the kv_pool page: occupancy of the
+                # host/disk levels plus the engine's traffic through
+                # them (device resident bytes ride along so all three
+                # tiers of the hierarchy read off one dict).
+                self.metrics.set_kv_tier_resident_bytes(
+                    self.kv_pool.blocks_used
+                    * (self.kv_pool.bytes_per_block or 0))
+                out["kv_tier"] = {
+                    **self.kv_tier.stats(),
+                    "resident_bytes": self.kv_pool.blocks_used
+                    * (self.kv_pool.bytes_per_block or 0),
+                    "spills": self.metrics.kv_spills,
+                    "spill_bytes": self.metrics.kv_spill_bytes,
+                    "readmits": self.metrics.kv_readmits,
+                    "readmit_bytes": self.metrics.kv_readmit_bytes,
+                    "pushes": self.metrics.kv_pushes,
+                    "push_bytes": self.metrics.kv_push_bytes,
+                    "push_fallbacks": self.metrics.kv_push_fallbacks,
+                }
         if self.flight_recorder is not None:
             out["flight_recorder"] = self.flight_recorder.stats()
         if self.trace_store is not None:
@@ -1833,18 +1883,25 @@ class ServingEngine:
         )
 
         tokens = [int(t) for t in prompt]
+        bt = self.kv_block_tokens
         match = self.kv_pool.match_blocks(tokens)
         try:
             n = len(match.ids)
+            leaves = []
+            if n:
+                padded = self._pad_kv_ids(match.ids, fill=0)
+                rows = self._kv_gather(self._cache, jnp.asarray(padded))
+                leaves = [np.asarray(l)[:n] for l in jax.tree.leaves(rows)
+                          if l.ndim > 1]
+            # Tier-owner exports: continue the chain from the host/disk
+            # tier where the device trie ends — an evicted-but-spilled
+            # family stays exportable to the fleet (the directory's
+            # owner contract), at zero device cost per tier block.
+            n = self._extend_export_from_tier(tokens, n, leaves)
             if n == 0:
                 return {"matched_tokens": 0, "blocks": 0, "payload": None}
-            padded = self._pad_kv_ids(match.ids, fill=0)
-            rows = self._kv_gather(self._cache, jnp.asarray(padded))
-            leaves = [np.asarray(l)[:n] for l in jax.tree.leaves(rows)
-                      if l.ndim > 1]
             payload = serialize_blocks(
-                tokens[:n * self.kv_block_tokens], leaves,
-                block_tokens=self.kv_block_tokens,
+                tokens[:n * bt], leaves, block_tokens=bt,
                 provenance=self.weight_version)
         finally:
             self.kv_pool.release(match)
@@ -1941,6 +1998,199 @@ class ServingEngine:
         out[:n] = ids
         return out
 
+    # -- tiered KV cache (serving/kv_tier.py) -------------------------------
+    def _spill_block(self, chain_tokens, row: int) -> None:
+        """Pool spill hook: serialize ONE eviction victim's pool row
+        into the host tier as exact KVX1 bytes, keyed by its full
+        root→block token chain. Runs inside ``_BlockTrie._alloc`` —
+        always on the engine loop, or on the executor while the loop
+        awaits it, and always after a pipeline barrier, so the gather
+        cannot race a donated in-flight tick. The payload is the same
+        serialization a peer transfer ships, so a spilled block is
+        re-admittable locally AND exportable to the fleet."""
+        tier = self.kv_tier
+        if tier is None:
+            return
+        from distkeras_tpu.serving.kv_transfer import serialize_blocks
+
+        t0 = time.monotonic()
+        bt = self.kv_block_tokens
+        padded = self._pad_kv_ids(np.asarray([row], np.int32), fill=0)
+        rows = self._kv_gather(self._cache, jnp.asarray(padded))
+        leaves = [np.asarray(l)[:1] for l in jax.tree.leaves(rows)
+                  if l.ndim > 1]
+        chain = [int(t) for t in chain_tokens]
+        payload = serialize_blocks(chain[-bt:], leaves, block_tokens=bt,
+                                   provenance=self.weight_version)
+        if tier.put(chain, payload):
+            self.metrics.record_kv_spill(
+                len(payload), time.monotonic() - t0,
+                trace_id=self._tier_trace_id)
+            self.scheduler.note_kv_arrival()
+
+    def _tier_provenance_ok(self, header) -> bool:
+        prov = header.get("provenance") or {}
+        mine = self.weight_version
+        return (int(prov.get("version") or 0), prov.get("digest")) == (
+            int(mine.get("version") or 0), mine.get("digest"))
+
+    def _readmit_from_tier(self, tokens, trace_id: str | None = None) -> int:
+        """Extend the device trie along ``tokens`` from the host tier:
+        for each complete block past the device-resident prefix, fetch
+        its KVX1 payload, adopt a pool row (never preempting — adoption
+        only reclaims unreferenced leaves), and H2D-scatter the bytes
+        in ONE batched call. Runs on the loop thread during admission,
+        after the pipeline barrier, BEFORE the trie match — so the
+        re-admitted blocks count as the prefix hits they are. Returns
+        the number of blocks re-admitted."""
+        tier, pool = self.kv_tier, self.kv_pool
+        if tier is None:
+            return 0
+        bt = self.kv_block_tokens
+        toks = [int(t) for t in tokens]
+        # Same last-block holdback as match(): prefill needs >= 1
+        # uncached token, so a block match() won't use is a wasted row.
+        cap = max(0, (len(toks) - 1) // bt)
+        resident = pool.probe(toks) // bt
+        if resident >= cap or not tier.contains(toks[:(resident + 1) * bt]):
+            return 0
+        from distkeras_tpu.serving.kv_transfer import deserialize_blocks
+
+        t0 = time.monotonic()
+        mine = [l for l in jax.tree.leaves(self._cache) if l.ndim > 1]
+        staged: list[tuple[int, list]] = []  # (pool_row, per-leaf [1,bt,..])
+        nbytes = 0
+        k = resident
+        while k < cap:
+            chain = toks[:(k + 1) * bt]
+            payload = tier.get(chain)
+            if payload is None:
+                break
+            try:
+                header, leaves = deserialize_blocks(payload)
+            except Exception:
+                break  # truncated/corrupt entry: stop, never raise
+            if (int(header.get("block_tokens") or 0) != bt
+                    or len(leaves) != len(mine)
+                    or not self._tier_provenance_ok(header)):
+                break
+            # adopt_foreign re-walks the chain from the root: resident
+            # prefix blocks are touched, block k gets a fresh row (or
+            # none when the pool is dry — stop there, what fit is
+            # already a win).
+            uploads, res = pool.adopt_foreign(chain, k + 1)
+            if not uploads:
+                break
+            staged.append((uploads[0][1], leaves))
+            nbytes += len(payload)
+            k += 1
+        if not staged:
+            return 0
+        rows = np.asarray([r for r, _ in staged], np.int32)
+        padded = self._pad_kv_ids(rows, fill=self.kv_pool.capacity)
+        b = len(padded)
+        treedef = jax.tree.structure(self._cache)
+        data_leaves, li = [], 0
+        for leaf in jax.tree.leaves(self._cache):
+            if leaf.ndim <= 1:
+                data_leaves.append(jnp.zeros((b, 0), leaf.dtype))
+                continue
+            arr = np.concatenate([blk[li] for _, blk in staged], axis=0)
+            if len(staged) < b:  # pad to the pow2 bucket (dropped)
+                pad = np.zeros((b - len(staged),) + arr.shape[1:],
+                               arr.dtype)
+                arr = np.concatenate([arr, pad], axis=0)
+            data_leaves.append(jnp.asarray(arr))
+            li += 1
+        data = jax.tree.unflatten(treedef, data_leaves)
+        self._cache = self._kv_scatter(self._cache, data,
+                                       jnp.asarray(padded))
+        self.metrics.record_kv_readmit(len(staged), nbytes,
+                                       time.monotonic() - t0,
+                                       trace_id=trace_id)
+        self.scheduler.note_kv_arrival()
+        return len(staged)
+
+    def _extend_export_from_tier(self, tokens, n: int, leaves: list) -> int:
+        """Continue an export chain past the device-resident prefix
+        using host-tier payloads: deserialize each contiguous tier
+        block and append its leaf rows to ``leaves`` (in place).
+        Returns the new block count. Export has NO last-block holdback
+        (mirrors ``match_blocks``): a peer adopting the chain wants the
+        full spilled prefix."""
+        tier = self.kv_tier
+        if tier is None:
+            return n
+        from distkeras_tpu.serving.kv_transfer import deserialize_blocks
+
+        bt = self.kv_block_tokens
+        n_total = len(tokens) // bt
+        extras, k = [], n
+        while k < n_total:
+            payload = tier.get(tokens[:(k + 1) * bt])
+            if payload is None:
+                break
+            try:
+                header, lv = deserialize_blocks(payload)
+            except Exception:
+                break
+            if (int(header.get("block_tokens") or 0) != bt
+                    or not self._tier_provenance_ok(header)):
+                break
+            want = len(leaves) if leaves else (
+                len(extras[0]) if extras else len(lv))
+            if len(lv) != want or not lv:
+                break
+            extras.append(lv)
+            k += 1
+        if not extras:
+            return n
+        if not leaves:
+            leaves.extend(
+                np.concatenate([e[i] for e in extras], axis=0)
+                for i in range(len(extras[0])))
+        else:
+            for i in range(len(leaves)):
+                leaves[i] = np.concatenate(
+                    [leaves[i]] + [e[i] for e in extras], axis=0)
+        return k
+
+    def _tier_pending(self, req) -> bool:
+        """True when a parked request's next uncovered block sits in
+        the host tier (or a peer import is queued) — i.e. waiting on a
+        tier arrival, not on a slot to free."""
+        if self.kv_tier is None:
+            return bool(self._pending_kv)
+        if self._pending_kv:
+            return True
+        toks = [int(t) for t in req.prompt]
+        bt = self.kv_block_tokens
+        resident = self.kv_pool.probe(toks) // bt
+        return self.kv_tier.contains(toks[:(resident + 1) * bt])
+
+    async def wait_for_kv(self, tokens, timeout_s: float) -> bool:
+        """Await KV residency for ``tokens``' first block in ANY local
+        tier (device pool or host tier) — the decode-side wait behind a
+        router-scheduled push (``kv_wait``): instead of pulling at
+        admission, the server parks the request here until the pushed
+        bytes land (the import path fires the scheduler's tier-arrival
+        event). Returns True when resident, False on timeout (caller
+        pulls or re-prefills — counted fallbacks, never errors)."""
+        if not self._paged:
+            return False
+        toks = [int(t) for t in tokens]
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while True:
+            if self.kv_pool.probe(toks) > 0 or (
+                    self.kv_tier is not None
+                    and self.kv_tier.probe(toks) > 0):
+                return True
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False
+            await self.scheduler.wait_for_kv_arrival(remaining)
+
     def _swap_sync(self, params) -> None:
         """Executor-thread half of the swap: transfer, flush, rewarm.
 
@@ -1962,8 +2212,14 @@ class ServingEngine:
         if self.kv_pool is not None:
             # Safe for the same reason the swap itself is: zero active
             # slots means zero slot-owned blocks, so the flush only
-            # drops (now-wrong) trie entries.
+            # drops (now-wrong) trie entries. flush() bypasses _alloc,
+            # so no spill fires — old-weight blocks never reach the
+            # host tier.
             self.kv_pool.flush()
+        if self.kv_tier is not None:
+            # The host/disk tiers hold serialized KV from the OLD
+            # weights — same purity argument, one stroke.
+            self.kv_tier.flush()
         # Rewarm: one decode tick over the (all-free) batch. Garbage
         # output, real proof — the compiled decode step runs against the
         # new params, so an armed auditor raises here if the swap somehow
@@ -2104,6 +2360,10 @@ class ServingEngine:
                                 res["error"] = e
                             finally:
                                 ev.set()
+                    # Imported blocks ARE a tier arrival: wake any
+                    # tier-pending parked admission (and a decode-side
+                    # wait_for_kv behind a router-scheduled push).
+                    self.scheduler.note_kv_arrival()
                 # 4. Admission: prefill queued requests into free slots.
                 # Device work runs in the executor; stream/metrics
                 # bookkeeping stays on the loop thread (asyncio queues and
@@ -2275,7 +2535,16 @@ class ServingEngine:
                         # hot-spin the loop doing only the park check;
                         # wait on the arrival event itself instead (the
                         # timeout keeps deadline expiry responsive).
-                        await self.scheduler.wait_for_wake(idle_poll_s)
+                        # Tier-pending heads (next uncovered block in
+                        # the host tier, or a peer import queued) wait
+                        # on the TIER-arrival event: the arrival wakes
+                        # them immediately instead of them re-checking
+                        # pool.version once per idle poll.
+                        if self._tier_pending(self._parked_req):
+                            await self.scheduler.wait_for_kv_arrival(
+                                idle_poll_s)
+                        else:
+                            await self.scheduler.wait_for_wake(idle_poll_s)
                     else:
                         await self.scheduler.wait_for_request(idle_poll_s)
                     continue
@@ -3039,6 +3308,17 @@ class ServingEngine:
         a block."""
         pool = self.kv_pool
         tokens = self._resident_tokens(req)
+        if self.kv_tier is not None:
+            # Host-tier re-admission BEFORE the match: blocks the trie
+            # evicted (but the tier kept) scatter back H2D and then
+            # count as the prefix hits they are. Eviction cascades from
+            # the adopt are fine — they spill lower-value leaves. The
+            # spill exemplar points at the admission that triggered it.
+            self._tier_trace_id = req.trace_id
+            try:
+                self._readmit_from_tier(tokens, trace_id=req.trace_id)
+            finally:
+                self._tier_trace_id = None
         match = pool.match(tokens)
         m = match.matched_tokens
         first_block = m // self.kv_block_tokens

@@ -60,6 +60,7 @@ __all__ = [
     "deserialize_blocks",
     "peek_header",
     "fetch_blocks",
+    "push_blocks",
     "split_frames",
     "is_chunk_frame",
     "FrameJoiner",
@@ -500,6 +501,104 @@ async def fetch_blocks(host: str, port: int, tokens, *,
                 # Typed peer-side T_CTRLR reply: the connection itself
                 # is healthy and fully drained.
                 pool.release(host, port, reader, writer)
+            raise
+        except BaseException:
+            pool.discard(writer)
+            raise
+        pool.release(host, port, reader, writer)
+        return result
+
+
+async def _push_on(reader, writer, payload: bytes, *, timeout: float):
+    """One kv_push delivery on an established bin1 connection: stream
+    the KVX1 payload as KVBLK frame(s) and wait for the receiver's
+    adopt reply. Returns the receiver's ``kv_import`` result dict."""
+    from distkeras_tpu.serving import wire
+
+    wrote = False
+    try:
+        for fp in split_frames(payload):
+            writer.write(wire.encode_frame(wire.T_KVBLK, 1, fp))
+            await writer.drain()
+            wrote = True
+    except (OSError, ConnectionError):
+        if wrote:
+            raise ConnectionError("peer connection failed mid kv_push")
+        raise _StaleConn()
+    decoder = wire.FrameDecoder()
+    replied = False
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        try:
+            data = await asyncio.wait_for(
+                reader.read(2 ** 18),
+                max(0.001, deadline - asyncio.get_running_loop().time()))
+        except asyncio.TimeoutError:
+            # A hung-but-connected receiver still owes the adopt ack:
+            # propagate the transport-failure signal (the caller
+            # discards the socket and falls back to pull/re-prefill).
+            raise
+        except (OSError, ConnectionError):
+            if replied or wrote:
+                raise ConnectionError(
+                    "peer connection failed awaiting kv_push ack")
+            raise _StaleConn()
+        if not data:
+            if replied or wrote:
+                raise ConnectionError("peer closed during kv_push")
+            raise _StaleConn()
+        for ftype, _sid, fp in decoder.feed(data):
+            replied = True
+            if ftype == wire.T_CTRLR:
+                rep = wire.decode_json(fp)
+                if "error" in rep:
+                    raise KVTransferError(str(rep["error"]))
+                return rep.get("kv_import", rep)
+
+
+async def push_blocks(host: str, port: int, payload: bytes, *,
+                      timeout: float = 10.0,
+                      pool: PeerConnectionPool | None = None) -> dict:
+    """PUSH a serialized KVX1 chain to a peer: deliver KVBLK frame(s)
+    on a pooled bin1 connection and wait for the receiver's adopt ack
+    (its ``_kv_import_frame`` reply). The router schedules this P→D
+    after a disaggregated prefill so the blocks are already resident
+    when the decode replica admits the request — replacing the
+    adopt-time pull (:func:`fetch_blocks`) and overlapping the transfer
+    with the receiver's decode of earlier work. Returns the receiver's
+    ``kv_import`` result (adopted/resident block counts, bytes). Raises
+    :class:`KVTransferError` on a typed receiver-side reject and
+    ``OSError``/``asyncio.TimeoutError`` on transport failure — callers
+    treat every raise as "the receiver will pull (or re-prefill)
+    instead". Unlike the pull path there is no miss case: the payload
+    travels with the request.
+
+    A connection that dies before the first frame is fully written
+    retries once on a fresh dial (restarted-peer case); once payload
+    bytes are in flight a failure propagates — the receiver's joiner
+    state is unknown, so the socket is discarded, never pooled.
+    """
+    if len(payload) > MAX_TOTAL_TRANSFER_BYTES:
+        raise KVTransferError(
+            f"kv_push payload {len(payload)}B exceeds the transfer cap "
+            f"{MAX_TOTAL_TRANSFER_BYTES}B")
+    pool = pool if pool is not None else peer_pool()
+    for attempt in (0, 1):
+        reader, writer, fresh = await pool.acquire(host, port,
+                                                   timeout=timeout)
+        try:
+            result = await _push_on(reader, writer, payload,
+                                    timeout=timeout)
+        except _StaleConn:
+            pool.discard(writer)
+            if fresh or attempt:
+                raise ConnectionError(
+                    f"peer {host}:{port} closed during kv_push")
+            continue  # stale pooled conn: one retry on a fresh dial
+        except KVTransferError:
+            # Typed receiver-side T_CTRLR reply: the connection is
+            # healthy and drained (one reply per pushed chain).
+            pool.release(host, port, reader, writer)
             raise
         except BaseException:
             pool.discard(writer)

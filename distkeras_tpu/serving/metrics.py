@@ -303,6 +303,52 @@ class ServingMetrics:
             "kv_migration_seconds",
             help="peer pull + adopt latency per KV migration",
             buckets=_LATENCY_BUCKETS)
+        # Tiered KV cache (serving/kv_tier.py): device⇄host block
+        # movement plus router-scheduled P→D pushes. The tier itself
+        # publishes kv_tier_{host,disk}_bytes occupancy; these count
+        # the ENGINE's traffic through it, exemplar'd with the trace
+        # that triggered each move.
+        self._c_kv_spills = reg.counter(
+            "kv_tier_spills_total",
+            help="trie eviction victims spilled D2H into the host tier")
+        self._c_kv_spill_bytes = reg.counter(
+            "kv_tier_spill_bytes_total",
+            help="serialized KVX1 bytes spilled into the host tier")
+        self._c_kv_readmits = reg.counter(
+            "kv_tier_readmits_total",
+            help="blocks re-admitted H2D from the host tier on a trie "
+                 "miss during admission")
+        self._c_kv_readmit_bytes = reg.counter(
+            "kv_tier_readmit_bytes_total",
+            help="serialized KVX1 bytes re-admitted from the host tier")
+        self._c_kv_pushes = reg.counter(
+            "kv_pushes_total",
+            help="KV chains pushed to a peer (router-scheduled P→D "
+                 "transfer, replacing an adopt-time pull)")
+        self._c_kv_push_bytes = reg.counter(
+            "kv_push_bytes_total",
+            help="serialized KV bytes delivered by push transfers")
+        self._c_kv_push_fallbacks = reg.counter(
+            "kv_push_fallbacks_total",
+            help="push transfers that failed (receiver pulls or "
+                 "re-prefills instead) — never a client-visible error")
+        self._h["kv_spill"] = reg.histogram(
+            "kv_tier_spill_seconds",
+            help="D2H gather + serialize latency per spilled block",
+            buckets=_LATENCY_BUCKETS)
+        self._h["kv_readmit"] = reg.histogram(
+            "kv_tier_readmit_seconds",
+            help="host-tier probe + H2D scatter latency per "
+                 "re-admission burst",
+            buckets=_LATENCY_BUCKETS)
+        self._h["kv_push"] = reg.histogram(
+            "kv_push_seconds",
+            help="export + deliver + remote-adopt latency per push",
+            buckets=_LATENCY_BUCKETS)
+        self._g_kv_tier_resident = reg.gauge(
+            "kv_tier_resident_bytes",
+            help="bytes resident in the DEVICE pool tier (blocks_used "
+                 "x bytes_per_block)")
         self._g_slo = reg.gauge(
             "serving_slo_seconds",
             help="configured request-latency SLO (0 = no SLO armed)")
@@ -536,6 +582,33 @@ class ServingMetrics:
     def record_kv_export(self, nbytes: int) -> None:
         self._c_kv_exports.inc()
 
+    def record_kv_spill(self, nbytes: int, latency_s: float,
+                        trace_id: str | None = None) -> None:
+        """One trie eviction victim spilled into the host tier."""
+        self._c_kv_spills.inc()
+        self._c_kv_spill_bytes.inc(int(nbytes))
+        self._h["kv_spill"].observe(latency_s, exemplar=trace_id)
+
+    def record_kv_readmit(self, blocks: int, nbytes: int, latency_s: float,
+                          trace_id: str | None = None) -> None:
+        """One admission-time re-admission burst from the host tier."""
+        self._c_kv_readmits.inc(int(blocks))
+        self._c_kv_readmit_bytes.inc(int(nbytes))
+        self._h["kv_readmit"].observe(latency_s, exemplar=trace_id)
+
+    def record_kv_push(self, nbytes: int, latency_s: float,
+                       trace_id: str | None = None) -> None:
+        """One KV chain pushed to a peer and adopted there."""
+        self._c_kv_pushes.inc()
+        self._c_kv_push_bytes.inc(int(nbytes))
+        self._h["kv_push"].observe(latency_s, exemplar=trace_id)
+
+    def record_kv_push_fallback(self) -> None:
+        self._c_kv_push_fallbacks.inc()
+
+    def set_kv_tier_resident_bytes(self, nbytes: int) -> None:
+        self._g_kv_tier_resident.set(int(nbytes))
+
     @property
     def kv_migrations(self) -> int:
         return int(self._c_kv_migrations.value)
@@ -551,6 +624,34 @@ class ServingMetrics:
     @property
     def kv_exports(self) -> int:
         return int(self._c_kv_exports.value)
+
+    @property
+    def kv_spills(self) -> int:
+        return int(self._c_kv_spills.value)
+
+    @property
+    def kv_spill_bytes(self) -> int:
+        return int(self._c_kv_spill_bytes.value)
+
+    @property
+    def kv_readmits(self) -> int:
+        return int(self._c_kv_readmits.value)
+
+    @property
+    def kv_readmit_bytes(self) -> int:
+        return int(self._c_kv_readmit_bytes.value)
+
+    @property
+    def kv_pushes(self) -> int:
+        return int(self._c_kv_pushes.value)
+
+    @property
+    def kv_push_bytes(self) -> int:
+        return int(self._c_kv_push_bytes.value)
+
+    @property
+    def kv_push_fallbacks(self) -> int:
+        return int(self._c_kv_push_fallbacks.value)
 
     @property
     def preemptions(self) -> int:
@@ -626,6 +727,17 @@ class ServingMetrics:
         if self._c_prompt_tokens.value:
             out["prefix_hit_rate"] = (
                 self._c_prefix_hit_tokens.value / self._c_prompt_tokens.value)
+        if self.kv_spills or self.kv_readmits:
+            out["kv_spills"] = float(self.kv_spills)
+            out["kv_spill_bytes"] = float(self.kv_spill_bytes)
+            out["kv_readmits"] = float(self.kv_readmits)
+            out["kv_readmit_bytes"] = float(self.kv_readmit_bytes)
+            if self._h["kv_spill"].count:
+                out["kv_spill_latency_p99_s"] = (
+                    self._h["kv_spill"].percentile(99))
+            if self._h["kv_readmit"].count:
+                out["kv_readmit_latency_p99_s"] = (
+                    self._h["kv_readmit"].percentile(99))
         if self._c_spec_draft.value:
             out["spec_draft_tokens"] = float(self.spec_draft_tokens)
             out["spec_accepted_tokens"] = float(self.spec_accepted_tokens)

@@ -194,6 +194,12 @@ class _BlockTrie:
         # "is it worth retrying a parked admission" heuristic.
         self.version = 0
         self._metrics: dict | None = None
+        # Optional ``hook(chain_tokens, slot)`` called just before an
+        # eviction victim's trie node is destroyed — the tiered-KV
+        # engine uses it to spill the victim block (D2H) into the host
+        # tier. Called while the victim's node is still intact (chain
+        # reconstructible) and its pool row still holds the KV bytes.
+        self.spill_hook = None
 
     # -- introspection ------------------------------------------------------
     @property
@@ -261,6 +267,19 @@ class _BlockTrie:
             self._note_occupancy()
 
     # -- trie walk ----------------------------------------------------------
+    @staticmethod
+    def _chain_tokens(node: _Node) -> list:
+        """Full root→``node`` token chain, reconstructed by walking the
+        parent links (each edge key is one block's token tuple)."""
+        keys = []
+        while node.parent is not None:
+            keys.append(node.key)
+            node = node.parent
+        out = []
+        for key in reversed(keys):
+            out.extend(key)
+        return out
+
     def _blocks(self, tokens, n_blocks: int):
         bt = self.block_tokens
         for i in range(n_blocks):
@@ -376,6 +395,15 @@ class _BlockTrie:
             heapq.heappush(self._lru, item)
         if victim is None:
             return None  # everything pinned or mid-chain
+        if self.spill_hook is not None:
+            # Spill BEFORE the node is unlinked: the hook needs the full
+            # root→victim chain and the still-valid pool row. A hook
+            # failure must never break allocation — the spill tier is an
+            # optimization, the evicted block was always droppable.
+            try:
+                self.spill_hook(self._chain_tokens(victim), victim.slot)
+            except Exception:  # pragma: no cover - defensive
+                pass
         del victim.parent.children[victim.key]
         del self._by_slot[victim.slot]
         self.evicted_blocks += 1

@@ -362,6 +362,11 @@ class Scheduler:
         # staying FIFO among requeues themselves.
         self._requeue_seq = itertools.count(-(2**62))
         self._arrival = asyncio.Event()
+        # Tier-arrival event: set when KV bytes land in a tier (host
+        # spill, re-admission, peer push/pull import). A fully-parked
+        # tier-pending admission waits on THIS instead of polling the
+        # pool version every idle tick.
+        self._kv_arrival = asyncio.Event()
         # Requests found expired during pop(), awaiting pickup by expire().
         self._expired_backlog: list[Request] = []
         # Per-tenant shed accounting (quota rejects), served by
@@ -820,9 +825,34 @@ class Scheduler:
         """Wake any waiter (e.g. so the engine loop notices shutdown)."""
         self._arrival.set()
 
+    async def wait_for_kv_arrival(self, timeout: float | None = None) -> bool:
+        """Block until KV blocks ARRIVE somewhere the parked head could
+        use them (host-tier spill, tier re-admission, or a pushed/pulled
+        peer import) — the tier-pending variant of :meth:`wait_for_wake`.
+        A fully-parked admission whose prompt has blocks in flight waits
+        here instead of polling ``pool.version`` each idle tick: the
+        arrival wakes it immediately, and nothing else does (submits and
+        kicks still land on the ordinary arrival event). Same race-free
+        clear-then-wait as :meth:`wait_for_wake`."""
+        self._kv_arrival.clear()
+        try:
+            await asyncio.wait_for(self._kv_arrival.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def note_kv_arrival(self) -> None:
+        """Signal that KV bytes just landed in a tier (spill, re-admit,
+        or peer import) — wakes both a tier-pending parked admission
+        (:meth:`wait_for_kv_arrival`) and the ordinary idle wait, since
+        an import also bumps ``pool.version``."""
+        self._kv_arrival.set()
+        self._arrival.set()
+
     def reset_loop_state(self) -> None:
-        """Replace the arrival event: asyncio primitives bind to the loop
+        """Replace the arrival events: asyncio primitives bind to the loop
         they are first awaited on, so an engine reopened under a NEW event
-        loop (multi-phase benches, sequential asyncio.run calls) needs a
-        fresh one. Queued requests are untouched."""
+        loop (multi-phase benches, sequential asyncio.run calls) needs
+        fresh ones. Queued requests are untouched."""
         self._arrival = asyncio.Event()
+        self._kv_arrival = asyncio.Event()

@@ -263,8 +263,9 @@ class ServingServer:
                         await self._send(writer, await self._control(spec))
                         continue
                     kv_info = None
-                    if isinstance(spec, dict) and "kv_from" in spec:
-                        kv_info = await self._import_from_peer(spec)
+                    if isinstance(spec, dict) and ("kv_from" in spec
+                                                   or "kv_wait" in spec):
+                        kv_info = await self._kv_prepare(spec)
                     req = self._submit_spec(spec)
                     self._note_migration(req, kv_info)
                 except ServingError as e:
@@ -402,9 +403,11 @@ class ServingServer:
                     # the pull waits out its timeout. Plain specs admit
                     # inline through ONE submit_many as before.
                     plain = [(sid, spec) for sid, spec in batch
-                             if "kv_from" not in spec]
+                             if "kv_from" not in spec
+                             and "kv_wait" not in spec]
                     kv_batch = [(sid, spec) for sid, spec in batch
-                                if "kv_from" in spec]
+                                if "kv_from" in spec
+                                or "kv_wait" in spec]
                     self._admit_bin1(plain, precancelled, {},
                                      live, pumps, sink)
                     if kv_batch:
@@ -466,7 +469,7 @@ class ServingServer:
         streams."""
         try:
             infos = await asyncio.gather(*(
-                self._import_from_peer(spec) for _, spec in batch))
+                self._kv_prepare(spec) for _, spec in batch))
             self._admit_bin1(batch, kv_cancelled,
                              dict(enumerate(infos)), live, pumps, sink)
         finally:
@@ -537,6 +540,100 @@ class ServingServer:
                               "blocks": result["blocks"],
                               "bytes": result["bytes"]}}
 
+    async def _kv_push(self, spec: dict) -> dict:
+        """``{"cmd": "kv_push", "prompt": [...], "to_host": h,
+        "to_port": p}``: export this pool's chain for ``prompt``
+        (device trie + host tier) and DELIVER it to the named peer as
+        KVBLK frame(s) over a pooled connection — the router-scheduled
+        P→D transfer that replaces the decode side's adopt-time pull.
+        The receiver's ordinary push-import path adopts the frames and
+        acks. Failures are typed replies, never raises: the router
+        counts them and the decode side falls back to pulling (or
+        re-prefilling)."""
+        from distkeras_tpu.serving.kv_transfer import push_blocks
+
+        host, port = spec.get("to_host"), spec.get("to_port")
+        if not host or not port:
+            return {"error": "kv_push needs to_host and to_port",
+                    "code": "bad_request"}
+        t0 = time.monotonic()
+        rep = await self._kv_export_verb(spec)
+        payload = rep.pop("payload", None)
+        if "error" in rep:
+            self.engine.metrics.record_kv_push_fallback()
+            return rep
+        if not payload:
+            # Nothing resident for this prompt: a miss, not a failure
+            # (the receiver will prefill; the router counts a fallback).
+            return {"kv_push": {"pushed": False, "matched_tokens": 0,
+                                "blocks": 0}}
+        try:
+            imp = await asyncio.wait_for(
+                push_blocks(str(host), int(port), payload,
+                            timeout=self.kv_transfer_timeout_s),
+                self.kv_transfer_timeout_s)
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                KVTransferError, wire.WireError) as e:
+            self.engine.metrics.record_kv_push_fallback()
+            return {"error": f"{type(e).__name__}: {e}",
+                    "code": getattr(e, "code", "kv_transfer")}
+        latency = time.monotonic() - t0
+        self.engine.metrics.record_kv_push(
+            len(payload), latency, trace_id=spec.get("trace_id"))
+        out = dict(rep.get("kv_export") or {})
+        out.update({
+            "pushed": True,
+            "bytes": len(payload),
+            "adopted_blocks": imp.get("adopted_blocks"),
+            "resident_blocks": imp.get("resident_blocks"),
+            "latency_s": round(latency, 6),
+        })
+        return {"kv_push": out}
+
+    async def _await_pushed_kv(self, spec: dict) -> dict | None:
+        """Decode side of a router-scheduled push: a spec carrying
+        ``kv_wait`` was dispatched while its KV blocks were still in
+        flight from the prefill replica. Park HERE (on the engine's
+        tier-arrival event, not a poll) until the pushed import lands
+        in the pool or host tier, then admit — a zero-copy prefix hit
+        with no pull on the critical path. On timeout, fall back to an
+        adopt-time pull from the named source (counted), and failing
+        that, monolithic prefill — never a client-visible error.
+        Returns None when the spec has no ``kv_wait``."""
+        src = spec.pop("kv_wait", None)
+        if not isinstance(src, dict):
+            return None
+        eng = self.engine
+        tokens = list(spec.get("prompt") or ())
+        tokens += list(spec.get("resume_tokens") or ())
+        t0 = time.monotonic()
+        landed = False
+        try:
+            landed = await eng.wait_for_kv(tokens,
+                                           self.kv_transfer_timeout_s)
+        except Exception:
+            landed = False
+        if landed:
+            return {"pushed": True,
+                    "matched_tokens": eng.kv_pool.probe(tokens),
+                    "latency_s": round(time.monotonic() - t0, 6)}
+        eng.metrics.record_kv_push_fallback()
+        if src.get("host"):
+            spec["kv_from"] = {"host": src.get("host"),
+                               "port": src.get("port")}
+            info = await self._import_from_peer(spec) or {}
+            info["push_timeout"] = True
+            return info
+        return {"fallback": "push_timeout"}
+
+    async def _kv_prepare(self, spec: dict) -> dict | None:
+        """Pre-admission KV arrival for one spec: pushed blocks
+        (``kv_wait``) first, else an adopt-time pull (``kv_from``)."""
+        info = await self._await_pushed_kv(spec)
+        if info is not None:
+            return info
+        return await self._import_from_peer(spec)
+
     async def _kv_import_frame(self, sid: int, payload,
                                sink: "wire.FrameSink") -> None:
         """Adopt a pushed KVBLK frame (the kv_import verb's frame form);
@@ -599,6 +696,8 @@ class ServingServer:
             return await self._reload(spec)
         if cmd == "kv_prefill":
             return await self._kv_prefill(spec)
+        if cmd == "kv_push":
+            return await self._kv_push(spec)
         if cmd == "kv_export":
             # Reachable only over JSONL (the bin1 handler intercepts it
             # to ship a binary KVBLK frame): the blocks cannot ride a
@@ -663,6 +762,21 @@ class ServingServer:
                     "bytes": engine.metrics.kv_migration_bytes,
                     "exports": engine.metrics.kv_exports,
                 }
+                if engine.kv_tier is not None:
+                    # Tiered-KV rollup: per-level occupancy + the
+                    # spill/readmit/push traffic — the "host tier
+                    # thrashing" runbook reads these here first.
+                    health["kv_tier"] = {
+                        **engine.kv_tier.stats(),
+                        "spills": engine.metrics.kv_spills,
+                        "spill_bytes": engine.metrics.kv_spill_bytes,
+                        "readmits": engine.metrics.kv_readmits,
+                        "readmit_bytes": engine.metrics.kv_readmit_bytes,
+                        "pushes": engine.metrics.kv_pushes,
+                        "push_bytes": engine.metrics.kv_push_bytes,
+                        "push_fallbacks":
+                            engine.metrics.kv_push_fallbacks,
+                    }
             if engine.auditor is not None:
                 health["recompile_audit"] = engine.auditor.report()
             if engine.slo_s is not None:

@@ -147,15 +147,17 @@ _F_SPECULATE = 1
 # Extras (bit1): a trailing [u32 len][JSON] blob after the trace string,
 # for the RARE spec fields the fixed header has no slot for — the
 # router's disaggregation hints (``kv_from``: which replica holds the
-# prompt's prefilled KV blocks) and migration resumes
-# (``resume_tokens``: tokens the client already received on a previous
-# replica, folded into the resume prefill). Absent on every ordinary
-# request, so the hot-path frame stays byte-identical to pre-extras
-# senders; a pre-extras DECODER rejects an extras frame typed
-# (length-mismatch WireError) — extras are only ever produced inside a
-# roles-enabled fleet, whose replicas all speak them.
+# prompt's prefilled KV blocks; ``kv_wait``: the blocks are being PUSHED
+# here — park on arrival, pulling from the named source only on
+# timeout) and migration resumes (``resume_tokens``: tokens the client
+# already received on a previous replica, folded into the resume
+# prefill). Absent on every ordinary request, so the hot-path frame
+# stays byte-identical to pre-extras senders; a pre-extras DECODER
+# rejects an extras frame typed (length-mismatch WireError) — extras
+# are only ever produced inside a roles-enabled fleet, whose replicas
+# all speak them.
 _F_EXTRAS = 2
-_EXTRA_KEYS = ("kv_from", "resume_tokens")
+_EXTRA_KEYS = ("kv_from", "kv_wait", "resume_tokens")
 
 
 class WireError(ValueError):

@@ -79,7 +79,13 @@ _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     # hides — it regresses UP, while the A/B's goodput
                     # and speedup_x regress DOWN (higher-is-better by
                     # default).
-                    "host_gap", "device_idle")
+                    "host_gap", "device_idle",
+                    # Tiered-KV rows (serving/kvtier_*): spill/re-admit
+                    # latency tails regress UP; hit rates, hit-rate
+                    # gain, ttft speedup, and the push-vs-pull bytes
+                    # saved regress DOWN (higher-is-better by default,
+                    # ttft_p99_s itself already matches "ttft" above).
+                    "spill_latency", "readmit_latency")
 
 
 def lower_is_better(key: str) -> bool:

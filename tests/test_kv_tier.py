@@ -1,0 +1,466 @@
+"""Tiered fleet-scale KV cache: host-RAM spill tier + fleet directory.
+
+The invariants under test:
+
+- ``HostKVTier`` unit behavior (jax-free): budget + watermark LRU
+  eviction, inclusive-cache gets, disk demotion/promotion round trips
+  **bitwise**, flush drops entries but keeps lifetime counters;
+- **spill → re-admit is bitwise**: blocks evicted from the device pool
+  spill to the host tier as exact KVX1 bytes and scatter back H2D on
+  the next prefix hit — the re-exported device rows are byte-identical
+  to the spilled payloads, greedy output stays token-identical, and an
+  ARMED ``RecompileAuditor`` proves decode never retraced (tp-sharded
+  pool included);
+- a pool-dry admission whose prefix lives in the host tier is served
+  from the tier **without preempting** anything;
+- router-scheduled **push transfers** (``kv_push``): a real
+  prefill+decode fleet with push scheduling stays token-identical under
+  armed auditors, the decode side's done record shows the pushed
+  arrival, and a repeat request hits the fleet cache **directory**
+  (transfer skipped, bytes-saved counted);
+- tier-owner death: the supervisor's death callback drops the dead
+  replica's directory claims (counted) and the next request falls back
+  to monolithic prefill with **zero client-visible errors**;
+- a fully-parked tier-pending admission wakes on the scheduler's
+  tier-arrival EVENT (no ``pool.version`` polling);
+- the tier is observable: gauges/counters in the registry, a
+  ``kv_tier`` section in engine debugz, and the debugz text formatter
+  renders it.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.serving.kv_tier import HostKVTier
+
+VOCAB = 64
+SUP = dict(health_interval_s=0.05, health_timeout_s=2.0, fail_after=2,
+           base_delay_s=0.05, max_delay_s=1.0, stable_after_s=0.5)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from distkeras_tpu.models.bert import gpt_tiny
+
+    model = gpt_tiny(seq_len=64, vocab_size=VOCAB)
+    return model, model.init(0)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(23)
+
+
+def _prompt(rng, n):
+    return rng.integers(0, VOCAB, size=(n,)).tolist()
+
+
+def _ref(lm, prompt, n):
+    from distkeras_tpu.inference.generate import generate
+
+    model, variables = lm
+    return generate(model, variables, np.asarray([prompt], np.int32),
+                    n, greedy=True)[0].tolist()
+
+
+def _engine(lm, **kw):
+    from distkeras_tpu.serving import ServingEngine
+
+    model, variables = lm
+    kw.setdefault("slots", 1)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("kv_pool_blocks", 5)
+    kw.setdefault("kv_block_tokens", 4)
+    kw.setdefault("kv_host_tier_mb", 4.0)
+    return ServingEngine(model, variables, **kw)
+
+
+async def _run(engine, coro):
+    task = asyncio.create_task(engine.run())
+    try:
+        return await coro
+    finally:
+        engine.shutdown(drain=True)
+        await task
+
+
+async def _kv_op(fn, arg):
+    event, result = fn(arg)
+    await asyncio.wait_for(event.wait(), 30)
+    return result
+
+
+# -- HostKVTier units (jax-free) ---------------------------------------------
+
+def _pay(n, fill):
+    return bytes([fill]) * n
+
+
+def test_tier_budget_watermark_and_inclusive_get():
+    tier = HostKVTier(1000, 4, watermark=0.5)
+    keys = [[i, i, i, i] for i in range(5)]
+    for i, k in enumerate(keys):
+        assert tier.put(k, _pay(200, i))
+    # 5 x 200 = budget exactly: nothing evicted yet.
+    assert tier.stats()["host_entries"] == 5
+    # Touch key 0 so it is MRU, then push past the budget: eviction
+    # runs down to the 500-byte watermark, keeps the protected insert
+    # and the recently-used entry, drops the LRU middle.
+    assert tier.get(keys[0]) == _pay(200, 0)
+    assert tier.put([9, 9, 9, 9], _pay(200, 9))
+    s = tier.stats()
+    assert s["host_bytes"] <= 500
+    assert tier.contains([9, 9, 9, 9])       # protected insert survives
+    assert tier.contains(keys[0])            # MRU survives
+    assert not tier.contains(keys[1])        # LRU evicted
+    assert s["evictions"] >= 3
+    # Inclusive cache: get() leaves the entry resident.
+    assert tier.get(keys[0]) == _pay(200, 0)
+    assert tier.contains(keys[0])
+    # An oversize payload is refused outright, never evicts the world.
+    assert not tier.put([8, 8, 8, 8], _pay(2000, 1))
+    # probe() counts contiguous complete blocks from the root.
+    t2 = HostKVTier(1000, 2)
+    t2.put([1, 2], b"a")
+    t2.put([1, 2, 3, 4], b"b")
+    assert t2.probe([1, 2, 3, 4, 5, 6]) == 2  # third block absent
+    assert t2.probe([7, 8, 3, 4]) == 0
+
+
+def test_tier_disk_demotion_promotion_bitwise_and_flush(tmp_path):
+    tier = HostKVTier(400, 4, disk_dir=str(tmp_path),
+                      disk_budget_bytes=1000, watermark=0.5)
+    blobs = {i: bytes(np.random.default_rng(i).integers(
+        0, 256, 150, dtype=np.uint8)) for i in range(4)}
+    for i in range(4):
+        tier.put([i] * 4, blobs[i])
+    s = tier.stats()
+    # Crossing 400 bytes demoted LRU entries to disk files.
+    assert s["demotions"] >= 1 and s["disk_entries"] >= 1
+    assert list(tmp_path.glob("kvx-*.bin"))
+    # A disk hit reads back BITWISE and promotes to host RAM.
+    demoted = [i for i in range(4) if not tier._host.get(tuple([i] * 4))]
+    i = demoted[0]
+    assert tier.get([i] * 4) == blobs[i]
+    assert tier.stats()["promotions"] == 1
+    assert tuple([i] * 4) in tier._host
+    # flush() empties both levels, unlinks files, keeps lifetime stats.
+    before = tier.stats()
+    dropped = tier.flush()
+    assert dropped == before["host_entries"] + before["disk_entries"]
+    s = tier.stats()
+    assert s["host_entries"] == s["disk_entries"] == 0
+    assert not list(tmp_path.glob("kvx-*.bin"))
+    assert s["demotions"] == before["demotions"]  # counters survive
+    assert s["flushes"] == 1
+
+
+# -- engine level: spill -> re-admit -----------------------------------------
+
+def test_spill_readmit_bitwise_token_identical_armed_auditor(lm, rng):
+    """THE tentpole invariant: pool pressure evicts a hot chain to the
+    host tier; the next request on that prefix re-admits it H2D and the
+    device rows are BITWISE the spilled bytes — token-identical output,
+    zero preemptions, and the armed auditor proves decode (and the
+    tier's gather/scatter traffic) never retraced it."""
+    from distkeras_tpu.serving.kv_transfer import deserialize_blocks
+    from distkeras_tpu.telemetry import RecompileAuditor
+
+    auditor = RecompileAuditor()
+    # 5 blocks x 4 tokens: a finished 15-token sequence adopts 3
+    # blocks, so b's from-scratch admission must evict a's chain.
+    engine = _engine(lm, auditor=auditor, arm_auditor_after_warmup=True)
+    a, b = _prompt(rng, 11), _prompt(rng, 11)
+    wa, wb = _ref(lm, a, 4), _ref(lm, b, 4)
+
+    async def drive():
+        outs = [await engine.submit(a, 4).result(),
+                await engine.submit(b, 4).result()]
+        # a's chain was evicted under b's admission: it lives in the
+        # tier now, keyed by full token chains.
+        assert engine.metrics.kv_spills >= 2
+        tier = engine.kv_tier
+        spilled = {k: tier.get(a[:(k + 1) * 4])
+                   for k in range(2) if tier.contains(a[:(k + 1) * 4])}
+        assert spilled, "nothing of a's chain reached the tier"
+        outs.append(await engine.submit(a, 4).result())
+        # Re-admitted blocks counted as the prefix hits they are.
+        assert engine.metrics.kv_readmits >= 1
+        assert engine.kv_pool.hit_tokens >= 4
+        assert engine.metrics.preemptions == 0
+        # Bitwise: export a's device-resident chain and compare each
+        # re-admitted block's rows against its spilled payload.
+        res = await _kv_op(engine.request_kv_export, a)
+        _, ex_leaves = deserialize_blocks(res["payload"])
+        for k, payload in spilled.items():
+            _, sp_leaves = deserialize_blocks(payload)
+            for sp, ex in zip(sp_leaves, ex_leaves):
+                assert sp[0].tobytes() == ex[k].tobytes()
+        return outs
+
+    outs = asyncio.run(_run(engine, drive()))
+    assert outs == [wa, wb, wa]
+    assert auditor.compiles("serving_decode") == 1
+    assert auditor.report()["serving_decode"]["armed"]
+
+
+def test_sharded_pool_spill_readmit_round_trip(lm, rng):
+    """The tier under a tp=2 pool: spilled payloads carry full heads
+    (the kv_transfer contract), re-admission reshards on upload, and
+    greedy output stays token-identical."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices for tp=2")
+    from distkeras_tpu.parallel.mesh import serving_mesh
+
+    engine = _engine(lm, mesh=serving_mesh({"tp": 2},
+                                           devices=jax.devices()[:2]))
+    a, b = _prompt(rng, 11), _prompt(rng, 11)
+    wa, wb = _ref(lm, a, 4), _ref(lm, b, 4)
+
+    async def drive():
+        outs = [await engine.submit(a, 4).result(),
+                await engine.submit(b, 4).result()]
+        assert engine.metrics.kv_spills >= 1
+        outs.append(await engine.submit(a, 4).result())
+        assert engine.metrics.kv_readmits >= 1
+        return outs
+
+    assert asyncio.run(_run(engine, drive())) == [wa, wb, wa]
+
+
+def test_pool_dry_admission_served_from_tier_without_preemption(lm, rng):
+    """A request whose prefix sits in the host tier must be served by
+    re-admission (adopt + H2D scatter), never by preempting running
+    slots — adoption only reclaims unreferenced leaves."""
+    engine = _engine(lm)
+    a = _prompt(rng, 11)
+    fillers = [_prompt(rng, 11) for _ in range(2)]
+
+    async def drive():
+        outs = [await engine.submit(a, 4).result()]
+        for f in fillers:  # churn the pool dry of a's chain
+            outs.append(await engine.submit(f, 4).result())
+        outs.append(await engine.submit(a, 4).result())
+        return outs
+
+    outs = asyncio.run(_run(engine, drive()))
+    want = [_ref(lm, a, 4)] + [_ref(lm, f, 4) for f in fillers]
+    assert outs == want + [want[0]]
+    assert engine.metrics.kv_readmits >= 1
+    assert engine.metrics.kv_readmit_bytes > 0
+    assert engine.metrics.preemptions == 0
+
+
+def test_tier_flushes_on_weight_swap(lm, rng):
+    """KV is a pure function of (weights, tokens): a weight swap must
+    flush the host tier with the device pool — stale spilled bytes
+    would poison every later re-admit."""
+    import jax
+
+    model, variables = lm
+    engine = _engine(lm)
+    prompt = _prompt(rng, 11)
+
+    async def drive():
+        await engine.submit(prompt, 4).result()
+        await engine.submit(_prompt(rng, 11), 4).result()  # force spill
+        assert engine.kv_tier.stats()["host_entries"] > 0
+        new = jax.tree.map(lambda x: x, variables)
+        event, result = engine.request_param_swap(new)
+        await asyncio.wait_for(event.wait(), 30)
+        assert "error" not in result
+        assert engine.kv_tier.stats()["host_entries"] == 0
+        assert engine.kv_tier.stats()["flushes"] == 1
+        # Post-swap service is correct (re-prefill, no stale bytes).
+        return await engine.submit(prompt, 4).result()
+
+    assert asyncio.run(_run(engine, drive())) == _ref(lm, prompt, 4)
+
+
+# -- scheduler: tier-arrival event (no pool.version polling) -----------------
+
+def test_parked_tier_pending_wakes_on_kv_arrival_event():
+    from distkeras_tpu.serving.scheduler import Scheduler
+
+    async def main():
+        sched = Scheduler(max_depth=4)
+        waiter = asyncio.create_task(sched.wait_for_kv_arrival(5.0))
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        # A plain kick targets the request-arrival path, NOT the tier
+        # event: the parked tier-pending head must not thundering-herd
+        # on every wake.
+        sched.kick()
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        t0 = asyncio.get_running_loop().time()
+        sched.note_kv_arrival()
+        assert await waiter is True
+        assert asyncio.get_running_loop().time() - t0 < 1.0
+        # note_kv_arrival also wakes the generic wake path (a parked
+        # NON-tier head must see freed blocks from a spill-evict too).
+        waiter2 = asyncio.create_task(sched.wait_for_wake(5.0))
+        await asyncio.sleep(0.01)
+        sched.note_kv_arrival()
+        await asyncio.wait_for(waiter2, 1.0)
+
+    asyncio.run(main())
+
+
+# -- fleet: push scheduling + directory --------------------------------------
+
+def _roles_cluster(lm, roles, registry=None, auditors=None,
+                   router_kwargs=None, **engine_kw):
+    from distkeras_tpu.serving import LocalReplica, ServingCluster
+    from distkeras_tpu.telemetry import RecompileAuditor
+
+    def factory(i):
+        def build():
+            kw = dict(slots=2, kv_pool_blocks=64, kv_block_tokens=4,
+                      kv_host_tier_mb=4.0)
+            kw.update(engine_kw)
+            if auditors is not None:
+                auditors[i] = RecompileAuditor()
+                kw.update(auditor=auditors[i],
+                          arm_auditor_after_warmup=True)
+            return _engine(lm, max_queue=16, **kw)
+
+        return LocalReplica(build)
+
+    kwargs = {"affinity_tokens": 4, "min_handoff_tokens": 4}
+    kwargs.update(router_kwargs or {})
+    return ServingCluster(factory, len(roles), roles=roles,
+                          registry=registry, supervisor_kwargs=SUP,
+                          router_kwargs=kwargs)
+
+
+def test_push_scheduled_transfer_token_identical_and_directory_hit(
+        lm, rng):
+    """Push mode end to end on REAL engines: the router schedules a
+    P→D push after the handoff, the decode replica parks on kv_wait
+    until the pushed import lands (no pull), output is token-identical
+    under armed auditors — and the SAME family's next request skips the
+    transfer entirely via the fleet cache directory."""
+    from distkeras_tpu.serving import ServingClient
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    async def main():
+        registry = MetricsRegistry()
+        auditors = {}
+        cluster = _roles_cluster(lm, ["prefill", "decode"],
+                                 registry=registry, auditors=auditors,
+                                 router_kwargs={"kv_push": True})
+        prompt = _prompt(rng, 12)
+        ref = _ref(lm, prompt, 6)
+        async with cluster:
+            async with ServingClient("127.0.0.1", cluster.port,
+                                     wire_mode="auto") as c:
+                done = await c.generate(prompt, 6)
+                assert done["tokens"] == ref
+                km = done.get("kv_migration") or {}
+                assert km.get("pushed") is True, km
+                assert "fallback" not in km
+                done2 = await c.generate(prompt, 6)
+                assert done2["tokens"] == ref
+            snap = registry.snapshot()
+            assert snap["router_kv_pushes_total"]["value"] >= 1
+            assert snap["router_kv_push_fallbacks_total"]["value"] == 0
+            assert snap["router_kv_push_bytes_total"]["value"] > 0
+            # Second request: directory found the decode replica
+            # already holding the family — transfer skipped, counted.
+            assert snap["router_kv_directory_hits_total"]["value"] >= 1
+            assert snap["router_kv_push_bytes_saved_total"]["value"] > 0
+            for rid, info in cluster.replicas.items():
+                assert info.handle.engine.decode_compile_count() in (
+                    0, 1), rid
+            stats = cluster.router.kv_directory_stats()
+            assert stats["families"] >= 1 and stats["holders"] >= 2
+
+    asyncio.run(main())
+
+
+def test_tier_owner_death_counted_fallback_zero_client_errors():
+    """Kill the directory's tier owner (the prefill replica) between
+    requests: its directory claims drop via the supervisor death
+    callback (counted), and the next request completes by monolithic
+    re-prefill — a counted fallback, never a client-visible error."""
+    from distkeras_tpu.serving import ServingClient, ServingCluster
+    from distkeras_tpu.serving.cluster.replicas import EchoReplica
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    async def _wait_until(cond, timeout=30.0, what="condition"):
+        t0 = asyncio.get_running_loop().time()
+        while not cond():
+            if asyncio.get_running_loop().time() - t0 > timeout:
+                raise AssertionError(f"timed out waiting for {what}")
+            await asyncio.sleep(0.02)
+
+    async def main():
+        registry = MetricsRegistry()
+        cluster = ServingCluster(
+            lambda i: EchoReplica(kv_block_tokens=4),
+            2, roles=["prefill", "decode"], registry=registry,
+            supervisor_kwargs=SUP,
+            router_kwargs={"affinity_tokens": 4,
+                           "min_handoff_tokens": 4, "kv_push": True})
+        async with cluster:
+            async with ServingClient("127.0.0.1", cluster.port,
+                                     wire_mode="auto") as c:
+                done = await c.generate([5, 6, 7, 8, 9], 1)
+                assert done["tokens"] == [5]
+            await _wait_until(
+                lambda: cluster.router.kv_directory_stats()[
+                    "families"] >= 1, what="directory entry")
+            # Hard-kill the tier owner; the supervisor's death callback
+            # must invalidate its directory claims.
+            await cluster.replicas["r0"].handle.kill()
+            await _wait_until(
+                lambda: cluster.router.kv_directory_stats()[
+                    "families"] == 0, what="directory invalidation")
+            assert registry.snapshot()[
+                "router_kv_directory_evictions_total"]["value"] >= 1
+            # Requests keep completing: handoff (and push) fall back to
+            # monolithic echo while the owner is down or restarting.
+            async with ServingClient("127.0.0.1", cluster.port,
+                                     wire_mode="auto") as c:
+                for _ in range(3):
+                    done = await c.generate([5, 6, 7, 8, 9], 1)
+                    assert done["tokens"] == [5]
+                    assert "error" not in done
+
+    asyncio.run(main())
+
+
+# -- observability ------------------------------------------------------------
+
+def test_tier_observability_debugz_and_registry(lm, rng):
+    from distkeras_tpu.serving.debugz import format_debugz
+
+    engine = _engine(lm)
+    a, b = _prompt(rng, 11), _prompt(rng, 11)
+
+    async def drive():
+        await engine.submit(a, 4).result()
+        await engine.submit(b, 4).result()
+        await engine.submit(a, 4).result()
+        return engine.debugz()
+
+    dz = asyncio.run(_run(engine, drive()))
+    kt = dz["kv_tier"]
+    assert kt["spills"] >= 1 and kt["spill_bytes"] > 0
+    assert kt["readmits"] >= 1 and kt["readmit_bytes"] > 0
+    assert kt["host_budget_bytes"] == 4 * 2 ** 20
+    assert kt["resident_bytes"] >= 0
+    snap = engine.metrics.registry.snapshot()
+    for name in ("kv_tier_host_bytes", "kv_tier_host_entries",
+                 "kv_tier_resident_bytes", "kv_tier_hits_total",
+                 "kv_tier_spills_total", "kv_tier_readmits_total",
+                 "kv_pushes_total"):
+        assert name in snap, name
+    assert snap["kv_tier_spills_total"]["value"] >= 1
+    text = format_debugz(dz)
+    assert "kv_tier:" in text and "kv_tier_traffic:" in text
