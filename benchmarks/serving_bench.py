@@ -83,6 +83,16 @@ affinity picks, streams lost) plus per-replica restarts.
 — no zero-streamed request fails (retried on a survivor), the
 supervisor restarts the corpse, and the fleet is whole again at the end.
 
+``--slo`` runs the **fleet telemetry + SLO acceptance** phases on a
+3-replica fleet: a no-push baseline vs the push plane's goodput
+overhead, the router's fleet-merged TTFT/ITL p99 diffed against an
+offline recompute from every replica's raw samples (must agree within
+one histogram bucket width — the mergeable-histogram exactness
+contract end to end), and an ``inject_latency`` breach that must drive
+the burn-rate engine ``ok -> page`` with exemplar trace ids and
+recover once cleared. ``--record-history`` writes ``serving/slo_*``
+rows (push overhead, aggregation staleness, burn cost, time-to-page).
+
 Run (CPU):
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py \
         --mode both --requests 24 --slots 4 --metrics-out /tmp/serve.jsonl
@@ -1144,6 +1154,292 @@ def _record_kvtier_history(args, report):
     bench.write_history(path, hist)
 
 
+async def _slo_bench(args, report):
+    """Fleet telemetry + SLO acceptance: three phases on one fleet size.
+
+    1. **baseline** — the same N-replica cluster with the push plane
+       off (``telemetry_interval_s=0``): its goodput is the no-push
+       reference the push phase's overhead is measured against.
+    2. **push** — plane on at ``--slo-push-interval``. After the load,
+       the router's fleet-merged TTFT/ITL p99 (pushed deltas, folded
+       bucket-exact) is diffed against an offline percentile over the
+       POOLED RAW samples read straight off every replica engine's
+       sample deques — the mergeable-histogram exactness contract,
+       end to end. Tolerance: one bucket width at the offline p99
+       (inside a bucket the merged estimate interpolates; across
+       replicas the bucket counts are exact).
+    3. **breach** — ``inject_latency`` (the server's chaos verb, sent
+       replica-direct: the router doesn't forward unknown verbs) slows
+       every decode tick past the ITL objective's snapped bound. The
+       burn engine must take the fleet ``ok -> page`` with >=1 exemplar
+       trace id on the breach transitions, and recover to ``ok`` once
+       the injection is cleared and the windows drain.
+
+    Every replica arms the :class:`RecompileAuditor`: the telemetry
+    plane must add ZERO retraces (decode compile count stays 1).
+    """
+    import bisect
+    import time as _time
+
+    from distkeras_tpu.serving import (
+        LocalReplica, ServingClient, ServingCluster,
+    )
+    from distkeras_tpu.serving.cluster.replicas import send_control
+    from distkeras_tpu.serving.metrics import _LATENCY_BUCKETS, percentile
+    from distkeras_tpu.serving.slo import default_objectives
+    from distkeras_tpu.telemetry import MetricsRegistry
+    from distkeras_tpu.telemetry.registry import hist_state_percentile
+
+    model, variables = _model(args)
+
+    def replica(i):
+        return LocalReplica(
+            lambda: _make_engine(args, model, variables, arm=True))
+
+    async def drive(port, n, salt, new_tokens=None):
+        """One closed-loop round; returns (wall_s, done_tokens)."""
+        prompts = _prompts(args, n, salt)
+        it = iter(prompts)
+        tokens = 0
+
+        async def client():
+            nonlocal tokens
+            async with ServingClient("127.0.0.1", port) as c:
+                for p in it:
+                    done = await c.generate(
+                        p, new_tokens or args.new_tokens)
+                    tokens += len(done["tokens"])
+
+        t0 = _time.monotonic()
+        await asyncio.gather(*(client() for _ in range(args.clients)))
+        return _time.monotonic() - t0, tokens
+
+    sup = dict(health_interval_s=0.1, base_delay_s=0.2)
+    sec: dict = {}
+    report["slo_bench"] = sec
+
+    # Phase 1: no-push baseline — a fresh fleet, the push plane's kill
+    # switch thrown, the SAME prompts the push phase will replay.
+    cluster = ServingCluster(
+        replica, args.replicas, registry=MetricsRegistry(),
+        router_kwargs={"telemetry_interval_s": 0.0},
+        supervisor_kwargs=sup)
+    async with cluster:
+        wall, tokens = await drive(cluster.port, args.requests, 0)
+    sec["baseline"] = {
+        "wall_s": round(wall, 3),
+        "goodput_tokens_per_sec": round(tokens / wall, 2),
+    }
+
+    # Phases 2 + 3 share one fleet with the plane on. Windows are
+    # bench-scaled (seconds, not SRE minutes/hours) so the breach pages
+    # — and recovery drains — inside a CPU demo run's patience.
+    slow_window_s = 4.0
+    cluster = ServingCluster(
+        replica, args.replicas, registry=MetricsRegistry(),
+        router_kwargs={
+            "telemetry_interval_s": args.slo_push_interval,
+            "slo_objectives": default_objectives(
+                ttft_threshold_s=args.slo_ttft_threshold,
+                itl_threshold_s=args.slo_itl_threshold),
+            "slo_kwargs": {"fast_window_s": 1.0,
+                           "slow_window_s": slow_window_s},
+        },
+        supervisor_kwargs=sup)
+    async with cluster:
+        router = cluster.router
+        wall, tokens = await drive(cluster.port, args.requests, 0)
+        goodput = tokens / wall
+        base_gp = sec["baseline"]["goodput_tokens_per_sec"]
+        sec["push"] = {
+            "wall_s": round(wall, 3),
+            "goodput_tokens_per_sec": round(goodput, 2),
+            # Clamped at 0: CPU A/B noise routinely makes the push side
+            # FASTER, and a negative overhead row would train the drift
+            # gate on noise.
+            "push_overhead_pct": round(
+                max(0.0, (base_gp - goodput) / base_gp * 100.0), 3),
+        }
+
+        engines = [info.handle.engine
+                   for info in cluster.replicas.values()
+                   if getattr(info.handle, "engine", None) is not None]
+
+        async def settled(name, n_raw):
+            # Wait until every raw sample has been pushed and folded
+            # (the plane is asynchronous; counts converge within a few
+            # cadences once the load stops).
+            deadline = _time.monotonic() + 10.0
+            st = None
+            while _time.monotonic() < deadline:
+                st = router.fleet.fleet_hist_state(name)
+                if st is not None and st.get("count", 0) >= n_raw:
+                    break
+                await asyncio.sleep(args.slo_push_interval)
+            return st
+
+        agg: dict = {}
+        for label, metric, attr in (
+                ("ttft", "serving_ttft_seconds", "ttft"),
+                ("itl", "serving_inter_token_seconds", "inter_token")):
+            xs = [float(x) for eng in engines
+                  for x in getattr(eng.metrics, attr)]
+            st = await settled(metric, len(xs))
+            assert st is not None and xs, f"no fleet samples for {metric}"
+            fleet_p99 = hist_state_percentile(st, 99)
+            off_p99 = percentile(xs, 99)
+            bounds = list(_LATENCY_BUCKETS)
+            bi = bisect.bisect_left(bounds, off_p99)
+            lo = bounds[bi - 1] if bi > 0 else 0.0
+            hi = bounds[bi] if bi < len(bounds) else 2 * bounds[-1]
+            err = abs(fleet_p99 - off_p99)
+            agg[label] = {
+                "fleet_p99_s": round(fleet_p99, 6),
+                "offline_p99_s": round(off_p99, 6),
+                "abs_err_s": round(err, 6),
+                "bucket_width_s": round(hi - lo, 6),
+                "samples": len(xs),
+                "merged_count": int(st.get("count", 0)),
+            }
+            assert err <= (hi - lo) + 1e-9, (
+                f"fleet-merged {label} p99 {fleet_p99:.6f}s is more "
+                f"than one bucket width ({hi - lo:.6f}s) from the "
+                f"offline recompute {off_p99:.6f}s over {len(xs)} raw "
+                f"samples")
+        stats = router.telemetry_stats()
+        agg["staleness_s"] = stats.get("staleness_s")
+        agg["pushes"] = stats.get("pushes")
+        agg["push_errors"] = stats.get("push_errors")
+        agg["push_subscriptions"] = stats.get("push_subscriptions")
+        sec["aggregation"] = agg
+
+        async with ServingClient("127.0.0.1", cluster.port) as ctl:
+            async def sloz():
+                rep = await ctl._control({"cmd": "sloz"})
+                return rep["sloz"]
+
+            async def poll_until(state, timeout):
+                deadline = _time.monotonic() + timeout
+                while _time.monotonic() < deadline:
+                    snap = await sloz()
+                    if snap["overall"] == state:
+                        return snap
+                    await asyncio.sleep(0.25)
+                return None
+
+            # Let any load-phase burn (e.g. first-request prefill
+            # compiles tripping the ITL objective) drain out of the
+            # windows: the ok -> page transition below must be OURS.
+            snap = await poll_until("ok", 3 * slow_window_s + 10.0)
+            assert snap is not None, (
+                f"fleet never settled to ok before the breach: "
+                f"{await sloz()}")
+
+            # Phase 3: the controlled breach.
+            for info in cluster.replicas.values():
+                await send_control(
+                    "127.0.0.1", info.port,
+                    {"cmd": "inject_latency",
+                     "decode_delay_s": args.slo_inject_delay})
+            t_inject = _time.monotonic()
+            load = asyncio.create_task(drive(
+                cluster.port, 2 * args.clients, 1,
+                new_tokens=args.slo_breach_tokens))
+            try:
+                paged = await poll_until(
+                    "page", 6 * slow_window_s + 30.0)
+            finally:
+                await load
+            assert paged is not None, (
+                "injected latency never drove the fleet to page")
+            time_to_page = _time.monotonic() - t_inject
+            breaches = [e for e in paged["events"]
+                        if e["to"] in ("warn", "page")]
+            exemplars = sorted({x for e in breaches
+                                for x in e.get("exemplars") or ()})
+            assert exemplars, (
+                f"no exemplar trace ids on the breach transitions: "
+                f"{breaches}")
+
+            # Clear the injection; the windows must drain back to ok.
+            for info in cluster.replicas.values():
+                await send_control("127.0.0.1", info.port,
+                                   {"cmd": "inject_latency",
+                                    "decode_delay_s": 0.0})
+            recovered = await poll_until("ok", 6 * slow_window_s + 30.0)
+            assert recovered is not None, (
+                "fleet never recovered to ok after the injection was "
+                "cleared")
+            final = await sloz()
+            sec["breach"] = {
+                "inject_delay_s": args.slo_inject_delay,
+                "time_to_page_s": round(time_to_page, 3),
+                "exemplars": exemplars[:8],
+                "transitions": [
+                    {k: e[k] for k in ("objective", "from", "to")}
+                    for e in final["events"]],
+                "recovered": True,
+            }
+            evals = max(1, final["evaluations"])
+            sec["burn_engine"] = {
+                "evaluations": final["evaluations"],
+                "eval_cost_s": final["eval_cost_s"],
+                "burn_overhead_per_eval_s": round(
+                    final["eval_cost_s"] / evals, 9),
+            }
+
+        # The standing invariant: the telemetry plane added no retraces.
+        compiles = {
+            rid: info.handle.engine.decode_compile_count()
+            for rid, info in cluster.replicas.items()
+            if info.handle.engine is not None
+        }
+        sec["decode_compile_count"] = compiles
+        assert all(c in (1, -1, 0) for c in compiles.values()), compiles
+
+
+def _record_slo_history(args, report):
+    """``serving/slo_*`` rows for the strict CI gate: push overhead and
+    aggregation staleness (both regress UP), the fleet-merged latency
+    percentiles and their offline-recompute error (UP), the burn
+    engine's per-evaluation cost and time-to-page (UP), and the push
+    phase's goodput (DOWN)."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    sec = report.get("slo_bench") or {}
+    push = sec.get("push") or {}
+    agg = sec.get("aggregation") or {}
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    base = (f"serving/slo_{args.model}/replicas{args.replicas}"
+            f"/slots{args.slots}")
+    rows = {
+        "goodput_tokens_per_sec": push.get("goodput_tokens_per_sec"),
+        "push_overhead_pct": push.get("push_overhead_pct"),
+        "staleness_s": agg.get("staleness_s"),
+        "time_to_page_s": (sec.get("breach") or {}).get("time_to_page_s"),
+        "burn_overhead_per_eval_s": (sec.get("burn_engine") or {}).get(
+            "burn_overhead_per_eval_s"),
+    }
+    for label in ("ttft", "itl"):
+        d = agg.get(label) or {}
+        rows[f"{label}_p99_fleet_s"] = d.get("fleet_p99_s")
+        rows[f"{label}_p99_abs_err_s"] = d.get("abs_err_s")
+    for metric, v in rows.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            key = f"{base}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
 def _record_history(args, report):
     """Append this run's headline numbers to ``bench_history.json`` under
     ``serving/...`` keys, via ``bench.py``'s shared ``history_entry`` /
@@ -1479,6 +1775,39 @@ def main():
                          "pool-only on BOTH prefix hit rate and p99 "
                          "TTFT (the acceptance gate); default is "
                          "report-only")
+    ap.add_argument("--slo", action="store_true",
+                    help="fleet telemetry + SLO acceptance: a no-push "
+                         "baseline fleet vs the same fleet with the "
+                         "telemetry push plane on (goodput overhead), "
+                         "fleet-merged TTFT/ITL p99 checked against an "
+                         "offline recompute from every replica's raw "
+                         "samples (within one bucket width), then an "
+                         "injected-latency breach that must take the "
+                         "burn engine ok -> page with exemplar trace "
+                         "ids and recover; records serving/slo_* rows")
+    ap.add_argument("--slo-push-interval", type=float, default=0.1,
+                    help="--slo: replica->router telemetry push cadence "
+                         "(seconds)")
+    ap.add_argument("--slo-ttft-threshold", type=float, default=30.0,
+                    help="--slo: TTFT objective threshold (seconds; "
+                         "generous by default so a CPU fleet's healthy "
+                         "phase stays ok)")
+    ap.add_argument("--slo-itl-threshold", type=float, default=2.0,
+                    help="--slo: inter-token objective threshold "
+                         "(seconds; the breach objective — "
+                         "--slo-inject-delay must exceed its snapped "
+                         "bucket bound)")
+    ap.add_argument("--slo-inject-delay", type=float, default=3.0,
+                    help="--slo: per-decode-tick delay (seconds) the "
+                         "breach phase injects on every replica via the "
+                         "inject_latency chaos verb")
+    ap.add_argument("--slo-breach-tokens", type=int, default=4,
+                    help="--slo: tokens per breach-phase request (small: "
+                         "each decode tick costs --slo-inject-delay)")
+    ap.add_argument("--slo-strict", action="store_true",
+                    help="--slo: assert telemetry push overhead <= 2%% "
+                         "of baseline goodput (CPU A/B goodput is "
+                         "noisy; default is report-only)")
     ap.add_argument("--record-history", action="store_true",
                     help="append serving/* rows to bench_history.json for "
                          "scripts/check_bench_regression.py")
@@ -1589,6 +1918,33 @@ def main():
                     args.trace_out)
         if args.record_history:
             _record_kvtier_history(args, report)
+        print(json.dumps(report, indent=1))
+        return
+
+    if args.slo:
+        # Fleet telemetry + SLO acceptance: its own phases, its own
+        # rows. Needs a fleet (the point is the MERGE) — default 3.
+        args.replicas = max(args.replicas, 3)
+        report["config"]["replicas"] = args.replicas
+        report["config"]["slo"] = {
+            "push_interval_s": args.slo_push_interval,
+            "ttft_threshold_s": args.slo_ttft_threshold,
+            "itl_threshold_s": args.slo_itl_threshold,
+            "inject_delay_s": args.slo_inject_delay,
+        }
+        try:
+            asyncio.run(_slo_bench(args, report))
+            if args.slo_strict:
+                pct = report["slo_bench"]["push"]["push_overhead_pct"]
+                assert pct <= 2.0, (
+                    f"telemetry push overhead {pct}% > 2% of the "
+                    f"no-push baseline goodput")
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        if args.record_history:
+            _record_slo_history(args, report)
         print(json.dumps(report, indent=1))
         return
 

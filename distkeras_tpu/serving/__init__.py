@@ -58,6 +58,11 @@ from distkeras_tpu.serving.scheduler import (
 )
 from distkeras_tpu.serving.kv_transfer import KVTransferError
 from distkeras_tpu.serving.metrics import ServingMetrics
+from distkeras_tpu.serving.slo import (
+    Objective,
+    SLOEngine,
+    default_objectives,
+)
 from distkeras_tpu.serving.prefix_cache import KVBlockPool, PrefixCache
 from distkeras_tpu.serving.engine import ServingEngine
 from distkeras_tpu.serving.server import ServingServer
@@ -93,4 +98,7 @@ __all__ = [
     "TenantOverQuota",
     "TenantQuota",
     "KVTransferError",
+    "SLOEngine",
+    "Objective",
+    "default_objectives",
 ]

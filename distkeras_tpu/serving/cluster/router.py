@@ -27,8 +27,22 @@ changing nothing but the port. Per generation request the router:
 Control verbs aggregate across the fleet: ``healthz`` returns the
 replica table plus each live replica's own healthz; ``metricsz`` returns
 the router's registry plus each replica's snapshot keyed by replica id
-(``format="prometheus"`` returns the ROUTER's page — per-replica pages
-need per-replica scrape targets, which the table's host/port provides).
+(``format="prometheus"`` returns the router's page FOLLOWED by the
+fleet-merged page built from pushed replica histograms — one scrape
+target covers the fleet; the table's host/port still provides
+per-replica targets for drill-down).
+
+**Fleet telemetry plane** (PR 17): instead of poll-time aggregation on
+hot signals, each replica PUSHES compact metric deltas to the router on
+a cadence — a ``telemetry_start`` control frame opens one long-lived
+stream per bin1 replica and ``T_TELEM`` frames ride the existing mux;
+JSONL-only replicas are polled with the ``telemetryz`` verb on the same
+cadence. The router folds deltas into fleet-level mergeable histograms
+(:class:`~distkeras_tpu.telemetry.timeseries.FleetAggregator`), keeps
+windowed aggregates in a ring-buffer store, and runs an SRE-style SLO
+burn-rate engine (:mod:`distkeras_tpu.serving.slo`) over them — the
+``sloz`` verb serves its state machine, burn rates, and breach
+exemplars; ``healthz`` carries the one-word overall state.
 
 ``{"cmd": "reload", "weights": path}`` performs the **zero-downtime
 rolling reload**: one replica at a time is marked DRAINING (the router
@@ -54,7 +68,12 @@ from distkeras_tpu.serving.cluster.replicas import (
     ReplicaInfo,
 )
 from distkeras_tpu.serving.cluster.supervisor import ReplicaSupervisor
+from distkeras_tpu.serving.slo import SLOEngine
 from distkeras_tpu.telemetry import span
+from distkeras_tpu.telemetry.timeseries import (
+    FleetAggregator,
+    TimeSeriesStore,
+)
 from distkeras_tpu.telemetry.request_trace import (
     TimelineRecord,
     TraceStore,
@@ -421,6 +440,10 @@ class Router:
         kv_prefill_timeout_s: float = 60.0,
         min_handoff_tokens: int | None = None,
         kv_push: bool = False,
+        telemetry_interval_s: float = 0.25,
+        telemetry_window_s: float = 0.5,
+        slo_objectives=None,
+        slo_kwargs: dict | None = None,
     ):
         if wire_mode not in ("auto", "jsonl"):
             raise ValueError(
@@ -487,6 +510,20 @@ class Router:
         self._kv_directory: dict[int, dict] = {}
         self._push_tasks: set[asyncio.Task] = set()
         supervisor.on_replica_death.append(self._forget_replica)
+        # Fleet telemetry plane: replicas push metric deltas here on
+        # ``telemetry_interval_s`` (0 disables the whole plane); the
+        # aggregator folds them into fleet-merged histograms and the
+        # windowed store; the SLO engine runs burn rates over the store.
+        # Push subscriptions are keyed per incarnation — a restarted
+        # replica is re-subscribed, a JSONL-only one is polled.
+        self.telemetry_interval_s = float(telemetry_interval_s)
+        self.fleet = FleetAggregator(
+            TimeSeriesStore(window_s=float(telemetry_window_s)))
+        self.slo = SLOEngine(self.fleet.store,
+                             objectives=slo_objectives,
+                             **(slo_kwargs or {}))
+        self._telem_subs: dict[str, tuple[int, int]] = {}
+        self._telem_task: asyncio.Task | None = None
         # In-flight classic relays per replica — what the rolling
         # reload's drain-by-migration fires. rid -> set[_RelayCtl].
         self._inflight: dict[str, set] = {}
@@ -578,8 +615,19 @@ class Router:
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._handle, self.host, self._requested_port)
+        if self.telemetry_interval_s > 0:
+            self._telem_task = asyncio.get_running_loop().create_task(
+                self._telemetry_loop(), name="fleet-telemetry")
 
     async def stop(self) -> None:
+        if self._telem_task is not None:
+            self._telem_task.cancel()
+            try:
+                await self._telem_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._telem_task = None
+        self._telem_subs.clear()
         if self._server is not None:
             self._server.close()
             try:
@@ -817,6 +865,98 @@ class Router:
             raise
         self._release(info, conn, healthy=True)
         return rec
+
+    # -- fleet telemetry plane ----------------------------------------------
+    async def _telemetry_loop(self) -> None:
+        """The push plane's heartbeat: each tick (re)subscribes every
+        routable replica that lost (or never had) a push stream, polls
+        the JSONL-only ones, and runs one SLO evaluation over the
+        windowed store. Pushed deltas arrive OUTSIDE this loop (the mux
+        read loop ingests them as they land) — the tick only repairs
+        subscriptions and advances the burn-rate state machine."""
+        try:
+            while True:
+                await asyncio.gather(*(
+                    self._subscribe_or_poll(info)
+                    for info in list(self.supervisor.replicas.values())
+                    if info.status in (READY, DRAINING)),
+                    return_exceptions=True)
+                try:
+                    self.slo.evaluate()
+                except Exception:
+                    pass  # one bad evaluation must not kill the plane
+                await asyncio.sleep(self.telemetry_interval_s)
+        except asyncio.CancelledError:
+            pass
+
+    async def _subscribe_or_poll(self, info: ReplicaInfo) -> None:
+        """Ensure one telemetry feed from this replica incarnation:
+        prefer a push subscription over its bin1 mux (negotiating the
+        mux on first contact — the plane wants the channel up before
+        the first request anyway); fall back to one ``telemetryz`` poll
+        for JSONL replicas. A dead mux clears the subscription (the
+        handler sees ``None``), so the next tick re-subscribes."""
+        live = (info.port, info.generation)
+        if self._telem_subs.get(info.rid) == live:
+            return
+        try:
+            mux = await self._get_mux(info)
+        except Exception:
+            mux = None
+        if mux is not None and not mux.dead:
+            try:
+                self._subscribe_telemetry(info, mux)
+                return
+            except _BackendLost:
+                pass
+        await self._poll_telemetry(info)
+
+    def _subscribe_telemetry(self, info: ReplicaInfo,
+                             mux: _BackendMux) -> None:
+        """Open the long-lived push stream: one mux sid whose handler
+        folds every T_TELEM frame into the fleet aggregator. The
+        replica's ``telemetry_start`` task pushes deltas on this sid
+        until the connection dies — no per-delta round trip, no
+        router-side poll on the hot path."""
+        rid, role = info.rid, info.role
+        live = (info.port, info.generation)
+
+        def handler(ftype, payload):
+            if ftype == wire.T_TELEM:
+                try:
+                    self.fleet.ingest(rid, role, json.loads(payload))
+                except Exception:
+                    pass  # counted by the aggregator where possible
+            elif ftype is None and self._telem_subs.get(rid) == live:
+                del self._telem_subs[rid]  # next tick re-subscribes
+            # T_CTRLR: the telemetry_start ack — nothing to do.
+
+        sid = mux.open(handler)
+        mux.enqueue(wire.encode_json_frame(
+            wire.T_CTRL, sid,
+            {"cmd": "telemetry_start",
+             "interval_s": self.telemetry_interval_s}))
+        self._telem_subs[rid] = live
+
+    async def _poll_telemetry(self, info: ReplicaInfo) -> None:
+        """JSONL fallback: one ``telemetryz`` delta pull. The replica
+        keeps one dedicated encoder for this verb, so the delta stream
+        stays correct with the router as its single poller."""
+        try:
+            rep = await self._backend_control(
+                info, {"cmd": "telemetryz"}, timeout=2.0)
+        except (OSError, ValueError, asyncio.TimeoutError, _BackendLost):
+            return  # health probing owns failure detection
+        payload = rep.get("telemetryz")
+        if isinstance(payload, dict):
+            self.fleet.ingest(info.rid, info.role, payload)
+
+    def telemetry_stats(self) -> dict:
+        """Aggregation rollup for healthz/debugz/sloz."""
+        out = self.fleet.stats()
+        out["push_subscriptions"] = len(self._telem_subs)
+        out["interval_s"] = self.telemetry_interval_s
+        return out
 
     # -- request path -------------------------------------------------------
     async def _handle(self, reader: asyncio.StreamReader,
@@ -1275,7 +1415,13 @@ class Router:
         """Supervisor death hook: drop every directory claim the dead
         incarnation made — entries it owned and copies it held. Lazy
         lookup validation catches generation bumps; this catches death
-        promptly so dispatches stop steering adoptions at a corpse."""
+        promptly so dispatches stop steering adoptions at a corpse.
+        Also tears down the dead incarnation's telemetry: its push
+        subscription (re-opened against the restart) and its gauge
+        series (counters/histograms are monotone fleet history and
+        stay; a corpse's gauges would read as live state forever)."""
+        self._telem_subs.pop(rid, None)
+        self.fleet.forget_replica(rid)
         dropped = 0
         for fam in list(self._kv_directory):
             entry = self._kv_directory[fam]
@@ -1672,6 +1818,12 @@ class Router:
             if versions:
                 router["weight_versions"] = versions
                 router["mixed_weight_versions"] = len(versions) > 1
+            if self.telemetry_interval_s > 0:
+                router["slo"] = self.slo.overall()
+                router["telemetry"] = self.telemetry_stats()
+            crash = self.supervisor.last_crash_summary()
+            if crash is not None:
+                router["last_crash"] = crash
             return {"healthz": {
                 "router": router,
                 "replicas": replicas,
@@ -1680,10 +1832,14 @@ class Router:
             if spec.get("format") == "prometheus":
                 from distkeras_tpu.telemetry import prometheus_text
 
-                if self.registry is None:
-                    return {"error": "router has no metrics registry",
-                            "code": "bad_request"}
-                return {"metricsz": prometheus_text(self.registry)}
+                # The router's own page followed by the fleet-merged
+                # page (per-replica AND fleet="all" series folded from
+                # pushed deltas) — one scrape target for the fleet.
+                pages = []
+                if self.registry is not None:
+                    pages.append(prometheus_text(self.registry))
+                pages.append(prometheus_text(self.fleet.registry))
+                return {"metricsz": "\n".join(pages)}
             infos = list(self.supervisor.replicas.items())
             fetched = await asyncio.gather(*(
                 self._fetch_verb(info, "metricsz") for _, info in infos))
@@ -1723,7 +1879,25 @@ class Router:
                 out["router"]["trace_store"] = self.trace_store.stats()
             if self._kv_directory or self.kv_push:
                 out["router"]["kv_directory"] = self.kv_directory_stats()
+            if self.telemetry_interval_s > 0:
+                out["router"]["telemetry"] = self.telemetry_stats()
+                out["slo"] = self.slo.snapshot()
+            if self.supervisor.last_crash is not None:
+                # The most recent crash's bounded flight-recorder dump
+                # — healthz carries the pointer, debugz carries the
+                # post-mortem itself.
+                out["last_crash"] = self.supervisor.last_crash
             return {"debugz": out}
+        if cmd == "sloz":
+            # On-demand evaluation so the page is never staler than the
+            # caller (the background loop also evaluates each tick).
+            self.fleet.store.flush()
+            try:
+                self.slo.evaluate()
+            except Exception:
+                pass
+            return {"sloz": {**self.slo.snapshot(),
+                             "aggregation": self.telemetry_stats()}}
         if cmd == "tracez":
             return await self._tracez(spec)
         if cmd == "reload":

@@ -146,6 +146,13 @@ class ReplicaSupervisor:
         # recorder "last words" dump when its handle exposes one — the
         # post-mortem trail `debugz` serves and operators grep first.
         self.restart_log: collections.deque = collections.deque(maxlen=64)
+        # The most recent crash's FULL flight-recorder dump (bounded to
+        # the final events/timelines so a chatty recorder can't bloat
+        # the supervisor): restart_log keeps one summary line per death,
+        # this keeps the one post-mortem an operator actually opens —
+        # served whole through the router's ``debugz`` and as a one-line
+        # pointer in ``healthz``.
+        self.last_crash: dict | None = None
         # The fleet's CURRENT weights path, recorded by the router's
         # rolling reload: a replica (re)started after a reload must
         # rejoin on these weights, not the factory's boot weights —
@@ -179,6 +186,14 @@ class ReplicaSupervisor:
     def restart_log_entries(self) -> list[dict]:
         return list(self.restart_log)
 
+    def last_crash_summary(self) -> dict | None:
+        """One-line pointer for ``healthz``: who crashed last, when, why,
+        and where the full dump lives (``debugz`` serves the dump)."""
+        if self.last_crash is None:
+            return None
+        return {k: self.last_crash[k]
+                for k in ("t", "rid", "why", "flight_recorder")}
+
     def _collect_last_words(self, info: ReplicaInfo, entry: dict) -> None:
         """Attach the dead replica's flight-recorder dump to its restart
         log entry: the path, plus a small summary (event/timeline counts
@@ -196,6 +211,17 @@ class ReplicaSupervisor:
                 return
             with open(path) as f:
                 dump = json.load(f)
+            self.last_crash = {
+                "t": entry["t"], "rid": info.rid, "why": entry["why"],
+                "flight_recorder": path,
+                "dump": {
+                    "source": dump.get("source"),
+                    "dumped_at": dump.get("dumped_at"),
+                    "events": dump.get("events", [])[-50:],
+                    "timelines": dump.get("timelines", [])[-20:],
+                    "slow_exemplars": dump.get("slow_exemplars", [])[-8:],
+                },
+            }
             entry["last_words"] = {
                 "source": dump.get("source"),
                 "dumped_at": dump.get("dumped_at"),

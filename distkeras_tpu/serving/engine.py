@@ -1038,6 +1038,11 @@ class ServingEngine:
                     watermark=kv_tier_watermark,
                     registry=self.metrics.registry)
                 self.kv_pool.spill_hook = self._spill_block
+                # Allocation BURSTS (a multi-block admission or import
+                # evicting several victims at once) spill through the
+                # batched hook: one D2H gather for the whole burst,
+                # mirroring _readmit_from_tier's one-scatter H2D path.
+                self.kv_pool.spill_many_hook = self._spill_blocks
             # Trace context for spill exemplars: the admission /
             # growth / import currently driving allocations.
             self._tier_trace_id: str | None = None
@@ -1311,6 +1316,12 @@ class ServingEngine:
         self._running = False
         self._stopping = False
         self._draining = True
+        # Fault-injection knob (the SLO bench's breach phase, via the
+        # ``inject_latency`` control verb): a host-side sleep per decode
+        # iteration. Purely host-time — the device work and compiled
+        # executables are untouched, so the armed auditor stays at one
+        # compile — but every slot's real ITL/TTFT stretches by it.
+        self.inject_decode_delay_s = 0.0
         # Pending parameter swap: (params, done-event, result dict) set by
         # request_param_swap(), consumed by the run loop at the first
         # iteration with no slot in flight.
@@ -2028,6 +2039,48 @@ class ServingEngine:
                 trace_id=self._tier_trace_id)
             self.scheduler.note_kv_arrival()
 
+    def _spill_blocks(self, victims) -> None:
+        """Batched pool spill hook (``spill_many_hook``): serialize a
+        whole allocation burst's eviction victims from ONE D2H gather —
+        ``victims`` is the burst's ``(chain_tokens, row)`` list, rows
+        still holding their KV bytes. The per-victim path gathers one
+        pow2-padded row per eviction; a B-victim burst paid B gathers
+        (each a full device round trip) where one batched gather over
+        the padded row vector does — the exact shape of
+        :meth:`_readmit_from_tier`'s one-scatter H2D side. Per-block
+        spill latency is recorded as the burst's share, so the
+        ``kv_tier_spill_seconds`` family directly shows the win."""
+        tier = self.kv_tier
+        if tier is None or not victims:
+            return
+        if len(victims) == 1:
+            self._spill_block(*victims[0])
+            return
+        from distkeras_tpu.serving.kv_transfer import serialize_blocks
+
+        t0 = time.monotonic()
+        bt = self.kv_block_tokens
+        n = len(victims)
+        rows = np.asarray([int(r) for _, r in victims], np.int32)
+        padded = self._pad_kv_ids(rows, fill=0)
+        gathered = self._kv_gather(self._cache, jnp.asarray(padded))
+        leaves = [np.asarray(l)[:n]
+                  for l in jax.tree.leaves(gathered) if l.ndim > 1]
+        stored: list[int] = []
+        for i, (chain_tokens, _row) in enumerate(victims):
+            chain = [int(t) for t in chain_tokens]
+            payload = serialize_blocks(
+                chain[-bt:], [l[i:i + 1] for l in leaves],
+                block_tokens=bt, provenance=self.weight_version)
+            if tier.put(chain, payload):
+                stored.append(len(payload))
+        per_block_s = (time.monotonic() - t0) / n
+        for nbytes in stored:
+            self.metrics.record_kv_spill(nbytes, per_block_s,
+                                         trace_id=self._tier_trace_id)
+        if stored:
+            self.scheduler.note_kv_arrival()
+
     def _tier_provenance_ok(self, header) -> bool:
         prov = header.get("provenance") or {}
         mine = self.weight_version
@@ -2588,6 +2641,11 @@ class ServingEngine:
                 # verify's position-0 logits. All-sampled batches (and
                 # the swap rewarm) take the one-token fallback step.
                 await self._tick_step(loop)
+                if self.inject_decode_delay_s > 0:
+                    # Injected fault (SLO bench): stretch the host side
+                    # of every iteration so observed latencies genuinely
+                    # breach — never a synthetic metric write.
+                    await asyncio.sleep(self.inject_decode_delay_s)
                 self.metrics.sample(
                     len(self.scheduler), self.active_slots, self.slots)
                 # Yield so the server can read sockets between iterations.

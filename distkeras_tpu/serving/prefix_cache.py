@@ -200,6 +200,18 @@ class _BlockTrie:
         # tier. Called while the victim's node is still intact (chain
         # reconstructible) and its pool row still holds the KV bytes.
         self.spill_hook = None
+        # Batched variant, ``hook(list[(chain_tokens, slot)])``: when
+        # set, multi-block allocation bursts (alloc(n), insert,
+        # adopt_foreign) COLLECT their victims and fire one call at the
+        # end of the burst — one D2H gather for the whole burst instead
+        # of one per victim. The victims' pool rows still hold their KV
+        # bytes at flush time: the burst only hands rows out, nothing
+        # overwrites them until the caller scatters after the grant.
+        # Takes precedence over ``spill_hook`` inside a burst;
+        # single-victim paths still use ``spill_hook`` when no burst is
+        # open.
+        self.spill_many_hook = None
+        self._spill_batch: list | None = None  # open burst's victims
 
     # -- introspection ------------------------------------------------------
     @property
@@ -395,7 +407,12 @@ class _BlockTrie:
             heapq.heappush(self._lru, item)
         if victim is None:
             return None  # everything pinned or mid-chain
-        if self.spill_hook is not None:
+        if self._spill_batch is not None:
+            # Inside a burst: collect the chain NOW (the node is about
+            # to be unlinked) and spill at the burst's end in one call.
+            self._spill_batch.append(
+                (self._chain_tokens(victim), victim.slot))
+        elif self.spill_hook is not None:
             # Spill BEFORE the node is unlinked: the hook needs the full
             # root→victim chain and the still-valid pool row. A hook
             # failure must never break allocation — the spill tier is an
@@ -410,6 +427,27 @@ class _BlockTrie:
         if self._metrics is not None:
             self._metrics["evictions"].inc()
         return victim.slot
+
+    def _begin_spill_burst(self) -> bool:
+        """Open a victim-collection burst (no-op without a batched
+        hook, or when nested inside an already-open burst). Returns
+        whether THIS call opened it — only the opener flushes."""
+        if self.spill_many_hook is None or self._spill_batch is not None:
+            return False
+        self._spill_batch = []
+        return True
+
+    def _flush_spill_burst(self) -> None:
+        """Fire the batched spill hook over the burst's victims. Runs
+        before the allocating call returns, so every victim row still
+        holds its KV bytes. Hook failures are swallowed like the
+        per-victim hook's — spilling is an optimization."""
+        batch, self._spill_batch = self._spill_batch, None
+        if batch:
+            try:
+                self.spill_many_hook(batch)
+            except Exception:  # pragma: no cover - defensive
+                pass
 
     def _note_occupancy(self) -> None:  # pragma: no cover - overridden
         pass
@@ -561,11 +599,16 @@ class PrefixCache(_BlockTrie):
             node = child
             idx += 1
         take: list[int] = []
-        for _ in keys[idx:]:
-            slot = self._alloc(protect=node)
-            if slot is None:
-                break
-            take.append(slot)
+        opened = self._begin_spill_burst()
+        try:
+            for _ in keys[idx:]:
+                slot = self._alloc(protect=node)
+                if slot is None:
+                    break
+                take.append(slot)
+        finally:
+            if opened:
+                self._flush_spill_burst()
         if not take:
             return 0
         n = len(take)
@@ -713,12 +756,20 @@ class KVBlockPool(_BlockTrie):
         if n <= 0:
             return []
         got: list[int] = []
-        while len(got) < n:
-            slot = self._alloc(protect=None)
-            if slot is None:
-                self._free.extend(got)
-                return None
-            got.append(slot)
+        opened = self._begin_spill_burst()
+        try:
+            while len(got) < n:
+                slot = self._alloc(protect=None)
+                if slot is None:
+                    self._free.extend(got)
+                    return None
+                got.append(slot)
+        finally:
+            # Flush even on shortfall: the victims were evicted either
+            # way, and their rows (returned to the free list unwritten)
+            # still hold the bytes to spill.
+            if opened:
+                self._flush_spill_burst()
         self.peak_blocks_used = max(self.peak_blocks_used, self.blocks_used)
         if self._metrics is not None:
             self._note_occupancy()
@@ -800,20 +851,25 @@ class KVBlockPool(_BlockTrie):
         now = next(self._clock)
         uploads: list[tuple[int, int]] = []
         resident = 0
-        for i, key in enumerate(keys):
-            child = node.children.get(key)
-            if child is None:
-                slot = self._alloc(protect=node)
-                if slot is None:
-                    break  # pool dry: keep the contiguous prefix
-                child = _Node(slot, node, key)
-                node.children[key] = child
-                self._by_slot[slot] = child
-                self.inserted_blocks += 1
-                uploads.append((i, slot))
-            self._touch(child, now)
-            node = child
-            resident += 1
+        opened = self._begin_spill_burst()
+        try:
+            for i, key in enumerate(keys):
+                child = node.children.get(key)
+                if child is None:
+                    slot = self._alloc(protect=node)
+                    if slot is None:
+                        break  # pool dry: keep the contiguous prefix
+                    child = _Node(slot, node, key)
+                    node.children[key] = child
+                    self._by_slot[slot] = child
+                    self.inserted_blocks += 1
+                    uploads.append((i, slot))
+                self._touch(child, now)
+                node = child
+                resident += 1
+        finally:
+            if opened:
+                self._flush_spill_burst()
         if uploads:
             self.peak_blocks_used = max(self.peak_blocks_used,
                                         self.blocks_used)

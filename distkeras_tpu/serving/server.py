@@ -83,6 +83,10 @@ class ServingServer:
         self._requested_port = port
         self._server: asyncio.AbstractServer | None = None
         self._engine_task: asyncio.Task | None = None
+        # JSONL telemetry fallback: one shared DeltaEncoder, lazily
+        # created — the ``telemetryz`` verb returns "what changed since
+        # the last poll" for pollers that never negotiated bin1.
+        self._telemetryz_enc = None
 
     @property
     def port(self) -> int:
@@ -315,6 +319,7 @@ class ServingServer:
         live: dict[int, Request] = {}
         pumps: set[asyncio.Task] = set()
         ctrls: set[asyncio.Task] = set()
+        telem: dict[int, asyncio.Task] = {}  # telemetry push per sid
         kv_wait: set[int] = set()       # sids whose REQ is pulling KV
         kv_cancelled: set[int] = set()  # cancels that raced a pull
         kv_joiners: dict[int, object] = {}  # per-stream chunk reassembly
@@ -359,7 +364,8 @@ class ServingServer:
                         # not stall every multiplexed stream on this
                         # connection.
                         ctrl = asyncio.get_running_loop().create_task(
-                            self._ctrl_bin1(sid, payload, sink))
+                            self._ctrl_bin1(sid, payload, sink,
+                                            ctrls, telem))
                         ctrls.add(ctrl)
                         ctrl.add_done_callback(ctrls.discard)
                     elif ftype == wire.T_KVBLK:
@@ -478,16 +484,49 @@ class ServingServer:
                 kv_cancelled.discard(sid)
 
     async def _ctrl_bin1(self, sid: int, payload,
-                         sink: "wire.FrameSink") -> None:
+                         sink: "wire.FrameSink",
+                         ctrls: set | None = None,
+                         telem: dict | None = None) -> None:
         """One control verb off a bin1 connection, as its own task.
         ``kv_export`` is special-cased here because its success reply is
         a BINARY ``KVBLK`` frame (the serialized blocks), not a JSON
-        control reply — the reason the verb needs bin1 at all."""
+        control reply — the reason the verb needs bin1 at all.
+        ``telemetry_start``/``telemetry_stop`` manage this connection's
+        T_TELEM push task (``telem`` maps sid -> task; the tasks also
+        live in ``ctrls`` so connection teardown cancels them)."""
         try:
             spec = wire.decode_json(payload)
         except wire.WireError as e:
             sink.send_json(wire.T_CTRLR, sid,
                            {"error": str(e), "code": "bad_request"})
+            return
+        if spec.get("cmd") == "telemetry_start" and ctrls is not None:
+            try:
+                interval = max(0.02, float(spec.get("interval_s", 0.25)))
+            except (TypeError, ValueError):
+                sink.send_json(wire.T_CTRLR, sid, {
+                    "error": f"bad interval_s {spec.get('interval_s')!r}",
+                    "code": "bad_request"})
+                return
+            old = telem.pop(sid, None)
+            if old is not None:
+                old.cancel()
+            task = asyncio.get_running_loop().create_task(
+                self._telemetry_push(sid, interval, sink))
+            telem[sid] = task
+            ctrls.add(task)
+            task.add_done_callback(ctrls.discard)
+            sink.send_json(wire.T_CTRLR, sid, {
+                "telemetry_start": {"interval_s": interval}})
+            return
+        if spec.get("cmd") == "telemetry_stop" and telem is not None:
+            stopped = 0
+            for task in list(telem.values()):
+                task.cancel()
+                stopped += 1
+            telem.clear()
+            sink.send_json(wire.T_CTRLR, sid,
+                           {"telemetry_stop": {"stopped": stopped}})
             return
         if spec.get("cmd") == "kv_export":
             rep = await self._kv_export_verb(spec)
@@ -512,6 +551,34 @@ class ServingServer:
                 sink.send_json(wire.T_CTRLR, sid, rep)
             return
         sink.send_json(wire.T_CTRLR, sid, await self._control(spec))
+
+    async def _telemetry_push(self, sid: int, interval_s: float,
+                              sink: "wire.FrameSink") -> None:
+        """The replica half of the pushed telemetry plane: every
+        ``interval_s``, ship this engine's registry DELTA as one compact
+        T_TELEM frame on the subscribing stream. Each subscriber gets
+        its own :class:`DeltaEncoder` (delta state is per-consumer), and
+        the first push is a full snapshot by construction. Host-side
+        dict work only — the engine loop, the device, and the compiled
+        executables never see it."""
+        from distkeras_tpu.telemetry.timeseries import DeltaEncoder
+
+        enc = DeltaEncoder(self.engine.metrics.registry)
+        try:
+            while not sink.closed:
+                try:
+                    # Refresh the passive queue/tenant gauges so pushes
+                    # carry live occupancy (same per-scrape refresh
+                    # metricsz does, minus the device-memory probe).
+                    self.engine.tenant_snapshot()
+                except Exception:
+                    pass
+                payload = json.dumps(enc.delta(),
+                                     separators=(",", ":")).encode()
+                sink.send_raw(wire.T_TELEM, sid, payload)
+                await asyncio.sleep(interval_s)
+        except asyncio.CancelledError:
+            pass
 
     async def _kv_export_verb(self, spec: dict) -> dict:
         """Serialize the pool's blocks for a prompt. Success returns
@@ -705,6 +772,34 @@ class ServingServer:
             return {"error": "kv_export needs a bin1 connection (the "
                              "reply is a binary KVBLK frame)",
                     "code": "bad_request"}
+        if cmd == "telemetryz":
+            # JSONL fallback for the telemetry push plane: one delta per
+            # poll (full snapshot on the first, or when asked).
+            if self._telemetryz_enc is None:
+                from distkeras_tpu.telemetry.timeseries import DeltaEncoder
+
+                self._telemetryz_enc = DeltaEncoder(
+                    self.engine.metrics.registry)
+            try:
+                self.engine.tenant_snapshot()
+            except Exception:
+                pass
+            return {"telemetryz": self._telemetryz_enc.delta(
+                full=bool(spec.get("full")))}
+        if cmd == "inject_latency":
+            # Fault injection (the SLO bench's breach phase): a host-
+            # side sleep per decode iteration. 0 clears it.
+            try:
+                delay = float(spec.get("decode_delay_s", 0.0))
+            except (TypeError, ValueError):
+                return {"error": f"bad decode_delay_s "
+                                 f"{spec.get('decode_delay_s')!r}",
+                        "code": "bad_request"}
+            if delay < 0 or delay > 10.0:
+                return {"error": f"decode_delay_s out of range ({delay})",
+                        "code": "bad_request"}
+            self.engine.inject_decode_delay_s = delay
+            return {"inject_latency": {"decode_delay_s": delay}}
         if cmd == "debugz":
             return {"debugz": self.engine.debugz()}
         if cmd == "tracez":

@@ -37,7 +37,14 @@ Frame types:
   blocks ship replica→replica for disaggregated prefill/decode —
   binary end to end, never JSON through the router's event loop. The
   native ``fw_scan_frames`` receive scan is frame-type-agnostic, so
-  KVBLK frames ride the same batched read path as every other type.
+  KVBLK frames ride the same batched read path as every other type;
+- ``T_TELEM`` — a pushed telemetry delta (compact JSON payload from
+  :class:`~distkeras_tpu.telemetry.timeseries.DeltaEncoder`): a replica
+  that received the ``telemetry_start`` control verb ships its metric
+  deltas to the router on a cadence over the SAME mux connection,
+  replacing poll-time aggregation on the hot signals. Another
+  type-agnostic rider on the native scan; the JSONL fallback is the
+  ``telemetryz`` verb, which returns one delta per poll.
 
 **Negotiation** is an upgrade from JSONL, so unknown peers keep today's
 protocol byte-for-byte: a bin1-capable client's FIRST line is JSON
@@ -86,6 +93,7 @@ __all__ = [
     "T_CTRLR",
     "T_CANCEL",
     "T_KVBLK",
+    "T_TELEM",
     "WireError",
     "native_available",
     "hello_line",
@@ -121,6 +129,7 @@ T_CTRL = 5
 T_CTRLR = 6
 T_CANCEL = 7
 T_KVBLK = 8  # serialized KV block chain (kv_transfer KVX1 payload)
+T_TELEM = 9  # pushed telemetry delta (compact JSON; replica -> router)
 
 # Frame header AFTER the u32 length prefix: type byte + stream id.
 _HDR = struct.Struct("<IBI")  # len, type, stream — one pack per frame

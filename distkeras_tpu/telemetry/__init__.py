@@ -19,6 +19,11 @@ layer both sides publish into. Four pillars:
 - **exposition** (:mod:`.exposition`) — Prometheus text + JSONL
   snapshots; the serving server serves both via its ``metricsz`` control
   verb, ``run.py`` wires ``--trace-out`` / ``--audit-recompiles``;
+- **fleet timeseries** (:mod:`.timeseries`) — the push-plane half:
+  registry delta encoding for replica→router telemetry pushes, the
+  router-side fold into fleet-merged histograms (bucket-exact fleet
+  p99s), and a ring-buffer store of per-window aggregates the SLO
+  burn-rate engine queries by metric name and span;
 - **request tracing** (:mod:`.request_trace`) — per-request trace ids
   propagated across the serving cluster's processes, per-hop timeline
   records, bounded stores behind the ``tracez`` control verb, and
@@ -55,8 +60,17 @@ from distkeras_tpu.telemetry.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    hist_state_delta,
+    hist_state_percentile,
+    log_buckets,
+    merge_hist_states,
     percentile,
     sanitize_metric_name,
+)
+from distkeras_tpu.telemetry.timeseries import (
+    DeltaEncoder,
+    FleetAggregator,
+    TimeSeriesStore,
 )
 from distkeras_tpu.telemetry.exposition import (
     prometheus_text,
@@ -102,6 +116,13 @@ __all__ = [
     "percentile",
     "sanitize_metric_name",
     "DEFAULT_BUCKETS",
+    "log_buckets",
+    "hist_state_delta",
+    "hist_state_percentile",
+    "merge_hist_states",
+    "DeltaEncoder",
+    "TimeSeriesStore",
+    "FleetAggregator",
     "prometheus_text",
     "write_snapshot_jsonl",
     "new_trace_id",

@@ -41,6 +41,10 @@ __all__ = [
     "percentile",
     "sanitize_metric_name",
     "DEFAULT_BUCKETS",
+    "log_buckets",
+    "hist_state_delta",
+    "hist_state_percentile",
+    "merge_hist_states",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -62,6 +66,134 @@ DEFAULT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+
+def log_buckets(lo: float, hi: float, per_decade: int = 4) -> tuple:
+    """A fixed log-spaced bucket layout covering ``[lo, hi]`` with
+    ``per_decade`` bounds per factor of 10. Fleet-mergeable histograms
+    want every publisher on the SAME layout — building the layout from
+    (lo, hi, per_decade) instead of hand-typed tuples makes "same
+    layout" a constructor argument, not a copy-paste discipline. Bounds
+    are rounded to 6 significant digits so independently constructed
+    layouts compare equal."""
+    if not (lo > 0 and hi > lo):
+        raise ValueError(f"need 0 < lo < hi, got ({lo}, {hi})")
+    if per_decade < 1:
+        raise ValueError(f"per_decade must be >= 1, got {per_decade}")
+    step = 10.0 ** (1.0 / per_decade)
+    out, v = [], float(lo)
+    while v < hi * (1.0 + 1e-9):
+        out.append(float(f"{v:.6g}"))
+        v *= step
+    return tuple(out)
+
+
+def hist_state_delta(cur: dict, prev: dict | None) -> dict:
+    """Bucket-wise difference of two histogram ``state()`` snapshots of
+    the SAME metric (``cur`` observed after ``prev``): the compact
+    "what happened since the last push" payload a replica ships to the
+    router. ``prev=None`` means the full state IS the delta (first
+    push). The delta's min/max are the current snapshot's — bounded by
+    one bucket width of the true window extremes, which is exactly the
+    accuracy the bucket counts themselves carry. Exemplars ride the
+    current per-bucket worst (merge takes per-bucket max, so replaying
+    them is idempotent)."""
+    if prev is None:
+        return dict(cur)
+    if list(cur["buckets"]) != list(prev["buckets"]):
+        raise ValueError("histogram delta across different bucket layouts")
+    counts = [int(c) - int(p)
+              for c, p in zip(cur["counts"], prev["counts"])]
+    if any(c < 0 for c in counts):
+        # The source histogram was reset (replica restart): the full
+        # current state is the honest delta.
+        return dict(cur)
+    out = {
+        "buckets": list(cur["buckets"]),
+        "counts": counts,
+        "count": int(cur["count"]) - int(prev["count"]),
+        "sum": float(cur["sum"]) - float(prev["sum"]),
+        "min": cur["min"],
+        "max": cur["max"],
+    }
+    if cur.get("exemplars"):
+        out["exemplars"] = cur["exemplars"]
+    return out
+
+
+def merge_hist_states(*states: dict) -> dict:
+    """Exact bucket-wise merge of histogram ``state()`` dicts sharing
+    one layout — associative and commutative by construction (integer
+    adds + min/max), so fleet aggregation can fold per-replica deltas
+    in any arrival order and any grouping. Returns a new state dict."""
+    states = [s for s in states if s]
+    if not states:
+        raise ValueError("merge of zero histogram states")
+    base = states[0]
+    counts = [0] * len(base["counts"])
+    total, sm = 0, 0.0
+    mn, mx = math.inf, -math.inf
+    exemplars: list = [None] * len(counts)
+    for s in states:
+        if list(s["buckets"]) != list(base["buckets"]):
+            raise ValueError(
+                "histogram merge across different bucket layouts")
+        for i, c in enumerate(s["counts"]):
+            counts[i] += int(c)
+        total += int(s["count"])
+        sm += float(s["sum"])
+        if s["count"]:
+            mn = min(mn, float(s["min"]))
+            mx = max(mx, float(s["max"]))
+        for i, ex in enumerate(s.get("exemplars") or []):
+            if ex is None:
+                continue
+            cur = exemplars[i]
+            if cur is None or float(ex[0]) > float(cur[0]):
+                exemplars[i] = [float(ex[0]), ex[1]]
+    out = {
+        "buckets": list(base["buckets"]),
+        "counts": counts,
+        "count": total,
+        "sum": sm,
+        "min": (mn if total else None),
+        "max": (mx if total else None),
+    }
+    if any(e is not None for e in exemplars):
+        out["exemplars"] = exemplars
+    return out
+
+
+def hist_state_percentile(state: dict, q: float) -> float:
+    """Bucket-interpolated percentile over a histogram ``state()`` dict
+    — the ONE estimator live histograms, fleet merges, and timeseries
+    windows all share, so a fleet p99 and a single-replica p99 disagree
+    only by what their bucket counts disagree by. Edge cases match
+    :func:`percentile`: empty raises, a single sample is returned
+    exactly (the sum of one sample IS the sample)."""
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"q must be in [0, 100], got {q}")
+    n = int(state["count"])
+    if n == 0:
+        raise ValueError("percentile of empty histogram")
+    if n == 1:
+        return float(state["sum"])
+    counts = state["counts"]
+    bounds = state["buckets"]
+    lo_obs = float(state["min"]) if state.get("min") is not None else 0.0
+    hi_obs = (float(state["max"]) if state.get("max") is not None
+              else float(bounds[-1]))
+    rank = (q / 100.0) * n
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if acc + c >= rank and c > 0:
+            lo = bounds[i - 1] if i > 0 else lo_obs
+            hi = bounds[i] if i < len(bounds) else hi_obs
+            frac = (rank - acc) / c
+            est = lo + (hi - lo) * frac
+            return min(max(est, lo_obs), hi_obs)
+        acc += c
+    return hi_obs
 
 
 def percentile(values: Iterable[float], q: float) -> float:
@@ -231,29 +363,64 @@ class Histogram(_Metric):
         """Bucket-interpolated percentile estimate; agrees with the exact
         :func:`percentile` on the edge cases (empty raises, one sample is
         returned exactly)."""
-        if not 0.0 <= q <= 100.0:
-            raise ValueError(f"q must be in [0, 100], got {q}")
+        return hist_state_percentile(self.state(exemplars=False), q)
+
+    # -- mergeable-histogram surface ----------------------------------------
+    def state(self, exemplars: bool = True) -> dict:
+        """JSON-able full snapshot of the histogram's mergeable state:
+        per-bucket counts (NON-cumulative), count/sum/min/max, bucket
+        layout, and (optionally) the per-bucket worst-sample exemplars.
+        ``state()`` dicts are the unit of fleet telemetry: deltas
+        (:func:`hist_state_delta`) ship over the wire, merges
+        (:func:`merge_hist_states` / :meth:`merge_state`) fold them."""
         with self._lock:
-            counts = list(self._counts)
-            n = self._count
-            lo_obs, hi_obs = self._min, self._max
-            total = self._sum
-        if n == 0:
-            raise ValueError("percentile of empty histogram")
-        if n == 1:
-            return total  # sum of one sample IS the sample — exact
-        rank = (q / 100.0) * n
-        acc = 0.0
-        for i, c in enumerate(counts):
-            if acc + c >= rank and c > 0:
-                lo = self.bucket_bounds[i - 1] if i > 0 else lo_obs
-                hi = (self.bucket_bounds[i]
-                      if i < len(self.bucket_bounds) else hi_obs)
-                frac = (rank - acc) / c
-                est = lo + (hi - lo) * frac
-                return min(max(est, lo_obs), hi_obs)
-            acc += c
-        return hi_obs
+            out = {
+                "buckets": list(self.bucket_bounds),
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": self._sum,
+                "min": (self._min if self._count else None),
+                "max": (self._max if self._count else None),
+            }
+            if exemplars and any(e is not None for e in self._exemplars):
+                out["exemplars"] = [
+                    None if e is None else [e[0], e[1]]
+                    for e in self._exemplars]
+        return out
+
+    def merge_state(self, state: dict) -> None:
+        """Fold a ``state()``/delta dict into this histogram — the
+        bucket-exact merge the router applies to every pushed replica
+        delta. Requires an identical bucket layout (fleet mergeability
+        is why layouts are fixed at construction). Commutative and
+        associative over the bucket state: any fold order yields the
+        same counts/sum/min/max."""
+        if list(state["buckets"]) != list(self.bucket_bounds):
+            raise ValueError(
+                f"cannot merge {self.name!r}: bucket layout "
+                f"{state['buckets']} != {list(self.bucket_bounds)}")
+        exemplars = state.get("exemplars") or []
+        with self._lock:
+            for i, c in enumerate(state["counts"]):
+                self._counts[i] += int(c)
+            self._count += int(state["count"])
+            self._sum += float(state["sum"])
+            if state["count"]:
+                if state["min"] is not None:
+                    self._min = min(self._min, float(state["min"]))
+                if state["max"] is not None:
+                    self._max = max(self._max, float(state["max"]))
+            for i, ex in enumerate(exemplars):
+                if ex is None or i >= len(self._exemplars):
+                    continue
+                cur = self._exemplars[i]
+                if cur is None or float(ex[0]) > cur[0]:
+                    self._exemplars[i] = (float(ex[0]), ex[1])
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram of the same bucket layout into this
+        one (see :meth:`merge_state`)."""
+        self.merge_state(other.state())
 
 
 class MetricsRegistry:

@@ -85,7 +85,16 @@ _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     # gain, ttft speedup, and the push-vs-pull bytes
                     # saved regress DOWN (higher-is-better by default,
                     # ttft_p99_s itself already matches "ttft" above).
-                    "spill_latency", "readmit_latency")
+                    "spill_latency", "readmit_latency",
+                    # Fleet-telemetry rows (serving/slo_*): the push
+                    # plane's goodput tax, the burn engine's
+                    # per-evaluation cost, and breach-detection latency
+                    # all regress UP (aggregation ``staleness_s`` and
+                    # the fleet-merged ttft/itl percentiles + their
+                    # offline-recompute error already match prefixes
+                    # above); the push-phase goodput row regresses DOWN
+                    # (higher-is-better by default).
+                    "push_overhead", "burn_overhead", "time_to_page")
 
 
 def lower_is_better(key: str) -> bool:

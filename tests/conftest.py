@@ -35,6 +35,29 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == _n_devices, jax.devices()
 
+# Persistent XLA compilation cache: the suite is compile-dominated (every
+# parity test builds prefill/decode executables for the same tiny models),
+# so repeat runs on a small CI box spend most of their wall clock
+# recompiling programs that haven't changed. Cache keys cover the HLO,
+# compile options, and backend, so hits are exact; the RecompileAuditor
+# is unaffected (it counts jit-cache entries, which a disk hit still
+# creates — only the XLA compile time is skipped). The min-compile-time
+# threshold is deliberately left at its default: forcing it to 0 also
+# caches sub-second multi-device trainer programs whose round-trip
+# through the serializer aborts the process on reload (reproduced on
+# tests/test_checkpoint.py). Opt out with DISTKERAS_JAX_CACHE=0;
+# override the location (e.g. a CI cache path) with
+# DISTKERAS_JAX_CACHE_DIR.
+if os.environ.get("DISTKERAS_JAX_CACHE", "1") != "0":
+    _cache_dir = os.environ.get(
+        "DISTKERAS_JAX_CACHE_DIR",
+        os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                     "distkeras-jax-cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    except Exception:
+        pass  # older jax without the cache config: run uncached
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

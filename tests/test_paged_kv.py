@@ -133,6 +133,66 @@ def test_block_pool_version_moves_on_free_and_adopt():
     assert pool.version > v1
 
 
+def _pool_with_two_cached_chains():
+    """A full 4-block pool whose rows all sit in unpinned trie chains
+    ([1,2]->[1,2,3,4] and [5,6]->[5,6,7,8]): any further alloc must
+    evict."""
+    pool = KVBlockPool(4, 2)
+    a = pool.alloc(2)
+    assert pool.adopt([1, 2, 3, 4], a, 0) == 2
+    b = pool.alloc(2)
+    assert pool.adopt([5, 6, 7, 8], b, 0) == 2
+    assert pool.blocks_free == 0
+    return pool
+
+
+def test_block_pool_spill_many_batches_the_eviction_burst():
+    """With ``spill_many_hook`` set, a multi-block alloc's eviction
+    victims arrive in ONE call (the tiered engine turns that into one
+    D2H gather) — and the batch matches the per-victim ``spill_hook``
+    sequence exactly, victim for victim."""
+    pool = _pool_with_two_cached_chains()
+    batches: list[list] = []
+    pool.spill_many_hook = lambda victims: batches.append(list(victims))
+    # The batched hook takes precedence inside the burst: the
+    # per-victim hook must stay silent.
+    singles: list[tuple] = []
+    pool.spill_hook = lambda chain, slot: singles.append((chain, slot))
+    got = pool.alloc(4)
+    assert got is not None and len(got) == 4
+    assert len(batches) == 1 and len(batches[0]) == 4
+    assert singles == []
+    # Parity: the identically-built pool with ONLY the per-victim hook
+    # spills the same (chain, slot) sequence, one call per victim.
+    pool2 = _pool_with_two_cached_chains()
+    pool2.spill_hook = lambda chain, slot: singles.append((chain, slot))
+    assert pool2.alloc(4) is not None
+    assert ([(list(c), s) for c, s in batches[0]]
+            == [(list(c), s) for c, s in singles])
+    # Every victim carried its full root->leaf chain.
+    chains = sorted(tuple(c) for c, _ in batches[0])
+    assert chains == [(1, 2), (1, 2, 3, 4), (5, 6), (5, 6, 7, 8)]
+
+
+def test_block_pool_spill_burst_flushes_even_on_shortfall():
+    """An alloc that fails midway already evicted its victims; the
+    burst must still hand them to the spill tier (the rows go back to
+    the free list unwritten, so the bytes are intact at flush time)."""
+    pool = KVBlockPool(3, 2)
+    a = pool.alloc(1)
+    assert pool.adopt([1, 2], a, 0) == 1  # evictable chain
+    b = pool.alloc(1)
+    assert pool.adopt([3, 4], b, 0) == 1
+    m = pool.match([3, 4, 9])  # pins [3,4]: unevictable
+    assert m.matched_tokens == 2
+    batches: list[list] = []
+    pool.spill_many_hook = lambda victims: batches.append(list(victims))
+    assert pool.alloc(3) is None  # 1 free + 1 evictable < 3
+    assert len(batches) == 1
+    assert [(list(c), s) for c, s in batches[0]] == [([1, 2], a[0])]
+    pool.release(m)
+
+
 # -- paged engine: parity + the compile invariant ----------------------------
 
 def test_paged_parity_vs_generate_and_dense_with_armed_auditor(lm, rng):

@@ -130,6 +130,78 @@ def test_router_parity_and_fleet_aggregation(lm, rng):
     assert metrics["router"]["router_requests_total"]["value"] == len(prompts)
 
 
+def test_fleet_telemetry_push_and_sloz_end_to_end(lm, rng):
+    """The pushed-metrics plane, end to end: replicas stream metric
+    deltas to the router on a cadence (mux ``telemetry_start``
+    subscriptions, one per replica), the router folds them into
+    fleet-merged histograms whose count covers every served request,
+    the Prometheus page carries both the per-replica and the
+    ``fleet="all"`` series, and ``sloz``/``healthz`` serve the burn-rate
+    engine's state over the same store."""
+    from distkeras_tpu.serving.slo import default_objectives
+
+    prompts = [_prompt(rng, n) for n in (5, 7, 4, 6)]
+
+    async def go():
+        cluster = ServingCluster(
+            _factory(lm), 2, supervisor_kwargs=SUP,
+            # CPU tiny-model fleets legitimately breach production-shaped
+            # latency targets during warmup; relaxed thresholds keep the
+            # ttft/itl objectives asserting "ok" below.
+            router_kwargs={
+                "telemetry_interval_s": 0.05,
+                "telemetry_window_s": 0.25,
+                "slo_objectives": default_objectives(
+                    ttft_threshold_s=30.0, itl_threshold_s=30.0),
+            })
+        async with cluster:
+            async def one(p):
+                async with ServingClient("127.0.0.1", cluster.port) as c:
+                    return (await c.generate(p, 6))["tokens"]
+
+            outs = await asyncio.gather(*(one(p) for p in prompts))
+            router = cluster.router
+            # Every request produced one TTFT observation; the pushed
+            # deltas must converge the fleet merge onto all of them.
+            await _wait_until(
+                lambda: (router.fleet.fleet_hist_state(
+                    "serving_ttft_seconds") or {}).get("count", 0)
+                >= len(prompts),
+                timeout=20.0, what="fleet-merged TTFT to cover all "
+                                   "requests")
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                health = await c.healthz()
+                sloz = (await c._control({"cmd": "sloz"}))["sloz"]
+                prom = await c.metricsz(format="prometheus")
+            fleet_snap = router.fleet.registry.snapshot()
+            return outs, health, sloz, prom, fleet_snap
+
+    outs, health, sloz, prom, fleet_snap = asyncio.run(go())
+    for p, got in zip(prompts, outs):
+        assert got == _want(lm, p, 6)  # telemetry never skews serving
+    # healthz folds the plane in: overall SLO state + aggregation stats.
+    assert health["router"]["slo"] in ("ok", "warn", "page")
+    telem = health["router"]["telemetry"]
+    assert telem["pushes"] > 0 and telem["push_errors"] == 0
+    assert telem["push_subscriptions"] == 2  # both replicas push (mux)
+    assert telem["interval_s"] == 0.05
+    assert set(telem["replicas"]) == {"r0", "r1"}
+    # sloz: the burn-rate snapshot plus the same aggregation rollup.
+    assert sloz["aggregation"]["pushes"] >= telem["pushes"]
+    assert 0 <= sloz["aggregation"]["staleness_s"] < 5.0
+    by_name = {o["objective"]: o for o in sloz["objectives"]}
+    assert by_name["ttft_p99"]["state"] == "ok"
+    assert by_name["itl_p99"]["state"] == "ok"
+    # The fleet Prometheus page renders the merged series both ways.
+    assert 'fleet="all"' in prom
+    assert "serving_ttft_seconds" in prom
+    # Gauges stay per-replica only (summing occupancy ratios is a lie).
+    assert 'serving_slot_occupancy{fleet="all"}' not in prom
+    for rid in ("r0", "r1"):
+        assert any("serving_slot_occupancy" in k and f"replica={rid}" in k
+                   for k in fleet_snap)
+
+
 def test_affinity_pins_prompt_family_to_one_replica(lm, rng):
     family = _prompt(rng, 16)  # >= affinity_tokens: one prompt family
 

@@ -1,0 +1,440 @@
+"""Fleet telemetry plane: mergeable histograms, windowed timeseries,
+delta encoding, the router-side fold, and the SLO burn-rate engine.
+
+The contracts under test, all host-side (no jax):
+
+- histogram merge is a proper commutative monoid on ``state()`` dicts
+  (commutative, associative) and BUCKET-EXACT: merging per-replica
+  states equals the state of one histogram that saw every sample, so a
+  fleet percentile computed from the merge equals the single-registry
+  ground truth — not an estimate over estimates;
+- ``DeltaEncoder`` ships only what changed (bucket-count diffs, counter
+  increments), re-ships full state on a reset source, and a quiet
+  registry's delta is empty;
+- ``TimeSeriesStore`` rolls fixed-width windows on an injected clock,
+  skips quiet gaps without minting empty windows, bounds memory at
+  ``capacity``, and ``summary()`` over any span is the bucket-exact
+  merge of its windows;
+- ``FleetAggregator`` folds pushes into per-replica + fleet="all"
+  series; the windowed fleet percentile matches an offline recompute
+  over the pooled raw samples; ``forget_replica`` drops ONLY the dead
+  replica's gauges (its counted history stays);
+- ``SLOEngine``: objective validation is loud, latency thresholds snap
+  to bucket bounds, the multiwindow rule pages only when BOTH windows
+  burn, the ok -> warn -> page -> ok state machine records transition
+  events with exemplar trace ids harvested from the offending buckets.
+"""
+
+import pytest
+
+from distkeras_tpu.serving.slo import Objective, SLOEngine
+from distkeras_tpu.telemetry import MetricsRegistry
+from distkeras_tpu.telemetry.registry import (
+    hist_state_percentile,
+    merge_hist_states,
+)
+from distkeras_tpu.telemetry.timeseries import (
+    DeltaEncoder,
+    FleetAggregator,
+    TimeSeriesStore,
+)
+
+BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _hist_state(values, exemplars=None, buckets=BUCKETS):
+    """A ``state()`` dict from a fresh histogram fed ``values``."""
+    reg = MetricsRegistry()
+    h = reg.histogram("m", buckets=buckets)
+    for i, v in enumerate(values):
+        h.observe(v, exemplar=exemplars[i] if exemplars else None)
+    return h.state()
+
+
+# -- mergeable-histogram properties ------------------------------------------
+
+def test_merge_commutative_and_associative():
+    # Dyadic values: float sums are exact, so the property holds as
+    # full dict equality, not approximately.
+    a = _hist_state([0.03125, 0.25, 0.75])
+    b = _hist_state([0.0078125, 0.0078125, 2.0])
+    c = _hist_state([0.0625] * 5)
+    assert merge_hist_states(a, b) == merge_hist_states(b, a)
+    assert (merge_hist_states(merge_hist_states(a, b), c)
+            == merge_hist_states(a, merge_hist_states(b, c)))
+    # Merging is non-destructive: the inputs are unchanged.
+    assert a == _hist_state([0.03125, 0.25, 0.75])
+
+
+def test_merge_equals_single_registry_ground_truth():
+    """Per-replica states merged == ONE histogram that saw everything:
+    the bucket-exact contract fleet percentiles rest on."""
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    shards = [rng.exponential(0.1, size=n).tolist() for n in (40, 17, 93)]
+    merged = merge_hist_states(*(_hist_state(s) for s in shards))
+    truth = _hist_state([v for s in shards for v in s])
+    assert merged["counts"] == truth["counts"]
+    assert merged["count"] == truth["count"]
+    assert merged["sum"] == pytest.approx(truth["sum"])
+    assert merged["min"] == truth["min"]
+    assert merged["max"] == truth["max"]
+    for q in (50, 90, 99):
+        assert (hist_state_percentile(merged, q)
+                == pytest.approx(hist_state_percentile(truth, q)))
+
+
+def test_merge_keeps_worst_exemplar_per_bucket():
+    a = _hist_state([0.02, 0.3], exemplars=["a1", "a2"])
+    b = _hist_state([0.03, 0.4], exemplars=["b1", "b2"])
+    m = merge_hist_states(a, b)
+    got = {tuple(e) for e in m["exemplars"] if e is not None}
+    assert (0.03, "b1") in got  # 0.03 > 0.02 in the same bucket
+    assert (0.4, "b2") in got   # 0.4 > 0.3
+    with pytest.raises(ValueError, match="layout"):
+        merge_hist_states(a, _hist_state([0.1], buckets=(1.0, 2.0)))
+
+
+# -- DeltaEncoder -------------------------------------------------------------
+
+def test_delta_encoder_ships_only_changes():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=BUCKETS)
+    c = reg.counter("reqs_total")
+    g = reg.gauge("depth")
+    h.observe(0.02, exemplar="t1")
+    c.inc(3)
+    g.set(5)
+    enc = DeltaEncoder(reg)
+    d1 = enc.delta()
+    assert d1["seq"] == 1
+    assert d1["hists"]["lat_seconds"]["count"] == 1
+    assert d1["counters"]["reqs_total"] == 3
+    assert d1["gauges"]["depth"] == 5
+    # Quiet registry: nothing shipped but the gauges (no delta exists
+    # for a gauge).
+    d2 = enc.delta()
+    assert d2["hists"] == {} and d2["counters"] == {}
+    # New traffic ships ONLY the increment.
+    h.observe(0.7)
+    c.inc()
+    d3 = enc.delta()
+    assert d3["hists"]["lat_seconds"]["count"] == 1  # not 2
+    assert d3["counters"]["reqs_total"] == 1
+    # full=True re-ships everything (the re-sync path).
+    d4 = enc.delta(full=True)
+    assert d4["hists"]["lat_seconds"]["count"] == 2
+    assert d4["counters"]["reqs_total"] == 4
+
+
+def test_delta_encoder_reset_source_reships_full_value():
+    """A restarted replica's counter went backwards from the encoder's
+    view: the full new value ships as the delta (never a negative)."""
+    reg = MetricsRegistry()
+    reg.counter("reqs_total").inc(2)
+    enc = DeltaEncoder(reg)
+    enc.delta()
+    enc._counter_prev["reqs_total"] = 99.0  # simulate the old incarnation
+    reg.counter("reqs_total").inc()
+    d = enc.delta()
+    assert d["counters"]["reqs_total"] == 3.0
+
+
+def test_metric_key_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("c_total", tenant="t1", zone="z")
+    (m,) = reg.collect()
+    key = DeltaEncoder.metric_key(m)
+    assert key == "c_total{tenant=t1,zone=z}"
+    assert DeltaEncoder.parse_key(key) == ("c_total",
+                                           {"tenant": "t1", "zone": "z"})
+    assert DeltaEncoder.parse_key("bare") == ("bare", {})
+
+
+# -- TimeSeriesStore ----------------------------------------------------------
+
+def test_store_rolls_windows_and_skips_gaps():
+    clk = FakeClock()
+    store = TimeSeriesStore(window_s=1.0, capacity=8, clock=clk)
+    store.record_hist("m", _hist_state([0.02]))
+    clk.advance(1.0)
+    store.record_hist("m", _hist_state([0.3]))
+    clk.advance(10.0)  # a quiet gap: no empty windows minted
+    store.record_hist("m", _hist_state([0.7]))
+    store.flush()
+    windows = store.query("m")
+    assert len(windows) == 3
+    assert all("hist" in w for w in windows)
+    # Span restriction: only the trailing window survives a 2s cut.
+    recent = store.query("m", span_s=2.0)
+    assert len(recent) == 1
+    assert recent[0]["hist"]["count"] == 1
+
+
+def test_store_capacity_bounds_memory():
+    clk = FakeClock()
+    store = TimeSeriesStore(window_s=1.0, capacity=4, clock=clk)
+    for _ in range(10):
+        store.record_value("c", 1.0)
+        clk.advance(1.0)
+    assert len(store.query("c")) == 4  # oldest evicted, newest kept
+    s = store.summary("c")
+    assert s["value"] == 4.0
+
+
+def test_store_summary_is_bucket_exact_merge():
+    clk = FakeClock()
+    store = TimeSeriesStore(window_s=1.0, capacity=8, clock=clk)
+    shard_a, shard_b = [0.02, 0.3, 0.09], [0.7, 0.005]
+    store.record_hist("m", _hist_state(shard_a))
+    clk.advance(1.0)
+    store.record_hist("m", _hist_state(shard_b))
+    store.flush()
+    s = store.summary("m")
+    truth = _hist_state(shard_a + shard_b)
+    assert s["count"] == truth["count"]
+    assert s["hist"]["counts"] == truth["counts"]
+    assert s["p99"] == pytest.approx(hist_state_percentile(truth, 99))
+    assert store.summary("absent") is None
+
+
+def test_store_gauge_keeps_window_max_and_last():
+    clk = FakeClock()
+    store = TimeSeriesStore(window_s=1.0, clock=clk)
+    store.record_gauge("g", 0.5)
+    store.record_gauge("g", 0.9)
+    store.record_gauge("g", 0.2)
+    store.flush()
+    (w,) = store.query("g")
+    assert w["gauge"] == 0.9 and w["last"] == 0.2
+    s = store.summary("g")
+    assert s["gauge_max"] == 0.9 and s["gauge_last"] == 0.2
+
+
+def test_store_rejects_bad_window():
+    with pytest.raises(ValueError):
+        TimeSeriesStore(window_s=0)
+
+
+# -- FleetAggregator ----------------------------------------------------------
+
+def _payload(reg, enc=None, **delta_kwargs):
+    return (enc or DeltaEncoder(reg)).delta(**delta_kwargs)
+
+
+def test_fleet_fold_per_replica_and_fleet_series():
+    import numpy as np
+
+    fleet = FleetAggregator(TimeSeriesStore(window_s=1.0,
+                                            clock=FakeClock()))
+    rng = np.random.default_rng(11)
+    raw: list[float] = []
+    regs = {rid: MetricsRegistry() for rid in ("r0", "r1", "r2")}
+    encs = {rid: DeltaEncoder(reg) for rid, reg in regs.items()}
+    # Several push rounds with interleaved traffic, like the real plane.
+    for _ in range(3):
+        for rid, reg in regs.items():
+            xs = rng.exponential(0.1, size=5).tolist()
+            raw.extend(xs)
+            h = reg.histogram("serving_ttft_seconds", buckets=BUCKETS)
+            for v in xs:
+                h.observe(v)
+            reg.counter("serving_requests_completed_total").inc(5)
+            reg.gauge("serving_slot_occupancy").set(0.5)
+            fleet.ingest(rid, "decode", encs[rid].delta())
+    truth = _hist_state(raw)
+    merged = fleet.fleet_hist_state("serving_ttft_seconds")
+    assert merged["counts"] == truth["counts"]
+    for q in (50, 99):
+        # The windowed fleet percentile == offline recompute over the
+        # pooled raw samples' histogram (bucket-exact end to end).
+        assert (hist_state_percentile(merged, q)
+                == pytest.approx(hist_state_percentile(truth, q)))
+    snap = fleet.registry.snapshot()
+    assert snap["serving_ttft_seconds{fleet=all}"]["count"] == len(raw)
+    assert snap["serving_ttft_seconds{replica=r1,role=decode}"][
+        "count"] == len(raw) // 3
+    assert snap[
+        "serving_requests_completed_total{fleet=all}"]["value"] == 45
+    st = fleet.stats()
+    assert st["pushes"] == 9 and st["push_errors"] == 0
+    assert st["replicas"] == {"r0": 3, "r1": 3, "r2": 3}
+    assert fleet.staleness_s() is not None
+    # The store got the fleet-wide series too.
+    fleet.store.flush()
+    assert fleet.store.summary("serving_ttft_seconds")["count"] == len(raw)
+
+
+def test_fleet_forget_replica_drops_only_gauges():
+    fleet = FleetAggregator()
+    reg = MetricsRegistry()
+    reg.histogram("serving_ttft_seconds", buckets=BUCKETS).observe(0.02)
+    reg.gauge("serving_slot_occupancy").set(1.0)
+    fleet.ingest("r0", "decode", DeltaEncoder(reg).delta())
+    fleet.forget_replica("r0")
+    snap = fleet.registry.snapshot()
+    assert not any("slot_occupancy" in k and "r0" in k for k in snap)
+    # Counted history stays: those requests happened.
+    assert snap["serving_ttft_seconds{fleet=all}"]["count"] == 1
+    assert fleet.stats()["replicas"] == {}
+
+
+def test_fleet_malformed_payload_counts_error_not_raise():
+    fleet = FleetAggregator()
+    fleet.ingest("r0", "decode", {"hists": {"m": {"not": "a state"}}})
+    assert fleet.stats()["push_errors"] == 1
+
+
+# -- SLOEngine ----------------------------------------------------------------
+
+def test_objective_validation_is_loud():
+    with pytest.raises(ValueError, match="kind"):
+        Objective(name="x", kind="vibes", target=0.9)
+    with pytest.raises(ValueError, match="target"):
+        Objective(name="x", kind="latency", target=1.5, metric="m")
+    with pytest.raises(ValueError, match="metric"):
+        Objective(name="x", kind="latency", target=0.9)
+    with pytest.raises(ValueError, match="bad and total"):
+        Objective(name="x", kind="ratio", target=0.9)
+    store = TimeSeriesStore(clock=FakeClock())
+    dup = [Objective(name="x", kind="gauge", target=0.9, metric="m")] * 2
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOEngine(store, objectives=dup)
+    with pytest.raises(ValueError, match="window"):
+        SLOEngine(store, fast_window_s=10.0, slow_window_s=5.0)
+
+
+def _latency_engine(clk, **kw):
+    store = TimeSeriesStore(window_s=1.0, capacity=64, clock=clk)
+    obj = Objective(name="lat_p", kind="latency", target=0.9,
+                    metric="m", threshold=0.07)
+    eng = SLOEngine(store, objectives=[obj], fast_window_s=2.0,
+                    slow_window_s=10.0, warn_burn=2.0, page_burn=5.0,
+                    clock=clk, **kw)
+    return store, eng
+
+
+def test_latency_threshold_snaps_to_bucket_bound():
+    clk = FakeClock()
+    store, eng = _latency_engine(clk)
+    store.record_hist("m", _hist_state([0.02]))
+    store.flush()
+    (r,) = eng.evaluate()
+    # 0.07 is inside (0.05, 0.1]: the effective bound is 0.1 and the
+    # bad fraction is the EXACT tail mass above it.
+    assert r["fast"]["threshold_effective"] == 0.1
+    assert r["state"] == "ok" and eng.overall() == "ok"
+
+
+def test_multiwindow_rule_needs_both_windows_burning():
+    """Fast window saturated with bad samples, slow window diluted by
+    older good traffic: no page — the classic blip guard."""
+    clk = FakeClock()
+    store, eng = _latency_engine(clk)
+    store.record_hist("m", _hist_state([0.02] * 97))  # good, t=0
+    clk.advance(5.0)                                  # outside fast span
+    store.record_hist("m", _hist_state([0.9] * 3))    # bad burst, now
+    store.flush()
+    (r,) = eng.evaluate()
+    assert r["fast"]["burn"] >= 5.0       # fast alone would page
+    assert r["slow"]["burn"] < 2.0        # slow says blip
+    assert r["state"] == "ok"
+
+
+def test_state_machine_walks_ok_warn_page_ok_with_exemplars():
+    clk = FakeClock()
+    store, eng = _latency_engine(clk)
+    # Healthy traffic -> ok.
+    store.record_hist("m", _hist_state([0.02] * 10))
+    store.flush()
+    assert eng.evaluate()[0]["state"] == "ok"
+    # ~30% above the bound in BOTH windows: burn 3 in [2, 5) -> warn.
+    clk.advance(1.0)
+    store.record_hist("m", _hist_state(
+        [0.02] * 4 + [0.9] * 6, exemplars=[None] * 4 + [f"t{i}"
+                                           for i in range(6)]))
+    store.flush()
+    assert eng.evaluate()[0]["state"] == "warn"
+    # Saturate with bad samples -> page, carrying exemplar trace ids.
+    # 0.95 > the warn phase's 0.9: the merge keeps the strictly-worst
+    # exemplar per bucket, so the page event must carry "slow1".
+    clk.advance(1.0)
+    store.record_hist("m", _hist_state([0.95] * 40,
+                                       exemplars=["slow1"] * 40))
+    store.flush()
+    r = eng.evaluate()[0]
+    assert r["state"] == "page" and eng.overall() == "page"
+    # Quiet windows drain the burn -> back to ok (idle burns nothing).
+    clk.advance(11.0)
+    store.record_hist("m", _hist_state([0.02]))
+    store.flush()
+    assert eng.evaluate()[0]["state"] == "ok"
+    transitions = [(e["from"], e["to"]) for e in eng.events]
+    assert transitions == [("ok", "warn"), ("warn", "page"),
+                           ("page", "ok")]
+    breach = [e for e in eng.events if e["to"] in ("warn", "page")]
+    assert all(e["exemplars"] for e in breach)
+    assert "slow1" in [x for e in breach for x in e["exemplars"]]
+    snap = eng.snapshot()
+    assert snap["overall"] == "ok"
+    assert snap["evaluations"] == 4 and snap["eval_cost_s"] >= 0
+    assert len(snap["events"]) == 3
+
+
+def test_ratio_objective_pages_on_error_budget():
+    clk = FakeClock()
+    store = TimeSeriesStore(window_s=1.0, clock=clk)
+    obj = Objective(name="errors", kind="ratio", target=0.99,
+                    bad=("rej_total",), total=("rej_total", "ok_total"))
+    eng = SLOEngine(store, objectives=[obj], fast_window_s=2.0,
+                    slow_window_s=10.0, clock=clk)
+    store.record_value("ok_total", 99.0)
+    store.record_value("rej_total", 1.0)
+    store.flush()
+    (r,) = eng.evaluate()
+    assert r["fast"]["burn"] == pytest.approx(1.0)  # exactly at budget
+    assert r["state"] == "ok"
+    clk.advance(1.0)
+    store.record_value("rej_total", 50.0)
+    store.record_value("ok_total", 50.0)
+    store.flush()
+    (r,) = eng.evaluate()
+    assert r["state"] == "page"  # burn far past 14.4 in both windows
+
+
+def test_gauge_objective_counts_time_above_threshold():
+    clk = FakeClock()
+    store = TimeSeriesStore(window_s=1.0, clock=clk)
+    obj = Objective(name="pressure", kind="gauge", target=0.5,
+                    metric="occ", threshold=0.95)
+    eng = SLOEngine(store, objectives=[obj], fast_window_s=4.0,
+                    slow_window_s=10.0, clock=clk)
+    for v in (0.5, 0.99, 0.99, 0.2):
+        store.record_gauge("occ", v)
+        clk.advance(1.0)
+    store.flush()
+    (r,) = eng.evaluate()
+    # 2 of 4 windows above threshold = bad fraction 0.5, budget 0.5:
+    # burn 1.0 -> sustainable, ok.
+    assert r["fast"]["bad_fraction"] == pytest.approx(0.5)
+    assert r["state"] == "ok"
+
+
+def test_no_data_burns_nothing():
+    clk = FakeClock()
+    store, eng = _latency_engine(clk)
+    (r,) = eng.evaluate()
+    assert r["state"] == "ok"
+    assert r["fast_burn"] == 0.0 and "fast" not in r
