@@ -932,6 +932,146 @@ def _record_pipeline_history(args, report):
     bench.write_history(path, hist)
 
 
+def _parse_depths(spec: str) -> list[int]:
+    """``--pipeline-depths 0,1,2,4`` -> sorted unique non-negative ints
+    (typed CLI error on junk, never a deep traceback)."""
+    try:
+        depths = sorted({int(tok) for tok in spec.split(",") if tok.strip()})
+    except ValueError:
+        raise SystemExit(f"--pipeline-depths: not an int list: {spec!r}")
+    if not depths or any(d < 0 for d in depths):
+        raise SystemExit(f"--pipeline-depths: need non-negative depths, "
+                         f"got {spec!r}")
+    return depths
+
+
+async def _pp_ab(args, model, variables, report):
+    """Depth sweep on a pipeline-parallel mesh: one fresh engine per
+    depth in ``--pipeline-depths``, same saturated closed-loop workload,
+    armed auditor every time. The pp win is stage overlap: at depth 1 a
+    pp=S mesh runs ONE micro-batch, so S-1 stages idle every tick and
+    ``bubble_fraction`` sits near 1-1/S; at depth>=S the micro-batched
+    ticks keep every stage busy and goodput climbs while the bubble
+    collapses. Every depth's streams must be token-identical to every
+    other depth's (and, via the caller's parity pass, to generate())."""
+    from distkeras_tpu.serving import ServingMetrics
+
+    mesh = _mesh(args)
+    pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
+    if pp <= 1:
+        raise SystemExit(
+            "--pp-ab needs a pipeline-parallel mesh: pass "
+            "--mesh-shape tp=N,pp=M with M>=2 (and --force-host-devices "
+            "N*M on a CPU host)")
+    depths = _parse_depths(args.pipeline_depths)
+    out: dict = {"pp": pp, "depths": depths}
+    all_results = []
+    depth_results: dict[int, list] = {}
+    prompts = _prompts(args, args.requests, salt=0)
+    for depth in depths:
+        engine = _make_engine(args, model, variables,
+                              pipeline_depth=depth, arm=True)
+        # Warmup outside the measured window (same discipline as
+        # _pipeline_ab): steady-state goodput and bubble, not compiles.
+        task = asyncio.create_task(engine.run())
+        warm = list(prompts[:min(4, len(prompts))])
+        await _closed_loop(engine, warm, args)
+        engine.shutdown(drain=True)
+        await task
+        engine.reopen()
+        engine.metrics = ServingMetrics()
+        task = asyncio.create_task(engine.run())
+        t0 = time.monotonic()
+        results = await _closed_loop(engine, list(prompts), args)
+        elapsed = time.monotonic() - t0
+        engine.shutdown(drain=True)
+        await task
+        summary = engine.metrics.summary()
+        done_tokens = sum(len(t) for _, t in results)
+        stage_compiles = engine.decode_compile_counts()
+        assert all(c in (1, -1) for c in stage_compiles), (
+            f"pp depth {depth} retraced a stage decode step: "
+            f"per-stage executables {stage_compiles}")
+        bubble = summary.get("bubble_fraction")
+        out[f"depth{depth}"] = {
+            "completed": len(results),
+            "wall_s": round(elapsed, 3),
+            "goodput_tokens_per_sec": round(done_tokens / elapsed, 2),
+            "inter_token_p99_s": round(
+                summary.get("inter_token_p99_s", 0.0), 6),
+            "ttft_p99_s": round(summary.get("ttft_p99_s", 0.0), 6),
+            "bubble_fraction": (None if bubble is None
+                                else round(float(bubble), 4)),
+            "stage_compile_counts": stage_compiles,
+        }
+        all_results.extend(results)
+        depth_results[depth] = results
+    # Cross-depth parity: identical prompts, identical greedy streams at
+    # EVERY depth (micro-batching reorders dispatch, never tokens). A
+    # prompt missing at some depth is a mismatch, not a silent pass.
+    base_depth = depths[0]
+    base_bucket: dict = {}
+    for p, toks in depth_results[base_depth]:
+        base_bucket.setdefault(tuple(p), toks)
+    mismatches = 0
+    for depth in depths[1:]:
+        bucket: dict = {}
+        for p, toks in depth_results[depth]:
+            bucket.setdefault(tuple(p), toks)
+        mismatches += sum(1 for key, toks in base_bucket.items()
+                          if bucket.get(key) != toks)
+    out["depth_parity_mismatches"] = mismatches
+    assert mismatches == 0, (
+        f"{mismatches} prompts streamed different tokens across "
+        f"pipeline depths {depths}")
+    # Headline: deepest depth vs depth 1 (the tentpole claim — depth>=pp
+    # goodput above depth 1 with the bubble reduced).
+    if 1 in depths and depths[-1] != 1:
+        g1 = out["depth1"]["goodput_tokens_per_sec"]
+        gd = out[f"depth{depths[-1]}"]["goodput_tokens_per_sec"]
+        if g1 > 0:
+            out["speedup_x"] = round(gd / g1, 3)
+    report["pp_ab"] = out
+    return all_results
+
+
+def _record_pp_history(args, report):
+    """``serving/pp_*`` rows for the strict CI gate: per-depth goodput +
+    saturated p99 ITL (higher/lower by name), the measured
+    ``bubble_fraction`` each depth leaves on the table (lower-is-better
+    — check_bench_regression knows the name), and the deepest-vs-depth-1
+    speedup."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    sec = report.get("pp_ab") or {}
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    paged = args.paged or args.kv_pool_mb > 0
+    model_tag = f"paged_{args.model}" if paged else args.model
+    base = (f"serving/pp_{model_tag}/pp{sec.get('pp')}/slots{args.slots}"
+            f"/clients{args.clients}")
+    rows: dict = {"speedup_x": sec.get("speedup_x")}
+    for depth in sec.get("depths") or []:
+        d = sec.get(f"depth{depth}") or {}
+        rows[f"depth{depth}/goodput_tokens_per_sec"] = (
+            d.get("goodput_tokens_per_sec"))
+        rows[f"depth{depth}/inter_token_p99_s"] = d.get("inter_token_p99_s")
+        rows[f"depth{depth}/bubble_fraction"] = d.get("bubble_fraction")
+    for metric, v in rows.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0:
+            key = f"{base}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
 async def _kv_tier_ab(args, model, variables, report):
     """Pool-only vs tiered A/B on an OVERSUBSCRIBED shared-prefix
     workload: the prefix working set is laid out at ``--kv-tier-oversub``
@@ -1734,11 +1874,26 @@ def main():
                     help="assert the others' flood/baseline p99-TTFT "
                          "ratio stays <= this (acceptance: 1.25); 0 = "
                          "report only")
-    ap.add_argument("--pipeline-depth", type=int, choices=(0, 1), default=1,
+    ap.add_argument("--pipeline-depth", type=int, default=1,
                     help="decode pipeline depth: 1 (default) dispatches "
                          "tick N+1 before consuming tick N's tokens so "
                          "host bookkeeping hides behind device compute; "
-                         "0 serializes dispatch+harvest")
+                         ">=2 on a pp mesh micro-batches the slots so "
+                         "every stage stays busy; 0 serializes "
+                         "dispatch+harvest")
+    ap.add_argument("--pipeline-depths", default="0,1,2,4",
+                    metavar="D1,D2,...",
+                    help="--pp-ab: comma-separated pipeline depths to "
+                         "sweep (default 0,1,2,4)")
+    ap.add_argument("--pp-ab", action="store_true",
+                    help="pipeline-parallel depth sweep: run the "
+                         "closed-loop workload at every --pipeline-depths "
+                         "depth on the --mesh-shape tp=N,pp=M mesh (fresh "
+                         "armed engine each), report per-depth goodput / "
+                         "p99 ITL / bubble_fraction + the deepest-vs-"
+                         "depth-1 speedup, cross-check every depth's "
+                         "streams token-identical, and record "
+                         "serving/pp_* history rows")
     ap.add_argument("--pipeline-ab", action="store_true",
                     help="A/B the decode pipeline: run the closed-loop "
                          "workload at depth 0 then depth 1 (fresh armed "
@@ -1859,6 +2014,32 @@ def main():
         "mesh": (dict(_mesh(args).shape)
                  if (args.mesh or args.mesh_shape) else None),
     }}
+
+    if args.pp_ab:
+        # Pipeline-parallel depth sweep: its own phases, its own rows.
+        model, variables = _model(args)
+        try:
+            all_results = asyncio.run(
+                _pp_ab(args, model, variables, report))
+            if not args.skip_parity:
+                mism = _check_parity(model, variables, all_results,
+                                     args.new_tokens)
+                report["parity_mismatches"] = mism
+                assert mism == 0, (
+                    f"{mism} pp streams diverged from generate()")
+            if args.min_speedup > 0:
+                got = (report.get("pp_ab") or {}).get("speedup_x")
+                assert got is not None and got >= args.min_speedup, (
+                    f"pp depth speedup {got} < required "
+                    f"{args.min_speedup}")
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        if args.record_history:
+            _record_pp_history(args, report)
+        print(json.dumps(report, indent=1))
+        return
 
     if args.pipeline_ab:
         # Decode-pipeline A/B: its own phases, its own rows.

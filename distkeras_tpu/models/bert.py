@@ -127,6 +127,25 @@ def _pos_window(pos_embed, starts, S: int, max_seq_len: int):
     return pos_embed[0][jnp.clip(pos_ids, 0, max_seq_len - 1)]
 
 
+def _layer_boundary(cfg, x, *, at_boundary: bool):
+    """Pin the residual stream at a decode-mode inter-layer boundary with
+    an ``optimization_barrier`` so XLA cannot fuse across it. Without
+    this, a pipeline-stage slice of the trunk (``stage=``) rounds
+    differently from the monolithic apply — the stage jit MUST
+    materialize the boundary activation while the whole-model jit is
+    free to fuse through it, and the divergent bf16 rounding flips
+    near-tie greedy argmaxes. With every boundary barriered, each
+    layer is an identical fusion island in both compilations and the
+    pp engine stays token-identical to the unsharded one. Decode-mode
+    only: training keeps full cross-layer fusion freedom (no parity
+    contract spans a training jit boundary)."""
+    if at_boundary and cfg.decode:
+        from jax import lax
+
+        x = lax.optimization_barrier(x)
+    return x
+
+
 def _dense(features, logical_axes, name=None, dtype=jnp.bfloat16, use_bias=True):
     return nn.Dense(
         features,
@@ -436,9 +455,22 @@ class Bert(nn.Module):
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False,
-                 positions=None, block_tables=None):
+                 positions=None, block_tables=None, stage=None):
+        """Full apply, or — with ``stage=(lo, hi, first, last)`` — the
+        contiguous layer slice ``[lo, hi)`` of a pipeline stage.
+
+        ``first`` stages take token ids and run the embedding;
+        non-first stages take the previous stage's activation
+        ``[B, S, H]`` as the first argument instead. ``last`` stages run
+        the final LayerNorm + tied head and return logits; non-last
+        stages return the raw activation. Stage boundaries are a
+        serving-time construct: the module is always *initialized* whole
+        (``stage=None``) and the param/cache trees split afterwards
+        (``parallel/pp.py``), so stage applies see exactly their own
+        subtree."""
         cfg = self.cfg
-        token_ids = token_ids.astype(jnp.int32)
+        lo, hi, first, last = (
+            (0, cfg.num_layers, True, True) if stage is None else stage)
         embed = nn.Embed(
             cfg.vocab_size,
             cfg.hidden_size,
@@ -448,6 +480,18 @@ class Bert(nn.Module):
             ),
             name="token_embed",
         )
+        if not first:
+            # Stage input is the previous stage's activation, already
+            # embedded — passed through uncast (the stage boundary must
+            # not re-round the stream the monolithic trunk carries).
+            x = token_ids
+            for i in range(lo, hi):
+                x = _layer_boundary(cfg, x, at_boundary=i > lo)
+                x = EncoderLayer(cfg, name=f"layer_{i}")(
+                    x, train=train,
+                    positions=positions, block_tables=block_tables)
+            return self._head(embed, x) if last else x
+        token_ids = token_ids.astype(jnp.int32)
         pos_embed = self.param(
             "pos_embed",
             nn.with_logical_partitioning(
@@ -509,13 +553,15 @@ class Bert(nn.Module):
             cfg.ring_mesh is not None
             and cfg.sp_impl == "ring_stripe"
             and not cfg.decode
+            and stage is None
         )
         if striped:
             from distkeras_tpu.ops.ring_flash import stripe_shard
 
             sp = dict(cfg.ring_mesh.shape)[cfg.ring_axis]
             x = stripe_shard(x, sp)
-        for i in range(cfg.num_layers):
+        for i in range(lo, hi):
+            x = _layer_boundary(cfg, x, at_boundary=i > lo)
             x = EncoderLayer(cfg, name=f"layer_{i}")(
                 x, train=train,
                 positions=positions, block_tables=block_tables)
@@ -523,6 +569,14 @@ class Bert(nn.Module):
             from distkeras_tpu.ops.ring_flash import stripe_unshard
 
             x = stripe_unshard(x, sp)
+        if not last:
+            return x
+        return self._head(embed, x)
+
+    def _head(self, embed, x):
+        """Final LayerNorm + tied MLM head (the last pipeline stage's
+        tail — and the whole model's, when unstaged)."""
+        cfg = self.cfg
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Tied MLM head: project back through the embedding matrix.
         logits = embed.attend(x.astype(jnp.float32))

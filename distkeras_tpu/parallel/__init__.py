@@ -25,6 +25,7 @@ def __getattr__(name):
         "distributed": "distkeras_tpu.parallel.distributed",
         "ps_grpc": "distkeras_tpu.parallel.ps_grpc",
         "sharding": "distkeras_tpu.parallel.sharding",
+        "pp": "distkeras_tpu.parallel.pp",
     }
     if name in lazy:
         return importlib.import_module(lazy[name])

@@ -31,8 +31,10 @@ __all__ = [
     "best_mesh",
     "data_parallel_shardings",
     "parse_mesh_shape",
+    "pp_stages",
     "serving_mesh",
     "shard_batch_spec",
+    "stage_submesh",
 ]
 
 AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
@@ -130,13 +132,14 @@ def serving_mesh(
         raise ValueError(
             f"serving mesh shape {sizes} has no 'tp' axis; tensor "
             f"parallelism is what a sharded serving replica shards over")
-    extra = {a: s for a, s in sizes.items() if a != "tp" and s > 1}
+    extra = {a: s for a, s in sizes.items()
+             if a not in ("tp", "pp") and s > 1}
     if extra:
         # Rejected HERE so the CLI layer fails one typed line before a
         # model loads (or a cluster spawns N children that would all
         # crash-loop in the engine ctor's identical check).
         raise ValueError(
-            f"serving mesh has non-trivial non-tp axes {extra}: data "
+            f"serving mesh has non-trivial non-tp/pp axes {extra}: data "
             f"parallelism in serving is N replicas (run.py cluster "
             f"--replicas), not a dp mesh axis inside one engine")
     need = math.prod(sizes.values())
@@ -150,6 +153,34 @@ def serving_mesh(
     dims = [sizes[a] for a in names]
     arr = np.array(devices[:need]).reshape(dims)
     return Mesh(arr, axis_names=tuple(names))
+
+
+def pp_stages(mesh: Mesh | None) -> int:
+    """Pipeline-stage count of a serving mesh (1 when unsharded or no
+    ``pp`` axis)."""
+    if mesh is None or "pp" not in mesh.axis_names:
+        return 1
+    return mesh.shape["pp"]
+
+
+def stage_submesh(mesh: Mesh, stage: int) -> Mesh:
+    """The tp-only sub-mesh of pipeline stage ``stage``.
+
+    A jit's inputs must all live on one device set, so each stage
+    compiles its callables against its own ``("tp",)`` mesh: the column
+    of ``mesh.devices`` at pp-index ``stage``. Stage 0 on a ``tp=2,pp=2``
+    mesh is ``devices[:, 0]``."""
+    if "pp" not in mesh.axis_names:
+        if stage != 0:
+            raise ValueError(f"mesh has no pp axis but stage {stage} "
+                             f"requested")
+        return mesh
+    pp_index = mesh.axis_names.index("pp")
+    n_stages = mesh.devices.shape[pp_index]
+    if not 0 <= stage < n_stages:
+        raise ValueError(f"stage {stage} out of range for pp={n_stages}")
+    col = np.take(mesh.devices, stage, axis=pp_index)
+    return Mesh(col.reshape(-1), axis_names=("tp",))
 
 
 def shard_batch_spec(mesh: Mesh) -> P:

@@ -1,0 +1,118 @@
+"""Pipeline-stage planning for pp-sharded serving.
+
+A ``pp=M`` serving mesh (``parallel/mesh.serving_mesh``) splits the
+model into M contiguous layer *stages*, each owning its slice of the
+encoder trunk plus the ends that anchor it: stage 0 owns the embedding
+(``token_embed``, ``pos_embed`` and — dense decode — the ``pos_index``
+cache counter), the last stage owns the final LayerNorm, the ``mlm_bias``
+head and a second placed copy of ``token_embed`` (the tied head reads
+the embedding matrix via ``embed.attend``). Stage parameters and KV
+caches land ONLY on their stage's devices — the shard-then-place seam
+(arXiv:2004.13336) extended from shards to stages, in the spirit of the
+TensorFlow paper's dataflow device placement (arXiv:1605.08695).
+
+The plan is pure bookkeeping over top-level pytree keys: the model is
+always *initialized* whole, then :meth:`StagePlan.split_params` /
+:meth:`StagePlan.split_tree` carve the param and cache trees into
+per-stage subtrees whose keys match exactly what a stage-sliced
+``Bert.__call__`` (``stage=(lo, hi, first, last)``) touches — so each
+stage's jit sees precisely its own placed subtree, and a mismatch fails
+loudly at trace time rather than silently replicating.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["StagePlan", "plan_stages"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlan:
+    """Contiguous equal-size layer assignment of ``num_layers`` encoder
+    layers onto ``num_stages`` pipeline stages."""
+
+    num_layers: int
+    num_stages: int
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.num_layers // self.num_stages
+
+    def layer_range(self, stage: int) -> tuple[int, int]:
+        """``[lo, hi)`` layer indices owned by ``stage``."""
+        if not 0 <= stage < self.num_stages:
+            raise ValueError(
+                f"stage {stage} out of range for pp={self.num_stages}")
+        lo = stage * self.layers_per_stage
+        return lo, lo + self.layers_per_stage
+
+    def stage_arg(self, stage: int) -> tuple[int, int, bool, bool]:
+        """The ``stage=`` argument for a stage-sliced model apply."""
+        lo, hi = self.layer_range(stage)
+        return (lo, hi, stage == 0, stage == self.num_stages - 1)
+
+    def stage_of_layer(self, layer: int) -> int:
+        if not 0 <= layer < self.num_layers:
+            raise ValueError(f"layer {layer} out of range for "
+                             f"{self.num_layers} layers")
+        return layer // self.layers_per_stage
+
+    def owner_stages(self, key: str) -> tuple[int, ...]:
+        """Which stage(s) hold top-level param-tree key ``key``."""
+        last = self.num_stages - 1
+        if key == "token_embed":
+            # Stage 0 embeds; the last stage's tied head reads the same
+            # matrix via ``embed.attend`` — both get a placed copy.
+            return (0,) if last == 0 else (0, last)
+        if key == "pos_embed":
+            return (0,)
+        if key in ("ln_final", "mlm_bias"):
+            return (last,)
+        if key.startswith("layer_"):
+            return (self.stage_of_layer(int(key[len("layer_"):])),)
+        raise KeyError(f"no stage assignment for param key {key!r}")
+
+    def split_params(self, params) -> list[dict]:
+        """Per-stage param subtrees (top-level-key split; ``token_embed``
+        appears on both stage 0 and the last stage)."""
+        parts: list[dict] = [{} for _ in range(self.num_stages)]
+        for key in params:
+            for s in self.owner_stages(key):
+                parts[s][key] = params[key]
+        return parts
+
+    def split_tree(self, tree) -> list[dict]:
+        """Per-stage cache/KV subtrees: ``layer_i`` keys go to the
+        layer's owning stage, the dense ``pos_index`` counter to stage 0
+        (it feeds the embedding's positional slice)."""
+        parts: list[dict] = [{} for _ in range(self.num_stages)]
+        for key in tree:
+            if key.startswith("layer_"):
+                s = self.stage_of_layer(int(key[len("layer_"):]))
+            elif key == "pos_index":
+                s = 0
+            else:
+                raise KeyError(f"no stage assignment for cache key {key!r}")
+            parts[s][key] = tree[key]
+        return parts
+
+
+def plan_stages(num_layers: int, num_stages: int) -> StagePlan:
+    """Validated stage plan; raises ``ValueError`` (typed, CLI-surfaced)
+    when the layer count cannot split into ``num_stages`` contiguous
+    equal stages."""
+    num_layers = int(num_layers)
+    num_stages = int(num_stages)
+    if num_stages < 1:
+        raise ValueError(f"pp={num_stages} must be >= 1")
+    if num_layers < num_stages:
+        raise ValueError(
+            f"pp={num_stages} stages need at least one layer each but "
+            f"the model has {num_layers} layers")
+    if num_layers % num_stages != 0:
+        raise ValueError(
+            f"num_layers={num_layers} does not divide into pp="
+            f"{num_stages} contiguous equal stages; choose a pp that "
+            f"divides the layer count")
+    return StagePlan(num_layers=num_layers, num_stages=num_stages)

@@ -157,11 +157,14 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "tokens, one per decode tick — bounds the decode "
                          "stall (p99 ITL) a long prompt can cause; "
                          "default: monolithic prefill")
-    ap.add_argument("--pipeline-depth", type=int, choices=(0, 1), default=1,
+    ap.add_argument("--pipeline-depth", type=int, default=1,
                     help="decode pipeline depth: 1 (default) dispatches "
                          "tick N+1 before consuming tick N's tokens, so "
                          "host bookkeeping (streaming, admission, socket "
-                         "reads) overlaps device compute; 0 serializes "
+                         "reads) overlaps device compute; >=2 on a pp "
+                         "mesh micro-batches the slots to keep every "
+                         "stage busy (depth>=pp hides stage bubbles); "
+                         "0 serializes "
                          "dispatch and harvest (the pre-pipeline "
                          "behavior). Greedy output is token-identical "
                          "either way — see docs/serving.md 'Decode "
@@ -828,9 +831,10 @@ def deploy_main(argv=None) -> int:
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="replica chunked-prefill size (tokens)")
-    ap.add_argument("--pipeline-depth", type=int, choices=(0, 1), default=1,
+    ap.add_argument("--pipeline-depth", type=int, default=1,
                     help="replica decode pipeline depth (1 overlaps host "
-                         "bookkeeping with device compute; 0 serializes)")
+                         "bookkeeping with device compute; >=2 "
+                         "micro-batches a pp mesh; 0 serializes)")
     ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
                     help="replica prefix-cache byte budget (MB)")
     ap.add_argument("--prefix-block", type=int, default=16,

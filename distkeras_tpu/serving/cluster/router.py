@@ -536,6 +536,7 @@ class Router:
         self._c_pushes = self._c_push_fallbacks = None
         self._c_push_bytes = self._c_push_saved_bytes = None
         self._c_dir_hits = self._c_dir_evictions = None
+        self._c_dir_steered = None
         self._h_handoff = None
         if registry is not None:
             self._c_requests = registry.counter(
@@ -598,6 +599,12 @@ class Router:
                 "router_kv_directory_evictions_total",
                 help="directory entries dropped as stale (owner dead "
                      "or restarted under a new generation)")
+            self._c_dir_steered = registry.counter(
+                "router_kv_dir_steered_total",
+                help="decode dispatches steered to a replica the cache "
+                     "directory showed already holding the prompt's "
+                     "prefix family (with KV capacity headroom) — the "
+                     "whole transfer skipped by placement")
             self._h_handoff = registry.histogram(
                 "router_kv_prefill_seconds",
                 help="prefill-replica handoff latency (kv_prefill "
@@ -680,9 +687,26 @@ class Router:
             # once per fleet) and any decode replica adopts them, so a
             # decode-side pin would only manufacture hotspots. The
             # affinity_prefix is now purely a prefill-placement hint —
-            # decode picks go least-outstanding. (docs/serving.md
+            # decode picks go least-outstanding... UNLESS the fleet
+            # cache directory already shows the family resident on a
+            # decode replica WITH KV capacity headroom: steering there
+            # skips the transfer entirely (the cheapest byte is the one
+            # never moved), bounded by the same affinity_slack so a hot
+            # holder never turns into a hotspot. (docs/serving.md
             # "Disaggregated serving".)
-            return min(ready, key=lambda r: r.outstanding)
+            least = min(ready, key=lambda r: r.outstanding)
+            fam = self._family(prompt)
+            holders = [r for r in ready
+                       if self._dir_holds(fam, r)
+                       and self._kv_headroom(r)]
+            if holders:
+                pick = min(holders, key=lambda r: r.outstanding)
+                if (pick.outstanding - least.outstanding
+                        <= self.affinity_slack):
+                    if self._c_dir_steered is not None:
+                        self._c_dir_steered.inc()
+                    return pick
+            return least
         fam = self._family(prompt)
         # Rendezvous (highest-random-weight) hash: each family ranks every
         # replica; the top-ranked READY one wins. Replica death/drain only
@@ -1450,6 +1474,21 @@ class Router:
             return False
         return True
 
+    def _kv_headroom(self, info: ReplicaInfo) -> bool:
+        """True when ``info``'s last health probe showed free KV pool
+        capacity — the gate on directory steering (a full holder would
+        just preempt what it holds to admit the steered request, losing
+        the very blocks we steered for). A replica whose healthz never
+        reported a pool (unpaged, or no probe yet) counts as capacious:
+        steering is an optimization, not a correctness gate."""
+        pool = (info.last_health or {}).get("kv_pool")
+        if not isinstance(pool, dict) or "blocks_free" not in pool:
+            return True
+        try:
+            return int(pool["blocks_free"]) > 0
+        except (TypeError, ValueError):
+            return True
+
     def _plan_kv_transfer(self, spec: dict, src: ReplicaInfo,
                           dst: ReplicaInfo, trace) -> None:
         """Decide how the decode pick ``dst`` gets the family's blocks
@@ -1547,7 +1586,8 @@ class Router:
                         ("push_bytes", self._c_push_bytes),
                         ("push_bytes_saved", self._c_push_saved_bytes),
                         ("directory_hits", self._c_dir_hits),
-                        ("directory_evictions", self._c_dir_evictions)):
+                        ("directory_evictions", self._c_dir_evictions),
+                        ("directory_steered", self._c_dir_steered)):
             if c is not None:
                 out[name] = int(c.value)
         return out

@@ -58,6 +58,13 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
             + (f" host_gap_p50={gap * 1e3:.3f}ms" if gap is not None
                else "")
             + (f" device_idle={idle:.1%}" if idle is not None else ""))
+        if pl.get("stages"):
+            bf = pl.get("bubble_fraction")
+            lines.append(
+                f"{indent}stages: {pl['stages']} pp stage(s) x "
+                f"{pl.get('micro_batches')} micro-batch(es), "
+                f"inflight_ticks={pl.get('inflight_ticks')}"
+                + (f" bubble={bf:.1%}" if bf is not None else ""))
     sp = dz.get("speculative")
     if sp:
         rate = sp.get("accept_rate")

@@ -542,6 +542,222 @@ def _draft_admit_fn(cache, slot, pre_cache):
     )
 
 
+# -- pipeline-parallel stage programs ---------------------------------------
+# Per-stage twins of the monolithic programs above, for a ``tp=N,pp=M``
+# serving mesh: each stage's jit sees ONLY its own placed param/cache
+# subtree (parallel/pp.StagePlan) and compiles against its own tp-only
+# sub-mesh. A non-last stage returns the traced activation the next
+# stage's jit consumes — jax transfers it between the stage device sets
+# at dispatch, and because the activation is ALWAYS committed to the
+# producing stage's layout the consuming jit keys one cache entry (jit
+# entries key on actual argument placement, so source-consistency is
+# what keeps compile-count==1 per stage). ``stage`` is the static
+# ``(lo, hi, first, last)`` slice Bert.__call__ takes.
+
+def _pp_prefill_fn(module, stage, params, cache, x, start, true_len):
+    """Non-last-stage slice of :func:`_prefill_fn`: extend this stage's
+    single-row cache with the chunk and hand the activation on. ``x`` is
+    the padded ``[1, P]`` token chunk on stage 0, the previous stage's
+    ``[1, P, H]`` activation after; the index-leaf entry/rewind contract
+    is per stage (every stage owns its own layers' index leaves)."""
+    cache = cache_with_index(cache, start)
+    act, mut = module.apply(
+        {"params": params, "cache": cache}, x, train=False,
+        mutable=["cache"], stage=stage,
+    )
+    return cache_with_index(mut["cache"], start + true_len), act
+
+
+def _pp_prefill_last_fn(module, stage, top_k, params, cache, act, start,
+                        true_len, temp, key):
+    """Last-stage slice of :func:`_prefill_fn`: trunk tail + head +
+    the sampling epilogue."""
+    cache = cache_with_index(cache, start)
+    logits, mut = module.apply(
+        {"params": params, "cache": cache}, act, train=False,
+        mutable=["cache"], stage=stage,
+    )
+    cache = cache_with_index(mut["cache"], start + true_len)
+    last = jnp.take(logits[0], true_len - 1, axis=0)[None]  # [1, V]
+    tok = sample_rows(last, temp[None], key, top_k)[0]
+    return cache, tok
+
+
+def _pp_decode_fn(module, stage, params, cache, x):
+    """Non-last-stage slice of :func:`_decode_fn` (``x`` is ``tokens[:,
+    None]`` on stage 0, the previous activation after)."""
+    act, mut = module.apply(
+        {"params": params, "cache": cache}, x, train=False,
+        mutable=["cache"], stage=stage,
+    )
+    return mut["cache"], act
+
+
+def _pp_decode_last_fn(module, stage, top_k, params, cache, act, temps, key):
+    """Last-stage slice of :func:`_decode_fn`: trunk tail + sampling."""
+    logits, mut = module.apply(
+        {"params": params, "cache": cache}, act, train=False,
+        mutable=["cache"], stage=stage,
+    )
+    nxt = sample_rows(logits[:, -1], temps, key, top_k)
+    return mut["cache"], nxt
+
+
+def _pp_paged_prefill_fn(module, stage, params, pools, x, start, table_row):
+    """Non-last-stage slice of :func:`_paged_prefill_fn` — this stage's
+    layer K/V scatters into ITS pool shard through the (replicated-
+    per-stage) table row."""
+    act, mut = module.apply(
+        {"params": params, "cache": pools}, x, train=False,
+        mutable=["cache"],
+        positions=jnp.full((1,), start, jnp.int32),
+        block_tables=table_row[None], stage=stage,
+    )
+    return mut["cache"], act
+
+
+def _pp_paged_prefill_last_fn(module, stage, top_k, params, pools, act,
+                              start, true_len, table_row, temp, key):
+    """Last-stage slice of :func:`_paged_prefill_fn`."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, act, train=False,
+        mutable=["cache"],
+        positions=jnp.full((1,), start, jnp.int32),
+        block_tables=table_row[None], stage=stage,
+    )
+    last = jnp.take(logits[0], true_len - 1, axis=0)[None]  # [1, V]
+    tok = sample_rows(last, temp[None], key, top_k)[0]
+    return mut["cache"], tok
+
+
+def _pp_paged_decode_fn(module, stage, sentinel, params, pools, x, positions,
+                        tables):
+    """Non-last-stage slice of :func:`_paged_decode_fn`. Every stage
+    returns its own ``positions + live`` vector (the advance rule is
+    pure table arithmetic, identical across stages), so each stage's
+    steady-state tick re-feeds its OWN returned vector and no positions
+    ever cross stages."""
+    act, mut = module.apply(
+        {"params": params, "cache": pools}, x, train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+        stage=stage,
+    )
+    live = (tables[:, 0] != sentinel).astype(positions.dtype)
+    return mut["cache"], act, positions + live
+
+
+def _pp_paged_decode_last_fn(module, stage, top_k, sentinel, params, pools,
+                             act, temps, positions, tables, key):
+    """Last-stage slice of :func:`_paged_decode_fn`."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, act, train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+        stage=stage,
+    )
+    nxt = sample_rows(logits[:, -1], temps, key, top_k)
+    live = (tables[:, 0] != sentinel).astype(positions.dtype)
+    return mut["cache"], nxt, positions + live
+
+
+def _pp_verify_first_fn(module, stage, params, cache, tokens, drafts,
+                        positions):
+    """Stage-0 slice of :func:`_spec_verify_fn`: build the verify window
+    and run this stage's layers over it. The index leaves are left at
+    ``positions + K``; the rewind to ``positions + commit`` happens in
+    :func:`_pp_index_rewind_fn` once the LAST stage has decided the
+    commit (the commit is a device scalar vector — the rewind jit
+    consumes it without a host sync)."""
+    window = jnp.concatenate([tokens[:, None], drafts[:, :-1]], axis=1)
+    cache = cache_with_index(cache, positions)
+    act, mut = module.apply(
+        {"params": params, "cache": cache}, window, train=False,
+        mutable=["cache"], stage=stage,
+    )
+    return mut["cache"], act
+
+
+def _pp_verify_fn(module, stage, params, cache, act, positions):
+    """Middle-stage slice of :func:`_spec_verify_fn`."""
+    cache = cache_with_index(cache, positions)
+    act, mut = module.apply(
+        {"params": params, "cache": cache}, act, train=False,
+        mutable=["cache"], stage=stage,
+    )
+    return mut["cache"], act
+
+
+def _pp_verify_last_fn(module, stage, top_k, params, cache, act, drafts,
+                       tokens, temps, spec_ok, remaining, positions, key):
+    """Last-stage slice of :func:`_spec_verify_fn`: head + accept +
+    THIS stage's index rewind (earlier stages rewind via
+    :func:`_pp_index_rewind_fn` with the returned commit)."""
+    cache = cache_with_index(cache, positions)
+    logits, mut = module.apply(
+        {"params": params, "cache": cache}, act, train=False,
+        mutable=["cache"], stage=stage,
+    )
+    out, commit = _spec_accept(logits, drafts, tokens, temps, spec_ok,
+                               remaining, key, top_k)
+    cache = cache_with_index(mut["cache"], positions + commit)
+    new_tok = jnp.where(
+        commit > 0,
+        jnp.take_along_axis(
+            out, jnp.maximum(commit - 1, 0)[:, None], axis=1)[:, 0],
+        tokens)
+    return cache, new_tok, out, commit
+
+
+def _pp_index_rewind_fn(cache, positions, commit):
+    """Roll a non-last stage's index leaves back from ``positions + K``
+    to ``positions + commit`` after a verify — the per-stage half of the
+    dense rollback contract."""
+    return cache_with_index(cache, positions + commit)
+
+
+def _pp_paged_verify_first_fn(module, stage, params, pools, tokens, drafts,
+                              positions, tables):
+    """Stage-0 slice of :func:`_paged_spec_verify_fn` (no index leaves —
+    rollback is the host not advancing ``_lens``)."""
+    window = jnp.concatenate([tokens[:, None], drafts[:, :-1]], axis=1)
+    act, mut = module.apply(
+        {"params": params, "cache": pools}, window, train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+        stage=stage,
+    )
+    return mut["cache"], act
+
+
+def _pp_paged_verify_fn(module, stage, params, pools, act, positions,
+                        tables):
+    """Middle-stage slice of :func:`_paged_spec_verify_fn`."""
+    act, mut = module.apply(
+        {"params": params, "cache": pools}, act, train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+        stage=stage,
+    )
+    return mut["cache"], act
+
+
+def _pp_paged_verify_last_fn(module, stage, top_k, params, pools, act,
+                             drafts, tokens, temps, spec_ok, remaining,
+                             room, positions, tables, key):
+    """Last-stage slice of :func:`_paged_spec_verify_fn`."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, act, train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+        stage=stage,
+    )
+    out, commit = _spec_accept(logits, drafts, tokens, temps, spec_ok,
+                               remaining, key, top_k)
+    commit = jnp.minimum(commit, room)
+    new_tok = jnp.where(
+        commit > 0,
+        jnp.take_along_axis(
+            out, jnp.maximum(commit - 1, 0)[:, None], axis=1)[:, 0],
+        tokens)
+    return mut["cache"], new_tok, out, commit
+
+
 @dataclasses.dataclass
 class _PrefillJob:
     """Partial-prefill progress for a slot still being admitted: the
@@ -571,14 +787,16 @@ def _tick_ready(tick) -> bool:
 
 @dataclasses.dataclass
 class _InflightTick:
-    """A dispatched-but-unharvested decode tick (``pipeline_depth=1``):
-    the device handles the harvest will read, the decodable rows the
-    dispatch covered (the stream targets — the slot table may gain or
-    lose entries before the harvest, and a row must stream iff it was
-    decodable AT DISPATCH and its slot is still alive), and — plain
-    paged ticks — the slots whose host ``_lens`` watermark the dispatch
-    optimistically advanced, so a teardown detected mid-flight can roll
-    the advance back before adopting blocks."""
+    """A dispatched-but-unharvested decode tick: the device handles the
+    harvest will read, the decodable rows the dispatch covered (the
+    stream targets — the slot table may gain or lose entries before the
+    harvest, and a row must stream iff it was decodable AT DISPATCH and
+    its slot is still alive), and — plain paged ticks — the slots whose
+    host ``_lens`` watermark the dispatch optimistically advanced, so a
+    teardown detected mid-flight can roll the advance back before
+    adopting blocks. With ``pipeline_depth>1`` on a pp mesh each tick
+    covers ONE slot micro-batch; ``mb``/``mb_start`` map its mb-local
+    token vector back to global slot ids at stream time."""
 
     kind: str                     # "decode" | "spec"
     rows: tuple                   # decodable slots at dispatch
@@ -588,6 +806,8 @@ class _InflightTick:
     commit: object = None         # spec: device per-row commit counts
     caps: object = None           # spec: host per-row draft budgets
     advanced: set = dataclasses.field(default_factory=set)
+    mb: int = 0                   # micro-batch index (pp depth>1)
+    mb_start: int = 0             # first global slot id of the micro-batch
 
 
 def _public_provenance(provenance: dict | None) -> dict:
@@ -754,15 +974,17 @@ class ServingEngine:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1 or None, got {prefill_chunk}")
-        if pipeline_depth not in (0, 1):
+        if int(pipeline_depth) != pipeline_depth or pipeline_depth < 0:
             raise ValueError(
-                f"pipeline_depth must be 0 (serialized dispatch+harvest) "
-                f"or 1 (dispatch tick N+1 before consuming tick N), got "
-                f"{pipeline_depth}")
+                f"pipeline_depth must be a non-negative int: 0 (serialized "
+                f"dispatch+harvest), 1 (dispatch tick N+1 before consuming "
+                f"tick N), or >1 (micro-batched ticks overlapping pipeline "
+                f"stages; needs a pp mesh), got {pipeline_depth}")
         self.pipeline_depth = int(pipeline_depth)
-        # The dispatched-but-unharvested tick (depth 1) and a bounded
-        # dispatch->harvest timeline (the tracez tick lane).
-        self._inflight: _InflightTick | None = None
+        # Dispatched-but-unharvested ticks, oldest first (at most
+        # max(1, pipeline_depth) deep), and a bounded dispatch->harvest
+        # timeline (the tracez tick lane).
+        self._inflight: collections.deque = collections.deque()
         self._tick_log: collections.deque = collections.deque(maxlen=256)
         # False until the first decode dispatch has run (and therefore
         # compiled): the FIRST dispatch goes through the executor so a
@@ -787,25 +1009,60 @@ class ServingEngine:
         # ValueError here, not a jax lowering error three layers down.
         self.mesh = mesh
         self._tp = 1
+        self._pp = 1
         self._replicated = None
         self._param_shardings = None
         self._cache_shardings = None
+        self._stage_plan = None
+        self._stage_meshes = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from distkeras_tpu.parallel.mesh import pp_stages
 
             if "tp" not in mesh.axis_names:
                 raise ValueError(
                     f"serving mesh {dict(mesh.shape)} has no 'tp' axis; "
                     f"build it with parallel.mesh.serving_mesh")
             self._tp = int(mesh.shape["tp"])
+            self._pp = int(pp_stages(mesh))
             extra = {a: s for a, s in mesh.shape.items()
-                     if a != "tp" and s > 1}
+                     if a not in ("tp", "pp") and s > 1}
             if extra:
                 raise ValueError(
                     f"serving mesh has non-trivial non-tp axes {extra}: "
                     f"data parallelism in serving is N replicas (run.py "
                     f"cluster), not a dp mesh axis inside one engine")
             self._replicated = NamedSharding(mesh, P())
+        # Micro-batch geometry. pipeline_depth > 1 only buys overlap when
+        # ticks flow through >1 stage (a single-stage device serializes
+        # them anyway), so it requires a pp mesh; the slot batch is then
+        # partitioned into max(1, depth) contiguous micro-batches, each
+        # with at most one tick in flight at steady state.
+        if self.pipeline_depth > 1:
+            if self._pp < 2:
+                raise ValueError(
+                    f"pipeline_depth={self.pipeline_depth} needs a pp>=2 "
+                    f"serving mesh (micro-batched ticks only overlap "
+                    f"across pipeline stages; --mesh-shape tp=N,pp=M)")
+            if self._spec:
+                raise ValueError(
+                    f"pipeline_depth={self.pipeline_depth} is incompatible "
+                    f"with speculative decoding (draft/verify ticks span "
+                    f"the whole slot batch); use pipeline_depth<=1")
+            if slots % self.pipeline_depth:
+                raise ValueError(
+                    f"slots={slots} does not divide into pipeline_depth="
+                    f"{self.pipeline_depth} equal micro-batches")
+        self._mb_count = (max(1, self.pipeline_depth)
+                          if self._pp > 1 else 1)
+        self._mb_size = int(slots) // self._mb_count
+        self._mb_rr = 0
+        if self._pp > 1 and kv_host_tier_mb > 0:
+            raise ValueError(
+                "kv_host_tier_mb > 0 is not supported on a pp mesh yet: "
+                "the host tier's gather/scatter programs span the whole "
+                "pool, which is stage-partitioned under pp")
         # Geometry probe: the plain decode-slots config, for the trained
         # context limit and (paged) the per-token KV byte cost.
         base_module, base_cfg = _decode_module(model, slots=True)
@@ -906,6 +1163,27 @@ class ServingEngine:
             raise ValueError(
                 f"top_k={top_k} outside [1, vocab_size={self._cfg.vocab_size}]"
             )
+        if self._pp > 1:
+            # Stage plan + per-stage modules. Each stage's module differs
+            # from the engine's only in ``tp_mesh``: the sharding
+            # constraints inside its compiled programs must name the
+            # stage's OWN tp-only sub-mesh (a constraint against the full
+            # tp×pp mesh would pin buffers to devices outside the
+            # stage-jit's device set).
+            from distkeras_tpu.parallel.mesh import stage_submesh
+            from distkeras_tpu.parallel.pp import plan_stages
+
+            self._stage_plan = plan_stages(self._cfg.num_layers, self._pp)
+            self._stage_meshes = [stage_submesh(mesh, s)
+                                  for s in range(self._pp)]
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._stage_rep = [NamedSharding(m, P())
+                               for m in self._stage_meshes]
+            self._stage_modules = [
+                type(self._module)(
+                    dataclasses.replace(self._cfg, tp_mesh=m))
+                for m in self._stage_meshes]
         # Device-resident params from the start. An engine booted from a
         # weights FILE used to hold raw numpy leaves here — every jitted
         # dispatch re-converted them, and the first param swap (which
@@ -924,18 +1202,61 @@ class ServingEngine:
                 kv_pytree_shardings,
             )
 
-            abstract = jax.eval_shape(
-                lambda r: self._module.init(
-                    r, jnp.zeros((int(slots), 1), jnp.int32), train=False),
-                jax.random.PRNGKey(0))
-            self._param_shardings = infer_variable_shardings(
-                mesh, abstract)["params"]
-            self._cache_shardings = kv_pytree_shardings(
-                mesh, abstract["cache"])
+            if self._pp > 1:
+                # Per-stage shard-then-place: the abstract variables are
+                # split along the stage plan and each stage's subtrees
+                # resolve their logical axes against the stage's OWN
+                # sub-mesh — so every param/KV leaf lands only on its
+                # stage's devices, at boot and at every later hot swap.
+                # The cache template is micro-batch-shaped: with
+                # pipeline_depth>1 each micro-batch owns an independent
+                # [mb_size, ...] cache tree per stage.
+                abstract = jax.eval_shape(
+                    lambda r: self._module.init(
+                        r, jnp.zeros((self._mb_size, 1), jnp.int32),
+                        train=False),
+                    jax.random.PRNGKey(0))
+                plan = self._stage_plan
+                # Abstract UNSPLIT param template: what a reload's tree
+                # must look like (request_param_swap validates against
+                # this, never the per-stage list with its duplicated
+                # tied embedding). Unboxed — the live params carry no
+                # LogicallyPartitioned metadata, and re-hanging a
+                # reload's leaves on a boxed treedef would retrace
+                # every stage jit at the swap rewarm.
+                from distkeras_tpu.parallel.sharding import unbox
+
+                self._swap_template = jax.tree.flatten(
+                    unbox(abstract["params"]))
+                self._param_shardings = [
+                    infer_variable_shardings(m, {"params": p})["params"]
+                    for m, p in zip(self._stage_meshes,
+                                    plan.split_params(abstract["params"]))]
+                self._cache_shardings = [
+                    kv_pytree_shardings(m, c)
+                    for m, c in zip(self._stage_meshes,
+                                    plan.split_tree(abstract["cache"]))]
+            else:
+                abstract = jax.eval_shape(
+                    lambda r: self._module.init(
+                        r, jnp.zeros((int(slots), 1), jnp.int32),
+                        train=False),
+                    jax.random.PRNGKey(0))
+                self._param_shardings = infer_variable_shardings(
+                    mesh, abstract)["params"]
+                self._cache_shardings = kv_pytree_shardings(
+                    mesh, abstract["cache"])
         from distkeras_tpu.parallel.gspmd import place_sharded
 
-        self._params = place_sharded(variables["params"],
-                                     self._param_shardings)
+        if self._pp > 1:
+            self._params = [
+                place_sharded(part, sh)
+                for part, sh in zip(
+                    self._stage_plan.split_params(variables["params"]),
+                    self._param_shardings)]
+        else:
+            self._params = place_sharded(variables["params"],
+                                         self._param_shardings)
         self.slots = int(slots)
         self.metrics = metrics or ServingMetrics()
         self.scheduler = Scheduler(
@@ -960,19 +1281,64 @@ class ServingEngine:
         # Sharded: the KV leaves are committed to their heads-sharded
         # layout at creation, and every compiled program's out_shardings
         # pins the same layout, so the bytes never migrate.
-        self._cache = _empty_cache(self._module, self.slots)
-        self._tokens = jnp.zeros((self.slots,), jnp.int32)
-        self._temps = jnp.zeros((self.slots,), jnp.float32)
-        if mesh is not None:
-            # Commit the rebound state to its layout NOW: jit cache
-            # entries key on the actual argument shardings, so a warmup
-            # or swap-rewarm tick on ctor-fresh (uncommitted) tokens
-            # would occupy a DIFFERENT executable than every post-
-            # admission tick on committed jit outputs — two compiles of
-            # one program, which the armed auditor rightly refuses.
-            self._cache = jax.device_put(self._cache, self._cache_shardings)
-            self._tokens = jax.device_put(self._tokens, self._replicated)
-            self._temps = jax.device_put(self._temps, self._replicated)
+        if self._pp > 1:
+            # Stage-partitioned state. ``_cache`` is a per-stage list —
+            # paged: each stage's slice of the shared pools; dense: a
+            # per-stage list of per-MICRO-BATCH [mb_size, ...] trees
+            # (micro-batches must own disjoint device buffers so depth>1
+            # ticks never contend for a donated cache). ``_tokens`` /
+            # ``_temps`` are per-micro-batch vectors committed to the
+            # LAST stage — where sampling produces and admission updates
+            # them — so every feed of the stage-0 decode program carries
+            # the same placement and its jit keys one cache entry.
+            plan = self._stage_plan
+            if self._paged:
+                self._cache = [
+                    jax.device_put(part, sh)
+                    for part, sh in zip(
+                        plan.split_tree(
+                            _empty_cache(self._module, self.slots)),
+                        self._cache_shardings)]
+            else:
+                mb_tree = plan.split_tree(
+                    _empty_cache(self._module, self._mb_size))
+                # Fresh zeros PER micro-batch: device_put of one shared
+                # source tree can alias, and an aliased buffer donated
+                # by micro-batch m's tick would be deleted out from
+                # under micro-batch m+1's.
+                self._cache = [
+                    [jax.device_put(
+                        jax.tree.map(
+                            lambda a: jnp.zeros(a.shape, a.dtype), part),
+                        sh)
+                     for _ in range(self._mb_count)]
+                    for part, sh in zip(mb_tree, self._cache_shardings)]
+            rep_last = self._stage_rep[-1]
+            self._tokens = [
+                jax.device_put(jnp.zeros((self._mb_size,), jnp.int32),
+                               rep_last)
+                for _ in range(self._mb_count)]
+            self._temps = [
+                jax.device_put(jnp.zeros((self._mb_size,), jnp.float32),
+                               rep_last)
+                for _ in range(self._mb_count)]
+        else:
+            self._cache = _empty_cache(self._module, self.slots)
+            self._tokens = jnp.zeros((self.slots,), jnp.int32)
+            self._temps = jnp.zeros((self.slots,), jnp.float32)
+            if mesh is not None:
+                # Commit the rebound state to its layout NOW: jit cache
+                # entries key on the actual argument shardings, so a
+                # warmup or swap-rewarm tick on ctor-fresh (uncommitted)
+                # tokens would occupy a DIFFERENT executable than every
+                # post-admission tick on committed jit outputs — two
+                # compiles of one program, which the armed auditor
+                # rightly refuses.
+                self._cache = jax.device_put(self._cache,
+                                             self._cache_shardings)
+                self._tokens = jax.device_put(self._tokens,
+                                              self._replicated)
+                self._temps = jax.device_put(self._temps, self._replicated)
         self._slot_state: list[_SlotState | None] = [None] * self.slots
 
         self.kv_pool: KVBlockPool | None = None
@@ -1067,14 +1433,40 @@ class ServingEngine:
                     kv_pytree_shardings,
                 )
 
-                self._row_shardings = kv_pytree_shardings(
-                    mesh, self._row_shapes)
-            self._fresh_row_cache = jax.jit(
-                lambda: jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, s.dtype),
-                    self._row_shapes),
-                **({} if mesh is None
-                   else {"out_shardings": self._row_shardings}))
+                if self._pp > 1:
+                    # A "row cache" under pp is a per-stage LIST of
+                    # single-row subtrees, each placed on its stage; the
+                    # prefill chain, the admit splice, and the prefix
+                    # cache all thread the list.
+                    self._row_shapes = self._stage_plan.split_tree(
+                        self._row_shapes)
+                    self._row_shardings = [
+                        kv_pytree_shardings(m, part)
+                        for m, part in zip(self._stage_meshes,
+                                           self._row_shapes)]
+                else:
+                    self._row_shardings = kv_pytree_shardings(
+                        mesh, self._row_shapes)
+            if self._pp > 1:
+                fresh_jits = [
+                    jax.jit(
+                        functools.partial(
+                            lambda shapes: jax.tree.map(
+                                lambda s: jnp.zeros(s.shape, s.dtype),
+                                shapes),
+                            part),
+                        out_shardings=sh)
+                    for part, sh in zip(self._row_shapes,
+                                        self._row_shardings)]
+                self._fresh_row_cache = (
+                    lambda jits=fresh_jits: [f() for f in jits])
+            else:
+                self._fresh_row_cache = jax.jit(
+                    lambda: jax.tree.map(
+                        lambda s: jnp.zeros(s.shape, s.dtype),
+                        self._row_shapes),
+                    **({} if mesh is None
+                       else {"out_shardings": self._row_shardings}))
 
             # Prefix cache: a byte-budgeted pool of KV blocks shared
             # across requests (serving/prefix_cache.py). An explicit
@@ -1089,7 +1481,8 @@ class ServingEngine:
                 self.prefix_cache = PrefixCache(
                     self._row_shapes, block_tokens=prefix_block_tokens,
                     budget_bytes=int(prefix_cache_mb * 2**20),
-                    registry=self.metrics.registry, mesh=mesh)
+                    registry=self.metrics.registry, mesh=mesh,
+                    stage_meshes=self._stage_meshes)
             else:
                 self.prefix_cache = None
             if self.prefix_cache is not None:
@@ -1119,15 +1512,19 @@ class ServingEngine:
             # the draft is small by definition — gpt_tiny drafting for
             # gpt_small — so replication buys a collective-free draft
             # scan on the latency-critical path for a memory cost that
-            # is noise next to the sharded target.
+            # is noise next to the sharded target. Under pp the draft
+            # lives on STAGE 0's sub-mesh only (its proposals feed the
+            # verify chain from the front).
+            self._draft_rep = (self._stage_rep[0] if self._pp > 1
+                               else self._replicated)
             self._draft_params = (
                 jax.device_put(draft_variables["params"])
                 if mesh is None else
-                jax.device_put(draft_variables["params"], self._replicated))
+                jax.device_put(draft_variables["params"], self._draft_rep))
             self._draft_cache = _empty_cache(self._draft_module, self.slots)
             if mesh is not None:
                 self._draft_cache = jax.device_put(self._draft_cache,
-                                                   self._replicated)
+                                                   self._draft_rep)
             self._draft_row_shapes = jax.eval_shape(
                 lambda r: self._draft_module.init(
                     r, jnp.zeros((1, 1), jnp.int32), train=False),
@@ -1138,7 +1535,7 @@ class ServingEngine:
                     lambda s: jnp.zeros(s.shape, s.dtype),
                     self._draft_row_shapes),
                 **({} if mesh is None
-                   else {"out_shardings": self._replicated}))
+                   else {"out_shardings": self._draft_rep}))
             # Host-side fed-token counts (int32 [slots], DENSE mode):
             # the per-row position the draft's entry rewind and the
             # dense verify's index rewind both derive from. Paged mode
@@ -1181,7 +1578,9 @@ class ServingEngine:
         rep = self._replicated
         psh = self._param_shardings
         csh = self._cache_shardings
-        if self._paged:
+        if self._pp > 1:
+            self._build_pp_programs(top_k, auditor)
+        elif self._paged:
             self._prefill = _sharded_jit(
                 functools.partial(_paged_prefill_fn, self._module, top_k),
                 (psh, csh, rep, rep, rep, rep, rep, rep), (csh, rep),
@@ -1222,7 +1621,7 @@ class ServingEngine:
             self._decode_step = _sharded_jit(
                 functools.partial(_decode_fn, self._module, top_k),
                 (psh, csh, rep, rep, rep), (csh, rep), donate=(1,))
-        if self._spec:
+        if self._spec and self._pp == 1:
             # Draft cache donated; tokens are NOT (the verify consumes
             # them right after). Verify donates cache + tokens exactly
             # like the fallback decode step it substitutes for. The
@@ -1258,7 +1657,10 @@ class ServingEngine:
         # call instead of silently stretching tail latency.
         self.auditor = auditor
         self._arm_after_warmup = bool(arm_auditor_after_warmup)
-        if auditor is not None:
+        if self._pp == 1:
+            self._decode_audit_names = ["serving_decode"] + (
+                ["serving_draft", "serving_verify"] if self._spec else [])
+        if auditor is not None and self._pp == 1:
             self._prefill = auditor.wrap(self._prefill, "serving_prefill")
             self._admit_jit = auditor.wrap(self._admit_jit, "serving_admit")
             if self._paged:
@@ -1341,25 +1743,324 @@ class ServingEngine:
             # go inline from the first iteration.
             self._dispatch_warm = True
 
+    # -- pipeline-parallel program construction -----------------------------
+    def _build_pp_programs(self, top_k, auditor) -> None:
+        """Compile the per-stage serving programs for a ``tp=N,pp=M``
+        mesh: each pipeline stage gets its OWN jits (prefill slice,
+        decode slice, admit splice, spec verify slice) at explicit
+        in/out shardings against the stage's sub-mesh, and thin host
+        wrappers chain them under the monolithic call signatures the
+        dispatch paths already use.
+
+        Compile-count==1 per stage rests on SOURCE CONSISTENCY, not on
+        trust in auto-transfers: a jit cache entry keys on each
+        argument's actual committed placement, so every argument
+        position must always ARRIVE placed the same way. The invariants
+        here: tokens/temps always live on the LAST stage (ctor
+        device_put, admit + decode out_shardings); paged positions/
+        tables are device_put per stage once and then re-fed from that
+        stage's own outputs; fresh host values (chunk offsets, split
+        keys, slot ids) are uncommitted — placement-free — every call;
+        and every value that CROSSES a stage boundary (the residual
+        activation, last-stage tokens feeding stage 0, the commit
+        vector feeding non-last rewinds) goes through
+        :meth:`_to_stage` — jax auto-transfers only single-device
+        arrays between 1-device stages, and a committed tp-sharded
+        array fed to another stage's sub-mesh is a runtime placement
+        error, so the handoff is placed explicitly. The target layout
+        is the same every call, so each stage jit still keys exactly
+        one cache entry.
+        """
+        S = self._pp
+        last = S - 1
+        plan = self._stage_plan
+        mods = self._stage_modules
+        psh = self._param_shardings
+        csh = self._cache_shardings
+        reps = self._stage_rep
+        rep_last = reps[-1]
+        hop = self._to_stage
+
+        def sjit(fn, in_sh, out_sh, donate):
+            return jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh,
+                           donate_argnums=donate)
+
+        def wrap(fn, name):
+            return fn if auditor is None else auditor.wrap(fn, name)
+
+        if self._paged:
+            sent = self._sentinel
+            pf = [wrap(sjit(
+                functools.partial(_pp_paged_prefill_fn, mods[s],
+                                  plan.stage_arg(s)),
+                (psh[s], csh[s], reps[s], reps[s], reps[s]),
+                (csh[s], reps[s]), (1,)), f"serving_prefill_s{s}")
+                for s in range(last)]
+            pf_last = wrap(sjit(
+                functools.partial(_pp_paged_prefill_last_fn, mods[last],
+                                  plan.stage_arg(last), top_k),
+                (psh[last], csh[last]) + (reps[last],) * 6,
+                (csh[last], rep_last), (1,)), f"serving_prefill_s{last}")
+
+            def prefill(params, pools, padded, start, true_len, table_row,
+                        temp, key):
+                pools = list(pools)
+                x = padded
+                for s in range(last):
+                    if s:
+                        x = hop(x, s)
+                    pools[s], x = pf[s](params[s], pools[s], x, start,
+                                        table_row)
+                pools[last], tok = pf_last(params[last], pools[last],
+                                           hop(x, last) if last else x,
+                                           start, true_len, table_row,
+                                           temp, key)
+                return pools, tok
+
+            self._prefill = prefill
+            admit = wrap(sjit(_paged_admit_fn, (rep_last,) * 5,
+                              (rep_last, rep_last), (0, 1)),
+                         "serving_admit")
+
+            def admit_wrap(tokens, temps, slot, tok, temp):
+                mb, local = divmod(int(slot), self._mb_size)
+                tokens, temps = list(tokens), list(temps)
+                tokens[mb], temps[mb] = admit(
+                    tokens[mb], temps[mb], jnp.int32(local), tok, temp)
+                return tokens, temps
+
+            self._admit_jit = admit_wrap
+            self._decode_steps = [wrap(sjit(
+                functools.partial(_pp_paged_decode_fn, mods[s],
+                                  plan.stage_arg(s), sent),
+                (psh[s], csh[s], reps[s], reps[s], reps[s]),
+                (csh[s], reps[s], reps[s]), (1,)), f"serving_decode_s{s}")
+                for s in range(last)]
+            self._decode_steps.append(wrap(sjit(
+                functools.partial(_pp_paged_decode_last_fn, mods[last],
+                                  plan.stage_arg(last), top_k, sent),
+                (psh[last], csh[last]) + (reps[last],) * 5,
+                (csh[last], rep_last, reps[last]), (1,)),
+                f"serving_decode_s{last}"))
+            # KV export/import are gated off under pp (the gather/
+            # scatter programs span the whole pool); the run loop still
+            # drains this (always-empty) queue.
+            self._pending_kv = []
+        else:
+            rsh = self._row_shardings
+            pf = [wrap(sjit(
+                functools.partial(_pp_prefill_fn, mods[s],
+                                  plan.stage_arg(s)),
+                (psh[s], rsh[s], reps[s], reps[s], reps[s]),
+                (rsh[s], reps[s]), (1,)), f"serving_prefill_s{s}")
+                for s in range(last)]
+            pf_last = wrap(sjit(
+                functools.partial(_pp_prefill_last_fn, mods[last],
+                                  plan.stage_arg(last), top_k),
+                (psh[last], rsh[last]) + (reps[last],) * 5,
+                (rsh[last], rep_last), (1,)), f"serving_prefill_s{last}")
+
+            def prefill(params, cache, padded, start, true_len, temp, key):
+                cache = list(cache)
+                x = padded
+                for s in range(last):
+                    if s:
+                        x = hop(x, s)
+                    cache[s], x = pf[s](params[s], cache[s], x, start,
+                                        true_len)
+                cache[last], tok = pf_last(params[last], cache[last],
+                                           hop(x, last) if last else x,
+                                           start, true_len, temp, key)
+                return cache, tok
+
+            self._prefill = prefill
+            splice = [wrap(sjit(_draft_admit_fn,
+                                (csh[s], reps[s], rsh[s]), csh[s], (0,)),
+                           f"serving_admit_s{s}") for s in range(S)]
+            sample_admit = wrap(sjit(_paged_admit_fn, (rep_last,) * 5,
+                                     (rep_last, rep_last), (0, 1)),
+                                "serving_admit")
+
+            def admit_wrap(cache, tokens, temps, slot, pre_cache, tok,
+                           temp):
+                mb, local = divmod(int(slot), self._mb_size)
+                loc = jnp.int32(local)
+                cache = [list(c) for c in cache]
+                for s in range(S):
+                    cache[s][mb] = splice[s](cache[s][mb], loc,
+                                             pre_cache[s])
+                tokens, temps = list(tokens), list(temps)
+                tokens[mb], temps[mb] = sample_admit(
+                    tokens[mb], temps[mb], loc, tok, temp)
+                return cache, tokens, temps
+
+            self._admit_jit = admit_wrap
+            self._decode_steps = [wrap(sjit(
+                functools.partial(_pp_decode_fn, mods[s],
+                                  plan.stage_arg(s)),
+                (psh[s], csh[s], reps[s]), (csh[s], reps[s]), (1,)),
+                f"serving_decode_s{s}") for s in range(last)]
+            self._decode_steps.append(wrap(sjit(
+                functools.partial(_pp_decode_last_fn, mods[last],
+                                  plan.stage_arg(last), top_k),
+                (psh[last], csh[last], reps[last], rep_last, reps[last]),
+                (csh[last], rep_last), (1,)), f"serving_decode_s{last}"))
+        self._decode_step = None  # per-stage under pp: _decode_steps
+        self._decode_audit_names = [f"serving_decode_s{s}"
+                                    for s in range(S)]
+
+        if self._spec:
+            rep0 = reps[0]
+            draft = wrap(sjit(
+                functools.partial(_spec_draft_fn, self._draft_module,
+                                  self.spec_k),
+                (rep0,) * 5, (rep0, rep0), (1,)), "serving_draft")
+
+            def draft_step(dp, dc, prev, tokens, start):
+                # ``tokens`` is the engine's per-micro-batch list (spec
+                # forces mb_count==1); it lives on the LAST stage, the
+                # draft runs on stage 0.
+                return draft(dp, dc, prev, hop(tokens[0], 0), start)
+
+            self._draft_step = draft_step
+            self._draft_prefill = wrap(sjit(
+                functools.partial(_draft_prefill_fn, self._draft_module),
+                (rep0,) * 5, rep0, (1,)), "serving_draft_prefill")
+            self._draft_admit = wrap(sjit(
+                _draft_admit_fn, (rep0, rep0, rep0), rep0, (0,)),
+                "serving_draft_admit")
+            if self._paged:
+                vf0 = wrap(sjit(
+                    functools.partial(_pp_paged_verify_first_fn, mods[0],
+                                      plan.stage_arg(0)),
+                    (psh[0], csh[0]) + (reps[0],) * 4,
+                    (csh[0], reps[0]), (1,)), "serving_verify_s0")
+                vmid = [wrap(sjit(
+                    functools.partial(_pp_paged_verify_fn, mods[s],
+                                      plan.stage_arg(s)),
+                    (psh[s], csh[s], reps[s], reps[s], reps[s]),
+                    (csh[s], reps[s]), (1,)), f"serving_verify_s{s}")
+                    for s in range(1, last)]
+                vlast = wrap(sjit(
+                    functools.partial(_pp_paged_verify_last_fn, mods[last],
+                                      plan.stage_arg(last), top_k),
+                    (psh[last], csh[last]) + (reps[last],) * 10,
+                    (csh[last], rep_last, rep_last, rep_last), (1,)),
+                    f"serving_verify_s{last}")
+
+                def verify(params, pools, tokens, drafts, temps, spec_ok,
+                           remaining, room, start, tables, key):
+                    toks = tokens[0]
+                    pools = list(pools)
+                    pools[0], act = vf0(params[0], pools[0], hop(toks, 0),
+                                        drafts, start, tables[0])
+                    for s in range(1, last):
+                        pools[s], act = vmid[s - 1](params[s], pools[s],
+                                                    hop(act, s), start,
+                                                    tables[s])
+                    pools[last], new_tok, out, commit = vlast(
+                        params[last], pools[last], hop(act, last),
+                        hop(drafts, last), toks,
+                        temps[0], spec_ok, remaining, room, start,
+                        tables[last], key)
+                    return pools, [new_tok], out, commit
+
+                self._verify_step = verify
+            else:
+                vf0 = wrap(sjit(
+                    functools.partial(_pp_verify_first_fn, mods[0],
+                                      plan.stage_arg(0)),
+                    (psh[0], csh[0], reps[0], reps[0], reps[0]),
+                    (csh[0], reps[0]), (1,)), "serving_verify_s0")
+                vmid = [wrap(sjit(
+                    functools.partial(_pp_verify_fn, mods[s],
+                                      plan.stage_arg(s)),
+                    (psh[s], csh[s], reps[s], reps[s]),
+                    (csh[s], reps[s]), (1,)), f"serving_verify_s{s}")
+                    for s in range(1, last)]
+                vlast = wrap(sjit(
+                    functools.partial(_pp_verify_last_fn, mods[last],
+                                      plan.stage_arg(last), top_k),
+                    (psh[last], csh[last]) + (reps[last],) * 8,
+                    (csh[last], rep_last, rep_last, rep_last), (1,)),
+                    f"serving_verify_s{last}")
+                rewind = [wrap(sjit(_pp_index_rewind_fn,
+                                    (csh[s], reps[s], reps[s]), csh[s],
+                                    (0,)), f"serving_verify_rewind_s{s}")
+                          for s in range(last)]
+
+                def verify(params, cache, tokens, drafts, temps, spec_ok,
+                           remaining, start, key):
+                    toks = tokens[0]
+                    rows = [c[0] for c in cache]
+                    rows[0], act = vf0(params[0], rows[0], hop(toks, 0),
+                                       drafts, start)
+                    for s in range(1, last):
+                        rows[s], act = vmid[s - 1](params[s], rows[s],
+                                                   hop(act, s), start)
+                    rows[last], new_tok, out, commit = vlast(
+                        params[last], rows[last], hop(act, last),
+                        hop(drafts, last), toks,
+                        temps[0], spec_ok, remaining, start, key)
+                    # Non-last stages left their index leaves at
+                    # positions + K; roll each back to the committed
+                    # length with the DEVICE commit vector — no host
+                    # sync on the dispatch path.
+                    for s in range(last):
+                        rows[s] = rewind[s](rows[s], start,
+                                            hop(commit, s))
+                    return [[c] for c in rows], [new_tok], out, commit
+
+                self._verify_step = verify
+            self._decode_audit_names += (
+                ["serving_draft"]
+                + [f"serving_verify_s{s}" for s in range(S)]
+                + ([] if self._paged else
+                   [f"serving_verify_rewind_s{s}" for s in range(last)]))
+
     # -- introspection ------------------------------------------------------
     def decode_compile_count(self) -> int:
         """Number of compiled decode executables (must stay 1: admission
         must never retrace the decode step). -1 when the jit cache probe
         is unavailable; falls back to the auditor's count if one is
         attached (so audited engines keep a real count on jax versions
-        without the private probe)."""
-        probe = getattr(self._decode_step, "_cache_size", None)
-        size = None
-        if probe is not None:
-            try:
-                size = probe()
-            except Exception:
-                size = None
+        without the private probe). Under pp the invariant is per
+        STAGE — this returns the max over stages (1 iff every stage
+        compiled exactly once); :meth:`decode_compile_counts` has the
+        per-stage vector."""
+        if self._pp > 1:
+            counts = self.decode_compile_counts()
+            return -1 if any(c < 0 for c in counts) else max(counts)
+        size = self._probe_cache_size(self._decode_step)
         if size is not None:
             return int(size)
         if self.auditor is not None:
             return self.auditor.compiles("serving_decode")
         return -1
+
+    @staticmethod
+    def _probe_cache_size(fn) -> int | None:
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:
+            return None
+
+    def decode_compile_counts(self) -> list[int]:
+        """Per-stage decode compile counts (pp engines; ``[count]`` for
+        a single-stage engine) — the per-stage face of the
+        compile-count==1 invariant."""
+        if self._pp == 1:
+            return [self.decode_compile_count()]
+        counts = []
+        for s, fn in enumerate(self._decode_steps):
+            size = self._probe_cache_size(fn)
+            if size is None and self.auditor is not None:
+                size = self.auditor.compiles(f"serving_decode_s{s}")
+            counts.append(-1 if size is None else int(size))
+        return counts
 
     def tick_timeline(self, n: int | None = None) -> list[dict]:
         """The bounded dispatch→harvest tick lane (most recent last):
@@ -1378,12 +2079,34 @@ class ServingEngine:
             return None
         from distkeras_tpu.telemetry.device import _device_name
 
-        return {
+        info = {
             "axes": {a: int(s) for a, s in self.mesh.shape.items()},
             "tp": self._tp,
+            "pp": self._pp,
             "devices": [_device_name(d)
                         for d in self.mesh.devices.flatten()],
         }
+        if self._pp > 1:
+            # Per-stage attribution: devices, owned layer range, and
+            # resident params/KV bytes — the fleet-verify view of where
+            # each stage's share of the model actually landed.
+            stages = []
+            for s in range(self._pp):
+                lo, hi = self._stage_plan.layer_range(s)
+                stages.append({
+                    "stage": s,
+                    "layers": [lo, hi],
+                    "devices": [_device_name(d) for d in
+                                self._stage_meshes[s].devices.flatten()],
+                    "params_bytes": sum(
+                        getattr(l, "nbytes", 0)
+                        for l in jax.tree.leaves(self._params[s])),
+                    "kv_bytes": sum(
+                        getattr(l, "nbytes", 0)
+                        for l in jax.tree.leaves(self._cache[s])),
+                })
+            info["stages"] = stages
+        return info
 
     def _bytes_by_device(self, tree) -> dict[str, int]:
         """Per-device resident bytes of a (possibly sharded) pytree —
@@ -1536,13 +2259,19 @@ class ServingEngine:
             "weight_version": self.weight_version,
             "pipeline": {
                 "depth": self.pipeline_depth,
-                "inflight": (self._inflight.kind
-                             if self._inflight is not None else None),
+                "inflight": (self._inflight[-1].kind
+                             if self._inflight else None),
+                "inflight_ticks": len(self._inflight),
                 "ticks_logged": len(self._tick_log),
                 "host_gap_p50_s": self.metrics.host_gap.gap_p50,
                 "device_idle_ratio": self.metrics.host_gap.idle_ratio,
             },
         }
+        if self._pp > 1:
+            out["pipeline"]["stages"] = self._pp
+            out["pipeline"]["micro_batches"] = self._mb_count
+            out["pipeline"]["bubble_fraction"] = (
+                self.metrics.bubble.fraction)
         if self.mesh is not None:
             out["mesh"] = self.mesh_info()
         if self._spec:
@@ -1804,7 +2533,14 @@ class ServingEngine:
         if isinstance(tree, dict) and "params" in tree:
             tree = tree["params"]
         new_leaves, _ = jax.tree.flatten(tree)
-        cur_leaves, cur_def = jax.tree.flatten(self._params)
+        if self._pp > 1:
+            # The live per-stage list duplicates the tied embedding
+            # (stage 0 + last); validate against the UNSPLIT abstract
+            # template the ctor captured, and hand _swap_sync the whole
+            # tree — it re-splits along the stage plan.
+            cur_leaves, cur_def = self._swap_template
+        else:
+            cur_leaves, cur_def = jax.tree.flatten(self._params)
         if len(new_leaves) != len(cur_leaves):
             raise ValueError(
                 f"reload weights have {len(new_leaves)} leaves; serving "
@@ -1857,6 +2593,11 @@ class ServingEngine:
             raise KVTransferError(
                 "KV export requires a paged engine (--paged / "
                 "--kv-pool-mb): dense caches have no block bookkeeping")
+        if self._pp > 1:
+            raise KVTransferError(
+                "KV export is not supported on a pp mesh yet: the "
+                "gather program spans the whole pool, which is "
+                "stage-partitioned under pp")
         event: asyncio.Event = asyncio.Event()
         result: dict = {}
         self._pending_kv.append(("export", prompt, event, result))
@@ -1877,6 +2618,11 @@ class ServingEngine:
             raise KVTransferError(
                 "KV import requires a paged engine (--paged / "
                 "--kv-pool-mb)")
+        if self._pp > 1:
+            raise KVTransferError(
+                "KV import is not supported on a pp mesh yet: the "
+                "scatter program spans the whole pool, which is "
+                "stage-partitioned under pp")
         event: asyncio.Event = asyncio.Event()
         result: dict = {}
         self._pending_kv.append(("import", payload, event, result))
@@ -2254,7 +3000,17 @@ class ServingEngine:
         the arXiv:2004.13336 move applied to weight rollout."""
         from distkeras_tpu.parallel.gspmd import place_sharded
 
-        params = place_sharded(params, self._param_shardings)
+        if self._pp > 1:
+            # Per-stage shard-then-place: the full host tree is split
+            # along the stage plan and each stage's subtree is sliced
+            # straight into ITS devices' layouts — a rolling update to
+            # a tp×pp replica transfers bytes/(tp·pp) per device.
+            params = [
+                place_sharded(part, sh)
+                for part, sh in zip(self._stage_plan.split_params(params),
+                                    self._param_shardings)]
+        else:
+            params = place_sharded(params, self._param_shardings)
         jax.block_until_ready(params)
         self._params = params
         if self.prefix_cache is not None:
@@ -2658,10 +3414,10 @@ class ServingEngine:
             # (otherwise server handlers block forever on streams nothing
             # will ever finish).
             err = ServingError(f"engine failure: {e!r}")
-            # Abandon any in-flight tick: its device buffers are
-            # dropped with the reference; nothing host-side depends on
-            # its result once every request below is errored out.
-            self._inflight = None
+            # Abandon any in-flight ticks: their device buffers are
+            # dropped with the references; nothing host-side depends on
+            # their results once every request below is errored out.
+            self._inflight.clear()
             for i, st in enumerate(self._slot_state):
                 if st is not None:
                     self._finish_error(st.request, err)
@@ -2710,7 +3466,7 @@ class ServingEngine:
         knows."""
         decodable = self._decodable()
         if not decodable:
-            if self._inflight is not None:
+            if self._inflight:
                 # Every dispatched row disappeared (cancel barrier tore
                 # them down before the harvest): flush so the stale
                 # handles don't pin device buffers.
@@ -2731,10 +3487,11 @@ class ServingEngine:
                         for i in decodable))
 
         spec_tick = want_spec()
-        if self._inflight is not None and (
-                spec_tick or self._inflight.kind == "spec"):
+        if self._inflight and (
+                spec_tick or any(t.kind == "spec"
+                                 for t in self._inflight)):
             # Either the NEXT tick needs settled commit state (it is
-            # speculative), or the in-flight one is speculative (its
+            # speculative), or an in-flight one is speculative (its
             # commits gate every later dispatch). Harvest, then
             # re-evaluate: the stream may have finished rows or flipped
             # the owe-fallback state.
@@ -2755,18 +3512,20 @@ class ServingEngine:
                         self._alloc_lookahead(i)
             with span("spec_tick", active=self.active_slots,
                       k=self.spec_k):
-                self._inflight = await self._dispatch(
-                    loop, self._spec_dispatch)
+                self._inflight.append(await self._dispatch(
+                    loop, self._spec_dispatch))
         else:
-            prev, self._inflight = self._inflight, None
             with span("decode_tick", active=self.active_slots):
-                self._inflight = await self._dispatch(
-                    loop, self._decode_dispatch)
-            if prev is not None:
-                # Tick N's harvest + stream, with N+1 already on the
-                # device: the one D2H waits for N only; everything after
-                # it overlaps N+1.
-                await self._complete_tick(loop, prev)
+                self._inflight.append(await self._dispatch(
+                    loop, self._decode_dispatch))
+            # Harvest the oldest tick(s) past the in-flight window,
+            # with the newest already on the device: the one D2H waits
+            # for the oldest only; everything after it overlaps the
+            # later ticks. Depth<=1 keeps at most ONE tick in flight
+            # (the PR-14 overlap); depth>1 on a pp mesh keeps up to
+            # ``depth`` micro-batch ticks flowing through the stages.
+            while len(self._inflight) > max(1, self.pipeline_depth):
+                await self._complete_tick(loop, self._inflight.popleft())
         if self._arm_after_warmup and self.auditor is not None:
             # The first dispatch IS the warmup: compilation is
             # synchronous at the jit call (only execution is async), so
@@ -2774,10 +3533,7 @@ class ServingEngine:
             # spec trio) and every later compile is a violated
             # invariant.
             self._arm_after_warmup = False
-            self.auditor.arm(*(
-                ("serving_decode", "serving_draft",
-                 "serving_verify") if self._spec
-                else ("serving_decode",)))
+            self.auditor.arm(*self._decode_audit_names)
         if self.pipeline_depth == 0:
             await self._pipeline_barrier(loop)
 
@@ -2799,10 +3555,11 @@ class ServingEngine:
         in-flight tick (if any). Called before every event that mutates
         batch shape or content — admission, chunked-prefill progress,
         paged growth/preemption, param swap, KV transfer, cancel/expire
-        teardown, idle, shutdown — and as the depth-0 serializer."""
-        tick, self._inflight = self._inflight, None
-        if tick is not None:
-            await self._complete_tick(loop, tick)
+        teardown, idle, shutdown — and as the depth-0 serializer. Under
+        depth>1 this drains ALL stages' in-flight micro-batch ticks,
+        oldest first."""
+        while self._inflight:
+            await self._complete_tick(loop, self._inflight.popleft())
 
     async def _complete_tick(self, loop, tick: _InflightTick) -> None:
         """Harvest one dispatched tick and do its host half: stream the
@@ -2846,20 +3603,21 @@ class ServingEngine:
                     self._stream_spec(st, out[i], int(commit[i]),
                                       int(caps[i]), t)
                 else:
-                    self._push_token(st, int(nxt[i]), t)
+                    self._push_token(st, int(nxt[i - tick.mb_start]), t)
                 if st.remaining == 0:
-                    if (self._paged and self._inflight is not None
-                            and i in self._inflight.advanced):
-                        # The just-dispatched tick optimistically
+                    if self._paged:
+                        # Still-dispatched later tick(s) optimistically
                         # advanced this slot's watermark; the request is
-                        # finished, so roll the advance back BEFORE
-                        # adoption — the trie must never claim the
+                        # finished, so roll every such advance back
+                        # BEFORE adoption — the trie must never claim an
                         # in-flight speculative write (its block is
                         # freed instead, and the write lands before any
                         # barrier-gated reuse can touch it).
-                        self._lens[i] -= 1
-                        self._inflight.advanced.discard(i)
-                        self._positions_dirty = True
+                        for later in self._inflight:
+                            if i in later.advanced:
+                                self._lens[i] -= 1
+                                later.advanced.discard(i)
+                                self._positions_dirty = True
                     self._finish_ok(st.request)
                     self._free_slot_paged(i, st)
                     self._slot_state[i] = None
@@ -3089,6 +3847,11 @@ class ServingEngine:
         set grew) — NOT by an O(slots × blocks) compare every tick.
         (Safe to hold across ticks: the decode jits donate cache/tokens
         only.)"""
+        if self._pp > 1:
+            # pp callers outside _pp_decode_dispatch (the spec verify
+            # chain) always run at mb_count==1, so micro-batch 0 IS the
+            # whole slot batch.
+            return self._pp_tables(0, decodable)
         if self._tables_dirty or self._tables_dev is None:
             tables = np.full_like(self._tables, self._sentinel)
             for i in decodable:
@@ -3096,6 +3859,43 @@ class ServingEngine:
             self._tables_dev = jnp.asarray(tables)
             self._tables_dirty = False
         return self._tables_dev
+
+    def _pp_tables(self, mb: int, rows) -> list:
+        """Per-STAGE committed device views of micro-batch ``mb``'s
+        masked tables (same dirty gating as :meth:`_upload_tables`; the
+        dirty flag invalidates every micro-batch's cached view, each
+        rebuilt lazily at its next dispatch). Committing each copy to
+        its stage's replicated layout keeps every stage-jit argument
+        placement identical across rebuild and steady-state ticks — the
+        source-consistency rule compile-count==1 per stage rests on."""
+        if self._tables_dirty or self._tables_dev is None:
+            self._tables_dev = [None] * self._mb_count
+            self._tables_dirty = False
+        if self._tables_dev[mb] is None:
+            lo = mb * self._mb_size
+            tables = np.full((self._mb_size, self._table_blocks),
+                             self._sentinel, np.int32)
+            for i in rows:
+                tables[i - lo] = self._tables[i]
+            self._tables_dev[mb] = [jax.device_put(tables, rep)
+                                    for rep in self._stage_rep]
+        return self._tables_dev[mb]
+
+    def _pp_positions(self, mb: int, rows) -> list:
+        """Per-stage committed positions vectors for micro-batch ``mb``
+        (each stage's steady-state tick re-feeds its OWN returned
+        vector; a dirty rebuild re-commits to every stage's layout)."""
+        if self._positions_dirty or self._positions_dev is None:
+            self._positions_dev = [None] * self._mb_count
+            self._positions_dirty = False
+        if self._positions_dev[mb] is None:
+            lo = mb * self._mb_size
+            positions = np.zeros((self._mb_size,), np.int32)
+            for i in rows:
+                positions[i - lo] = self._lens[i]
+            self._positions_dev[mb] = [jax.device_put(positions, rep)
+                                       for rep in self._stage_rep]
+        return self._positions_dev[mb]
 
     def _decode_dispatch(self) -> _InflightTick:
         """Enqueue ONE plain decode tick (executor thread) and return
@@ -3105,6 +3905,8 @@ class ServingEngine:
         here — position watermarks advance by exactly one per decodable
         row, recorded in ``advanced`` so a teardown detected while the
         tick is still in flight can roll its row back."""
+        if self._pp > 1:
+            return self._pp_decode_dispatch()
         self._key, sub = jax.random.split(self._key)
         rows = tuple(self._decodable())
         if self._paged:
@@ -3144,6 +3946,78 @@ class ServingEngine:
         return _InflightTick(kind="decode", rows=rows, t_dispatch=t,
                              tokens=self._tokens, advanced=set(rows))
 
+    def _to_stage(self, x, s):
+        """Place a cross-stage value on stage ``s``'s replicated
+        sharding. jax auto-transfers single-device arrays between
+        1-device stages, but a committed tp-sharded array fed to a jit
+        on a DISJOINT sub-mesh is a runtime placement error — so every
+        stage-boundary handoff is placed explicitly. The target layout
+        is identical every call, so the consumer jit still keys one
+        cache entry."""
+        return jax.device_put(x, self._stage_rep[s])
+
+    def _pp_decode_dispatch(self) -> _InflightTick:
+        """One micro-batch decode tick through the stage chain
+        (executor thread). The micro-batch is picked round-robin,
+        skipping to the next one with decodable rows (an all-idle
+        engine still dispatches — the warmup path decodes garbage on
+        whichever micro-batch the cursor is at, exactly like the
+        unsharded warmup). Every stage's jit is dispatched back to
+        back; jax chains them through the activation future, so the
+        host returns after enqueueing all S programs and the device
+        timeline is stage 0 → ... → stage S-1. With depth>1 the NEXT
+        call dispatches the next micro-batch while these stages drain —
+        stage s is busy with micro-batch m while stage s-1 runs m+1 —
+        which is what turns the per-stage idle bubble into overlap."""
+        self._key, sub = jax.random.split(self._key)
+        decodable = self._decodable()
+        mb, rows = self._mb_rr, ()
+        for off in range(self._mb_count):
+            cand = (self._mb_rr + off) % self._mb_count
+            lo = cand * self._mb_size
+            cand_rows = tuple(i for i in decodable
+                              if lo <= i < lo + self._mb_size)
+            if cand_rows:
+                mb, rows = cand, cand_rows
+                break
+        self._mb_rr = (mb + 1) % self._mb_count
+        lo = mb * self._mb_size
+        last = self._pp - 1
+        x = self._tokens[mb][:, None]
+        if self._paged:
+            tables = self._pp_tables(mb, rows)
+            pos = self._pp_positions(mb, rows)
+            new_pos = [None] * self._pp
+            for s in range(last):
+                self._cache[s], x, new_pos[s] = self._decode_steps[s](
+                    self._params[s], self._cache[s],
+                    self._to_stage(x, s), pos[s], tables[s])
+            self._cache[last], nxt, new_pos[last] = (
+                self._decode_steps[last](
+                    self._params[last], self._cache[last],
+                    self._to_stage(x, last),
+                    self._temps[mb], pos[last], tables[last], sub))
+            self._positions_dev[mb] = new_pos
+            for i in rows:
+                self._lens[i] += 1
+        else:
+            for s in range(last):
+                self._cache[s][mb], x = self._decode_steps[s](
+                    self._params[s], self._cache[s][mb],
+                    self._to_stage(x, s))
+            self._cache[last][mb], nxt = self._decode_steps[last](
+                self._params[last], self._cache[last][mb],
+                self._to_stage(x, last),
+                self._temps[mb], sub)
+            if self._spec:
+                for i in rows:
+                    self._spec_pos[i] += 1
+        self._tokens[mb] = nxt
+        t = self.metrics.host_gap.tick_dispatched()
+        return _InflightTick(kind="decode", rows=rows, t_dispatch=t,
+                             tokens=nxt, advanced=set(rows),
+                             mb=mb, mb_start=lo)
+
     def _harvest_decode(self, tick: _InflightTick) -> np.ndarray:
         """The one D2H per plain tick (executor thread): blocks until
         the device finishes the tick, then hands its token vector to
@@ -3152,6 +4026,8 @@ class ServingEngine:
         hg.harvest_started()
         nxt = np.asarray(tick.tokens)
         t = hg.harvest_ended()
+        if self._pp > 1:
+            self.metrics.bubble.record(tick.t_dispatch, t, self._pp)
         self._tick_log.append({
             "kind": tick.kind, "rows": len(tick.rows),
             "t_dispatch": tick.t_dispatch, "t_harvest": t,
@@ -3242,6 +4118,8 @@ class ServingEngine:
         out = np.asarray(tick.out)
         commit = np.asarray(tick.commit)
         t = hg.harvest_ended()
+        if self._pp > 1:
+            self.metrics.bubble.record(tick.t_dispatch, t, self._pp)
         for i in tick.rows:
             if self._paged:
                 self._lens[i] += int(commit[i])

@@ -37,7 +37,8 @@ from distkeras_tpu.telemetry.registry import (
 )
 from distkeras_tpu.tracing import MetricStream
 
-__all__ = ["HostGapTracker", "ServingMetrics", "percentile"]
+__all__ = ["BubbleTracker", "HostGapTracker", "ServingMetrics",
+           "percentile"]
 
 # Decode ticks and inter-token gaps sit well under the default buckets'
 # upper range; keep a finer low end for them.
@@ -158,6 +159,65 @@ class HostGapTracker:
         return out
 
 
+class BubbleTracker:
+    """Pipeline-stage idle ("bubble") accounting — the ``HostGapTracker``
+    family's pp sibling, built from the SAME two host instants every
+    tick already stamps (dispatch, harvest end).
+
+    A decode tick on a ``pp=S`` mesh occupies each stage for roughly
+    ``span / S`` of its dispatch→harvest span (the micro-batch flows
+    through the S stage programs back to back). Over a window of ticks,
+    total per-stage busy time is ``sum(spans) / S`` while per-stage
+    capacity is the window's wall span — so
+
+        ``bubble_fraction = 1 - (sum(spans) / S) / wall``
+
+    is the fraction of stage-time the pipeline provably wasted. One
+    tick in flight (``pipeline_depth<=1``) serializes the spans: wall ≈
+    sum(spans) and the bubble sits near ``1 - 1/S`` (half the machine
+    idle at S=2). ``pipeline_depth>=S`` overlaps micro-batches until
+    wall ≈ sum(spans)/S and the bubble approaches 0 — exactly the
+    ramp-up/drain-only residue the depth sweep in ``serving_bench
+    --pp-ab`` measures. Host-side stalls inside a span bias the
+    estimate toward LESS reported idleness, never more (same
+    conservative direction as the host-gap tracker), and the fraction
+    is clamped to [0, 1]."""
+
+    def __init__(self, gauge=None, window: int = 4096):
+        self._gauge = gauge
+        self._spans = collections.deque(maxlen=window)
+        self.num_stages = 1
+
+    def record(self, t_dispatch: float, t_harvest: float,
+               num_stages: int) -> None:
+        """One completed tick's dispatch→harvest span."""
+        self.num_stages = max(1, int(num_stages))
+        self._spans.append((float(t_dispatch), float(t_harvest)))
+        if self._gauge is not None:
+            f = self.fraction
+            if f is not None:
+                self._gauge.set(f)
+
+    def reset(self) -> None:
+        self._spans.clear()
+
+    @property
+    def fraction(self) -> float | None:
+        """Windowed stage-idle fraction; None until two ticks ran."""
+        if len(self._spans) < 2:
+            return None
+        wall = (max(t1 for _, t1 in self._spans)
+                - min(t0 for t0, _ in self._spans))
+        if wall <= 0:
+            return None
+        busy = sum(t1 - t0 for t0, t1 in self._spans) / self.num_stages
+        return min(1.0, max(0.0, 1.0 - busy / wall))
+
+    def summary(self) -> dict[str, float]:
+        f = self.fraction
+        return {} if f is None else {"bubble_fraction": f}
+
+
 class ServingMetrics:
     """Accumulates per-request and per-iteration serving metrics.
 
@@ -245,6 +305,15 @@ class ServingMetrics:
                 help="windowed fraction of inter-tick wall time the "
                      "device was provably idle (host gap / dispatch "
                      "interval)"))
+        # Pipeline-parallel stage-idle accounting: the fraction of
+        # stage-time provably wasted to pipeline bubbles (1 - 1/pp at
+        # depth<=1; driven toward 0 by depth>=pp micro-batch overlap).
+        self.bubble = BubbleTracker(
+            gauge=reg.gauge(
+                "serving_bubble_fraction",
+                help="windowed fraction of pipeline-stage time idle "
+                     "between micro-batch ticks (pp meshes; lower is "
+                     "better, 0 = every stage busy)"))
         # Speculative decoding: proposed vs committed draft tokens (the
         # ratio is the accept rate — THE health signal for a draft
         # model: it falling means the draft stopped predicting the
@@ -720,6 +789,7 @@ class ServingMetrics:
                 out[f"{name}_p99_s"] = percentile(xs, 99)
                 out[f"{name}_mean_s"] = sum(xs) / len(xs)
         out.update(self.host_gap.summary())
+        out.update(self.bubble.summary())
         if self.prefill_chunks:
             out["prefill_chunks_mean"] = (
                 sum(self.prefill_chunks) / len(self.prefill_chunks))

@@ -475,12 +475,23 @@ class PrefixCache(_BlockTrie):
     ``materialize``/``splice`` build are pinned to the engine's sharded
     row layout — a cache hit never moves KV bytes between devices, only
     row ids. Trie/allocator state is host bookkeeping either way.
+    ``stage_meshes``: a pp engine's per-stage tp submeshes — ``template``
+    is then a per-stage LIST of row subtrees (the engine's
+    ``StagePlan.split_tree`` carve of the single-row cache), the pool
+    becomes one per-stage pool placed on its stage's devices, and
+    ``splice``/``materialize``/``insert`` take/return per-stage cache
+    lists. ONE trie spans all stages: a block's trie node stands for the
+    same token positions in every stage's pool, so the host bookkeeping
+    (match/insert/evict) stays stage-agnostic while the bytes never
+    leave their stage.
     """
 
     def __init__(self, template, *, block_tokens: int = 16,
-                 budget_bytes: int = 64 * 2**20, registry=None, mesh=None):
+                 budget_bytes: int = 64 * 2**20, registry=None, mesh=None,
+                 stage_meshes=None):
         if block_tokens < 1:
             raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        self._stages = len(stage_meshes) if stage_meshes is not None else 0
         kv_leaves = [a for a in jax.tree.leaves(template) if a.ndim > 1]
         if not kv_leaves:
             raise ValueError("cache template has no KV leaves")
@@ -499,32 +510,64 @@ class PrefixCache(_BlockTrie):
                 f"(one block = {self.bytes_per_block} bytes)")
         self._init_trie(capacity, block_tokens)
         self.mesh = mesh
-        self._pool = jax.tree.map(
-            lambda a: (jnp.zeros((0,), jnp.int32) if a.ndim == 1 else
-                       jnp.zeros((self.capacity, self.block_tokens)
-                                 + a.shape[2:], a.dtype)),
-            template)
-        self._row_shapes = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), template)
-        pool_sh = row_sh = None
-        if mesh is not None:
+
+        def mk_pool(a):
+            return (jnp.zeros((0,), jnp.int32) if a.ndim == 1 else
+                    jnp.zeros((self.capacity, self.block_tokens)
+                              + a.shape[2:], a.dtype))
+
+        if self._stages:
             from distkeras_tpu.parallel.sharding import kv_pytree_shardings
 
-            pool_sh = kv_pytree_shardings(mesh, self._pool)
-            row_sh = kv_pytree_shardings(mesh, self._row_shapes)
-            self._pool = jax.device_put(self._pool, pool_sh)
-        self._store = jax.jit(
-            functools.partial(_store_fn, self.block_tokens),
-            donate_argnums=(0,),
-            **({} if mesh is None else {"out_shardings": pool_sh}))
-        self._splice = jax.jit(
-            functools.partial(_splice_fn, self.block_tokens),
-            donate_argnums=(0,),  # the cache being built; the pool persists
-            **({} if mesh is None else {"out_shardings": row_sh}))
-        self._materialize = jax.jit(
-            functools.partial(_materialize_fn, self.block_tokens,
-                              self._row_shapes),
-            **({} if mesh is None else {"out_shardings": row_sh}))
+            self._pool = [jax.tree.map(mk_pool, part) for part in template]
+            self._row_shapes = [
+                jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), part)
+                for part in template]
+            pool_sh = [kv_pytree_shardings(m, p)
+                       for m, p in zip(stage_meshes, self._pool)]
+            row_sh = [kv_pytree_shardings(m, r)
+                      for m, r in zip(stage_meshes, self._row_shapes)]
+            self._pool = [jax.device_put(p, sh)
+                          for p, sh in zip(self._pool, pool_sh)]
+            self._store = [
+                jax.jit(functools.partial(_store_fn, self.block_tokens),
+                        donate_argnums=(0,), out_shardings=sh)
+                for sh in pool_sh]
+            self._splice = [
+                jax.jit(functools.partial(_splice_fn, self.block_tokens),
+                        donate_argnums=(0,), out_shardings=sh)
+                for sh in row_sh]
+            self._materialize = [
+                jax.jit(functools.partial(_materialize_fn, self.block_tokens,
+                                          shapes),
+                        out_shardings=sh)
+                for shapes, sh in zip(self._row_shapes, row_sh)]
+        else:
+            self._pool = jax.tree.map(mk_pool, template)
+            self._row_shapes = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), template)
+            pool_sh = row_sh = None
+            if mesh is not None:
+                from distkeras_tpu.parallel.sharding import (
+                    kv_pytree_shardings,
+                )
+
+                pool_sh = kv_pytree_shardings(mesh, self._pool)
+                row_sh = kv_pytree_shardings(mesh, self._row_shapes)
+                self._pool = jax.device_put(self._pool, pool_sh)
+            self._store = jax.jit(
+                functools.partial(_store_fn, self.block_tokens),
+                donate_argnums=(0,),
+                **({} if mesh is None else {"out_shardings": pool_sh}))
+            self._splice = jax.jit(
+                functools.partial(_splice_fn, self.block_tokens),
+                donate_argnums=(0,),  # the cache being built; pool persists
+                **({} if mesh is None else {"out_shardings": row_sh}))
+            self._materialize = jax.jit(
+                functools.partial(_materialize_fn, self.block_tokens,
+                                  self._row_shapes),
+                **({} if mesh is None else {"out_shardings": row_sh}))
         if registry is not None:
             self._metrics = _register_trie_metrics(registry)
             self._metrics["capacity"].set(self.capacity)
@@ -569,8 +612,11 @@ class PrefixCache(_BlockTrie):
         stay bounded; rows written past the true match are garbage the
         causal mask hides until the tail prefill / decode overwrites
         them. Donates ``cache``."""
-        return self._splice(cache, self._pool,
-                            jnp.asarray(self._pad_ids(ids, 0)))
+        ids_dev = jnp.asarray(self._pad_ids(ids, 0))
+        if self._stages:
+            return [sp(c, p, ids_dev) for sp, c, p
+                    in zip(self._splice, cache, self._pool)]
+        return self._splice(cache, self._pool, ids_dev)
 
     def materialize(self, ids: np.ndarray):
         """Build a FRESH single-row cache with pool rows ``ids`` as its
@@ -579,8 +625,11 @@ class PrefixCache(_BlockTrie):
         splice covers are never materialized as zeros first (and never
         round-trip through a donation the backend may have to copy).
         Same pad-width bucketing as :meth:`splice`."""
-        return self._materialize(self._pool,
-                                 jnp.asarray(self._pad_ids(ids, 0)))
+        ids_dev = jnp.asarray(self._pad_ids(ids, 0))
+        if self._stages:
+            return [mk(p, ids_dev) for mk, p
+                    in zip(self._materialize, self._pool)]
+        return self._materialize(self._pool, ids_dev)
 
     def insert(self, tokens, cache) -> int:
         """Store every complete block of ``tokens`` not already cached,
@@ -612,10 +661,13 @@ class PrefixCache(_BlockTrie):
         if not take:
             return 0
         n = len(take)
-        self._pool = self._store(
-            self._pool, cache,
-            jnp.asarray(self._pad_ids(take, self.capacity)),
-            jnp.int32(idx * self.block_tokens))
+        ids_dev = jnp.asarray(self._pad_ids(take, self.capacity))
+        off = jnp.int32(idx * self.block_tokens)
+        if self._stages:
+            self._pool = [st(p, c, ids_dev, off) for st, p, c
+                          in zip(self._store, self._pool, cache)]
+        else:
+            self._pool = self._store(self._pool, cache, ids_dev, off)
         for key, slot in zip(keys[idx:idx + n], take):
             child = _Node(slot, node, key)
             node.children[key] = child

@@ -838,6 +838,14 @@ class ServingServer:
                         engine.metrics.host_gap.idle_ratio,
                 },
             }
+            if engine._pp > 1:
+                # pp replica: stage count + measured bubble, so fleet
+                # rollups can spot an under-fed pipeline (bubble near
+                # 1-1/pp means depth is too shallow for this host).
+                health["pipeline"]["stages"] = engine._pp
+                health["pipeline"]["micro_batches"] = engine._mb_count
+                health["pipeline"]["bubble_fraction"] = (
+                    engine.metrics.bubble.fraction)
             mesh = engine.mesh_info()
             if mesh is not None:
                 # Sharded replica: axis sizes + shard devices, so fleet

@@ -94,7 +94,13 @@ _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     # offline-recompute error already match prefixes
                     # above); the push-phase goodput row regresses DOWN
                     # (higher-is-better by default).
-                    "push_overhead", "burn_overhead", "time_to_page")
+                    "push_overhead", "burn_overhead", "time_to_page",
+                    # Pipeline-parallel rows (serving/pp_*): the stage
+                    # bubble is the idle fraction depth>=pp exists to
+                    # collapse — it regresses UP; the per-depth goodput
+                    # and speedup_x rows regress DOWN (higher-is-better
+                    # by default).
+                    "bubble_fraction")
 
 
 def lower_is_better(key: str) -> bool:

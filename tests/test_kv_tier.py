@@ -435,6 +435,66 @@ def test_tier_owner_death_counted_fallback_zero_client_errors():
     asyncio.run(main())
 
 
+def test_directory_steering_picks_holder_and_counts(rng):
+    """Capacity-aware directory steering on an echo fleet: with TWO
+    decode replicas, the first request's push records its decode pick
+    as the family's holder; the SAME family's next dispatches must be
+    steered back to that holder (router_kv_dir_steered_total counts
+    them) instead of round-robining least-outstanding — and every one
+    of them rides the directory hit (transfer skipped)."""
+    from distkeras_tpu.serving import ServingClient, ServingCluster
+    from distkeras_tpu.serving.cluster.replicas import EchoReplica
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    async def _wait_until(cond, timeout=30.0, what="condition"):
+        t0 = asyncio.get_running_loop().time()
+        while not cond():
+            if asyncio.get_running_loop().time() - t0 > timeout:
+                raise AssertionError(f"timed out waiting for {what}")
+            await asyncio.sleep(0.02)
+
+    async def main():
+        registry = MetricsRegistry()
+        cluster = ServingCluster(
+            lambda i: EchoReplica(kv_block_tokens=4),
+            3, roles=["prefill", "decode", "decode"], registry=registry,
+            supervisor_kwargs=SUP,
+            router_kwargs={"affinity_tokens": 4,
+                           "min_handoff_tokens": 4, "kv_push": True})
+        prompt = [5, 6, 7, 8, 9]
+        async with cluster:
+            async with ServingClient("127.0.0.1", cluster.port,
+                                     wire_mode="auto") as c:
+                done = await c.generate(prompt, 1)
+                assert done["tokens"] == [5]
+                # The push must land (directory holder recorded) before
+                # the steered requests go out.
+                await _wait_until(
+                    lambda: cluster.router.kv_directory_stats()[
+                        "holders"] >= 2, what="push-recorded holder")
+                for _ in range(3):
+                    done = await c.generate(prompt, 1)
+                    assert done["tokens"] == [5]
+            snap = registry.snapshot()
+            assert snap["router_kv_dir_steered_total"]["value"] >= 3
+            assert snap["router_kv_directory_hits_total"]["value"] >= 3
+            stats = cluster.router.kv_directory_stats()
+            assert stats["directory_steered"] >= 3
+        # The capacity gate: a holder whose healthz shows an exhausted
+        # pool is NOT steerable (it would preempt the very blocks we
+        # steered for); an unreported pool stays capacious.
+        router = cluster.router
+        info = next(iter(cluster.replicas.values()))
+        info.last_health = {"kv_pool": {"blocks_free": 0}}
+        assert router._kv_headroom(info) is False
+        info.last_health = {"kv_pool": {"blocks_free": 3}}
+        assert router._kv_headroom(info) is True
+        info.last_health = {}
+        assert router._kv_headroom(info) is True
+
+    asyncio.run(main())
+
+
 # -- observability ------------------------------------------------------------
 
 def test_tier_observability_debugz_and_registry(lm, rng):
