@@ -193,7 +193,7 @@ def _draft(args, model, variables):
 def _make_engine(args, model, variables, metrics=None, trace_store=None,
                  slots=None, tenant_quotas=None, tenant_weights=None,
                  quota_burst_s=2.0, pipeline_depth=None, arm=False,
-                 kv_host_tier_mb=0.0):
+                 kv_host_tier_mb=0.0, constrained=False):
     from distkeras_tpu.serving import ServingEngine, ServingMetrics
 
     paged = args.paged or args.kv_pool_mb > 0
@@ -224,7 +224,7 @@ def _make_engine(args, model, variables, metrics=None, trace_store=None,
         spec_k=args.spec_k, mesh=mesh,
         pipeline_depth=(args.pipeline_depth if pipeline_depth is None
                         else pipeline_depth),
-        kv_host_tier_mb=kv_host_tier_mb,
+        kv_host_tier_mb=kv_host_tier_mb, constrained=constrained,
         auditor=auditor, arm_auditor_after_warmup=auditor is not None,
         trace_store=trace_store,
         tenant_quotas=tenant_quotas, tenant_weights=tenant_weights,
@@ -702,6 +702,192 @@ async def _qos_bench(args, model, variables, report):
         assert ratio <= args.qos_max_degradation, (
             f"other tenants' p99 TTFT degraded {ratio:.2f}x under the "
             f"flood (allowed {args.qos_max_degradation}x)")
+
+
+def _parse_workload_mix(spec: str) -> dict[str, int]:
+    """``generate:8,sample:4,score:6[,embed:2]`` -> {kind: count}."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, sep, cnt = part.partition(":")
+        kind = kind.strip()
+        if kind not in ("generate", "sample", "score", "embed"):
+            raise SystemExit(
+                f"--workload-mix: unknown kind {kind!r} (expected "
+                f"generate/sample/score/embed)")
+        try:
+            n = int(cnt)
+        except ValueError:
+            raise SystemExit(
+                f"--workload-mix: bad count for {kind!r}: {cnt!r}")
+        if n > 0:
+            out[kind] = out.get(kind, 0) + n
+    if not out:
+        raise SystemExit(f"--workload-mix: empty mix {spec!r}")
+    return out
+
+
+async def _kinds_bench(args, model, variables, report):
+    """Mixed request-kind workload on ONE paged engine: plain generates,
+    n-way forked samples (copy-on-write KV shares), prefill-only
+    scores/embeds, and — when ``--constrain-ratio`` > 0 — a slice of the
+    generates decoded under a token-mask automaton, all interleaved in
+    the same continuous batch. Reports per-kind completion counts and
+    latency percentiles plus the two kind-specific costs:
+    ``mask_upload_p99_s`` (dirty-mask host→device time, off the decode
+    path for every unconstrained slot) and ``fork_overhead_s`` (what an
+    n-way sample pays over a plain generate of the same shape — the
+    price of the fork, not n prefills). Returns (prompt, tokens) pairs
+    for every generate stream AND every fork row so the caller's parity
+    cross-check covers both (greedy fork rows must be token-identical
+    to generate())."""
+    from distkeras_tpu.serving import ServingMetrics
+    from distkeras_tpu.serving.metrics import percentile
+
+    mix = _parse_workload_mix(args.workload_mix)
+    total = sum(mix.values())
+    prompts = _prompts(args, total, salt=303)
+    jobs: list[list] = []
+    i = 0
+    for kind in ("generate", "sample", "score", "embed"):
+        for _ in range(mix.get(kind, 0)):
+            jobs.append([kind, prompts[i], None])
+            i += 1
+    # Constrained slice: carve --constrain-ratio of the generates into
+    # masked streams driven by a two-state alternating automaton (emit
+    # token 1, then 2, repeat) — enough structure that the output
+    # PROVES the mask engaged, cheap enough that the cost measured is
+    # the mask upload, not the automaton.
+    dfa = {"start": 0, "edges": [[0, 1, 1], [1, 2, 0]]}
+    gen_jobs = [j for j in jobs if j[0] == "generate"]
+    n_con = int(len(gen_jobs) * args.constrain_ratio)
+    for j in gen_jobs[:n_con]:
+        j[0], j[2] = "constrained", dfa
+    rng = np.random.default_rng(args.seed + 31)
+    rng.shuffle(jobs)  # interleave: mixed batches are the point
+
+    metrics = ServingMetrics()
+    engine = _make_engine(args, model, variables, metrics=metrics,
+                          constrained=n_con > 0)
+    task = asyncio.create_task(engine.run())
+    lats: dict[str, list[float]] = {}
+    results: list[tuple[list[int], list[int]]] = []
+    errors: list[str] = []
+    it = iter(jobs)
+
+    # The validation contract, probed live: a contradictory combo is a
+    # TYPED reject at submit (never admitted, never killed mid-stream).
+    try:
+        engine.submit(prompts[0], max(args.new_tokens, 1), kind="score")
+        raise AssertionError(
+            "score with max_new_tokens > 0 was admitted — kind "
+            "validation must reject contradictory combos at submit")
+    except ValueError:
+        pass
+
+    async def client():
+        for kind, p, constraint in it:
+            t0 = time.monotonic()
+            try:
+                if kind == "sample":
+                    req = engine.submit(p, args.new_tokens, kind="sample",
+                                        n=args.sample_n)
+                    await req.result()
+                    rows = req.fork_completions or []
+                    if len(rows) != args.sample_n:
+                        errors.append(
+                            f"sample: {len(rows)} completions != "
+                            f"n={args.sample_n}")
+                        continue
+                    results.extend((p, row) for row in rows)
+                elif kind in ("score", "embed"):
+                    req = engine.submit(p, 0, kind=kind)
+                    await req.result()
+                    if kind == "score" and (
+                            req.logprobs is None
+                            or len(req.logprobs) != len(p) - 1):
+                        errors.append("score: logprobs missing/short")
+                        continue
+                    if kind == "embed" and not req.embedding:
+                        errors.append("embed: empty embedding")
+                        continue
+                elif kind == "constrained":
+                    req = engine.submit(p, args.new_tokens,
+                                        constraint=constraint)
+                    toks = await req.result()
+                    want = [1 if t % 2 == 0 else 2
+                            for t in range(len(toks))]
+                    if toks != want:
+                        errors.append(
+                            f"constrained: {toks} violates the "
+                            f"alternating automaton")
+                        continue
+                else:
+                    req = engine.submit(p, args.new_tokens)
+                    results.append((p, await req.result()))
+            except Exception as e:  # typed ServingErrors included
+                errors.append(f"{kind}: {type(e).__name__}: {e}")
+                continue
+            lats.setdefault(kind, []).append(time.monotonic() - t0)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(client() for _ in range(args.clients)))
+    elapsed = time.monotonic() - t0
+    engine.shutdown(drain=True)
+    await task
+
+    s = metrics.summary()
+    sec: dict = {
+        "mix": {k: int(v) for k, v in mix.items()},
+        "constrained_requests": n_con,
+        "sample_n": args.sample_n if "sample" in mix else None,
+        "elapsed_s": round(elapsed, 6),
+        "completed": {k: len(v) for k, v in sorted(lats.items())},
+        "kind_admitted": metrics.kind_counters(),
+        "goodput_tokens_per_sec": round(s["tokens_per_sec"], 3),
+    }
+    for k, v in sorted(lats.items()):
+        sec[f"latency_{k}_p50_s"] = round(percentile(v, 50), 6)
+        sec[f"latency_{k}_p99_s"] = round(percentile(v, 99), 6)
+    if metrics.fork_blocks:
+        sec["fork_blocks_total"] = metrics.fork_blocks
+    if lats.get("sample") and lats.get("generate"):
+        sec["fork_overhead_s"] = round(
+            sum(lats["sample"]) / len(lats["sample"])
+            - sum(lats["generate"]) / len(lats["generate"]), 6)
+    if s.get("mask_upload_count"):
+        sec["mask_upload_count"] = int(s["mask_upload_count"])
+        sec["mask_upload_p99_s"] = round(s["mask_upload_p99_s"], 6)
+    if errors:
+        sec["errors"] = errors
+    report["kinds"] = sec
+
+    # The mixed-workload contract, asserted: every request of every
+    # kind completed (scorelike traffic never starves decode, forks
+    # never leak), and every constrained stream obeyed its automaton.
+    assert not errors, f"kind workload failures: {errors}"
+    done = dict(sec["completed"])
+    want_counts = dict(mix)
+    if n_con:
+        want_counts["generate"] = want_counts["generate"] - n_con
+        want_counts["constrained"] = n_con
+    for kind, want in want_counts.items():
+        if want:
+            assert done.get(kind, 0) == want, (
+                f"{kind}: completed {done.get(kind, 0)} of {want}")
+    if n_con:
+        assert s.get("mask_upload_count"), (
+            "constrained streams ran but no mask upload was recorded")
+    if "sample" in mix and any(
+            len(j[1]) >= args.kv_block for j in jobs if j[0] == "sample"):
+        # At least one sample prompt spans a full KV block, so the fork
+        # must have handed out copy-on-write shares (lower --kv-block
+        # or raise --prompt-len if the mix should exercise this).
+        assert metrics.fork_blocks > 0, (
+            "block-spanning forks recorded zero CoW shares")
+    return results
 
 
 async def _sweep_point(args, model, variables, slots, salt):
@@ -1747,6 +1933,43 @@ def _record_qos_history(args, report):
     bench.write_history(path, hist)
 
 
+def _record_kinds_history(args, report):
+    """``serving/kinds_*`` rows for the strict CI gate: per-kind p99
+    latency (latency-named → lower-is-better), mixed-workload goodput,
+    and the two kind-specific costs the checker learns by prefix —
+    ``mask_upload`` (dirty-mask host→device time) and ``fork_overhead``
+    (what an n-way sample pays over a plain generate)."""
+    import os
+    import sys
+    import time as _time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+
+    sec = report.get("kinds") or {}
+    path = os.path.join(root, "bench_history.json")
+    hist = bench.load_history(path)
+    when = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    mixtag = ",".join(f"{k}{v}" for k, v in sorted(
+        (sec.get("mix") or {}).items()))
+    base = (f"serving/kinds_{args.model}/{mixtag}"
+            f"/n{sec.get('sample_n') or 1}")
+    rows = {
+        "goodput_tokens_per_sec": sec.get("goodput_tokens_per_sec"),
+        "mask_upload_p99_s": sec.get("mask_upload_p99_s"),
+        "fork_overhead_s": sec.get("fork_overhead_s"),
+    }
+    for kind in ("generate", "constrained", "sample", "score", "embed"):
+        rows[f"latency_{kind}_p99_s"] = sec.get(f"latency_{kind}_p99_s")
+    for metric, v in rows.items():
+        if isinstance(v, (int, float)) and v > 0:
+            key = f"{base}/{metric}"
+            hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    bench.write_history(path, hist)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="both",
@@ -1963,6 +2186,27 @@ def main():
                     help="--slo: assert telemetry push overhead <= 2%% "
                          "of baseline goodput (CPU A/B goodput is "
                          "noisy; default is report-only)")
+    ap.add_argument("--workload-mix", default=None,
+                    metavar="generate:N,sample:M,score:K[,embed:J]",
+                    help="mixed request-kind mode (implies --paged): "
+                         "run the given per-kind request counts "
+                         "interleaved on ONE engine — plain generates, "
+                         "n-way forked samples (CoW KV shares), "
+                         "prefill-only scores/embeds, plus a "
+                         "--constrain-ratio slice of the generates "
+                         "decoded under a token-mask automaton; "
+                         "reports per-kind p99 latency, mask-upload "
+                         "p99 and fork overhead, cross-checks "
+                         "generate + fork-row parity, and records "
+                         "serving/kinds_* history rows")
+    ap.add_argument("--sample-n", type=int, default=3,
+                    help="--workload-mix: fork width of each sample "
+                         "request (completions per prompt off one "
+                         "shared prefill)")
+    ap.add_argument("--constrain-ratio", type=float, default=0.25,
+                    help="--workload-mix: share of the generate slice "
+                         "to run as constrained (token-masked) "
+                         "streams; 0 disables the mask path")
     ap.add_argument("--record-history", action="store_true",
                     help="append serving/* rows to bench_history.json for "
                          "scripts/check_bench_regression.py")
@@ -2126,6 +2370,35 @@ def main():
                     args.trace_out)
         if args.record_history:
             _record_slo_history(args, report)
+        print(json.dumps(report, indent=1))
+        return
+
+    if args.workload_mix:
+        # Mixed request-kind mode: its own phase, its own rows. Forked
+        # sampling needs the paged pool under it (CoW block shares).
+        if not (args.paged or args.kv_pool_mb > 0):
+            args.paged = True
+        report["config"]["paged"] = True
+        report["config"]["workload_mix"] = args.workload_mix
+        report["config"]["sample_n"] = args.sample_n
+        report["config"]["constrain_ratio"] = args.constrain_ratio
+        model, variables = _model(args)
+        try:
+            all_results = asyncio.run(
+                _kinds_bench(args, model, variables, report))
+            if not args.skip_parity:
+                mism = _check_parity(model, variables, all_results,
+                                     args.new_tokens)
+                report["parity_mismatches"] = mism
+                assert mism == 0, (
+                    f"{mism} generate/fork streams diverged from "
+                    f"generate()")
+        finally:
+            if tracer is not None:
+                report["trace_out"] = tracer.export_chrome_trace(
+                    args.trace_out)
+        if args.record_history:
+            _record_kinds_history(args, report)
         print(json.dumps(report, indent=1))
         return
 

@@ -455,7 +455,8 @@ class Bert(nn.Module):
 
     @nn.compact
     def __call__(self, token_ids, train: bool = False,
-                 positions=None, block_tables=None, stage=None):
+                 positions=None, block_tables=None, stage=None,
+                 return_hidden: bool = False):
         """Full apply, or — with ``stage=(lo, hi, first, last)`` — the
         contiguous layer slice ``[lo, hi)`` of a pipeline stage.
 
@@ -467,7 +468,13 @@ class Bert(nn.Module):
         serving-time construct: the module is always *initialized* whole
         (``stage=None``) and the param/cache trees split afterwards
         (``parallel/pp.py``), so stage applies see exactly their own
-        subtree."""
+        subtree.
+
+        ``return_hidden=True`` returns the raw trunk activation
+        ``[B, S, H]`` instead of logits (the embedding verb's pooled-
+        output source). No params are skipped or added —
+        initialization always runs with ``return_hidden=False``, so one
+        weight tree serves both shapes."""
         cfg = self.cfg
         lo, hi, first, last = (
             (0, cfg.num_layers, True, True) if stage is None else stage)
@@ -490,7 +497,9 @@ class Bert(nn.Module):
                 x = EncoderLayer(cfg, name=f"layer_{i}")(
                     x, train=train,
                     positions=positions, block_tables=block_tables)
-            return self._head(embed, x) if last else x
+            if not last or return_hidden:
+                return x
+            return self._head(embed, x)
         token_ids = token_ids.astype(jnp.int32)
         pos_embed = self.param(
             "pos_embed",
@@ -569,7 +578,7 @@ class Bert(nn.Module):
             from distkeras_tpu.ops.ring_flash import stripe_unshard
 
             x = stripe_unshard(x, sp)
-        if not last:
+        if not last or return_hidden:
             return x
         return self._head(embed, x)
 

@@ -184,6 +184,13 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                          "may be oversubscribed (preempt-and-requeue), "
                          "and long-context requests chain blocks up to "
                          "the trained context")
+    ap.add_argument("--constrained", action="store_true",
+                    help="constrained decoding: compile the decode step "
+                         "with a per-slot additive token-mask input so "
+                         "requests may carry a 'constraint' automaton "
+                         "(docs/serving.md 'Request kinds'). Requires "
+                         "--paged/--kv-pool-mb; without this flag such "
+                         "requests are rejected as bad_request")
     ap.add_argument("--kv-pool-mb", type=float, default=0.0,
                     help="paged-KV pool byte budget (MB); > 0 implies "
                          "--paged. See docs/serving.md 'KV pool sizing'")
@@ -423,6 +430,10 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         raise SystemExit("--kv-host-tier-mb requires --paged or "
                          "--kv-pool-mb: the host tier spills paged-KV "
                          "blocks")
+    if args.constrained and not kv_pool_mb:
+        raise SystemExit("--constrained requires --paged or "
+                         "--kv-pool-mb: the token-mask decode step "
+                         "runs on the paged pool")
     draft_model = draft_variables = None
     if args.draft_model:
         draft_kwargs = json.loads(args.draft_args)
@@ -468,6 +479,7 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         kv_disk_tier_dir=args.kv_disk_tier_dir,
         kv_disk_tier_mb=args.kv_disk_tier_mb,
         kv_tier_watermark=args.kv_tier_watermark,
+        constrained=args.constrained,
         max_context=args.max_context,
         draft_model=draft_model, draft_variables=draft_variables,
         spec_k=args.spec_k, mesh=mesh,
@@ -602,6 +614,8 @@ def _serving_config_flags(args) -> list[str]:
                 # replica pid.
                 extra += ["--kv-disk-tier-dir", args.kv_disk_tier_dir,
                           "--kv-disk-tier-mb", str(args.kv_disk_tier_mb)]
+        if getattr(args, "constrained", False):
+            extra += ["--constrained"]
     if args.max_context is not None:
         extra += ["--max-context", str(args.max_context)]
     if args.draft_model:

@@ -217,7 +217,8 @@ class ServingClient:
                                 "code": "error"})
 
     def _spec(self, prompt, max_new_tokens, *, temperature, priority,
-              timeout, speculate, tenant) -> dict:
+              timeout, speculate, tenant, kind="generate", n=1,
+              constraint=None) -> dict:
         # Sanitize here too so last_trace_id matches the id the server
         # actually records (Request/router sanitize on their side).
         spec = {
@@ -229,6 +230,15 @@ class ServingClient:
             "trace_id": self.last_trace_id,
             "speculate": bool(speculate),
         }
+        # Kind extras ride the bin1 extras whitelist, which drops falsy
+        # values — only stamp them when they carry information, so a
+        # plain generate encodes byte-identical to the pre-kinds wire.
+        if kind and kind != "generate":
+            spec["kind"] = str(kind)
+        if n and int(n) > 1:
+            spec["n"] = int(n)
+        if constraint:
+            spec["constraint"] = constraint
         tenant = tenant if tenant is not None else self.tenant
         if tenant:
             spec["tenant"] = str(tenant)
@@ -245,6 +255,9 @@ class ServingClient:
         trace_id: str | None = None,
         speculate: bool = True,
         tenant: str | None = None,
+        kind: str = "generate",
+        n: int = 1,
+        constraint=None,
     ) -> AsyncIterator[int]:
         """Yield token ids as the server streams them; raises the typed
         :class:`ServingError` subclass matching the server's error code.
@@ -259,7 +272,8 @@ class ServingClient:
         self.last_trace_id = sanitize_trace_id(trace_id) or new_trace_id()
         spec = self._spec(prompt, max_new_tokens, temperature=temperature,
                           priority=priority, timeout=timeout,
-                          speculate=speculate, tenant=tenant)
+                          speculate=speculate, tenant=tenant,
+                          kind=kind, n=n, constraint=constraint)
         if self.proto == wire.PROTO_BIN1:
             async for tok in self._stream_bin1(spec):
                 yield tok
@@ -348,6 +362,25 @@ class ServingClient:
                 on_token(tok)
         return self.last_done
 
+    async def sample(self, prompt: Sequence[int], max_new_tokens: int,
+                     n: int, **kw) -> dict:
+        """Forked sampling: ONE prefill, ``n`` independent completions
+        sharing the prompt's KV blocks copy-on-write. The done record's
+        ``completions`` holds the ``n`` token lists."""
+        return await self.generate(prompt, max_new_tokens,
+                                   kind="sample", n=int(n), **kw)
+
+    async def score(self, prompt: Sequence[int], **kw) -> dict:
+        """Prefill-only scoring: the done record's ``logprobs`` holds the
+        per-token log-probability of ``prompt[i+1]`` given the prefix
+        (length ``len(prompt) - 1``); no decode slot is occupied."""
+        return await self.generate(prompt, 0, kind="score", **kw)
+
+    async def embed(self, prompt: Sequence[int], **kw) -> dict:
+        """Prefill-only embedding: the done record's ``embedding`` holds
+        the mean-pooled final hidden state over the prompt."""
+        return await self.generate(prompt, 0, kind="embed", **kw)
+
     async def generate_batch(
         self,
         prompts: Sequence[Sequence[int]],
@@ -358,6 +391,9 @@ class ServingClient:
         timeout: float | None = None,
         speculate: bool = True,
         tenant: str | None = None,
+        kind: str = "generate",
+        n: int = 1,
+        constraint=None,
     ) -> list:
         """Submit MANY generations at once and await them all — the
         client half of batched admission. On a negotiated bin1
@@ -378,7 +414,8 @@ class ServingClient:
                     out.append(await self.generate(
                         p, max_new_tokens, temperature=temperature,
                         priority=priority, timeout=timeout,
-                        speculate=speculate, tenant=tenant))
+                        speculate=speculate, tenant=tenant,
+                        kind=kind, n=n, constraint=constraint))
                 except ServingError as e:
                     out.append(e)
             return out
@@ -397,6 +434,12 @@ class ServingClient:
                 "priority": int(priority), "timeout": timeout,
                 "speculate": bool(speculate),
             }
+            if kind and kind != "generate":
+                spec["kind"] = str(kind)
+            if n and int(n) > 1:
+                spec["n"] = int(n)
+            if constraint:
+                spec["constraint"] = constraint
             if tenant:
                 spec["tenant"] = str(tenant)
             try:

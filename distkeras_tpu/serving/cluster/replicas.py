@@ -273,6 +273,7 @@ class EchoServer:
         self.kv_fail = bool(kv_fail)
         self.kv_block_tokens = int(kv_block_tokens)
         self.requests = 0
+        self.kind_requests: dict[str, int] = {}
         self.kv_prefills = 0
         self.kv_exports = 0
         self.kv_imports = 0
@@ -309,8 +310,11 @@ class EchoServer:
                     "weight_version": None, "echo": True,
                     "requests": self.requests}}]
             if cmd == "metricsz":
-                return [{"metricsz": {"echo_requests_total":
-                                      {"value": self.requests}}}]
+                mz = {"echo_requests_total": {"value": self.requests}}
+                for k, v in sorted(self.kind_requests.items()):
+                    mz[f'serving_requests_total{{kind="{k}"}}'] = {
+                        "value": v}
+                return [{"metricsz": mz}]
             if cmd == "reload":
                 return [{"reload": {"ok": True, "echo": True,
                                     "weights": spec.get("weights")}}]
@@ -333,19 +337,74 @@ class EchoServer:
             return [{"error": "prompt must be a non-empty token list",
                      "code": "bad_request",
                      "trace_id": spec.get("trace_id")}]
-        self.requests += 1
         try:
             tok = int(prompt[0])
         except (TypeError, ValueError):
             return [{"error": "non-integer prompt token",
                      "code": "bad_request",
                      "trace_id": spec.get("trace_id")}]
-        toks = [tok] * self.echo_tokens
+        err = self._check_kind(spec)
+        if err is not None:
+            return [err]
+        self.requests += 1
+        toks, extra = self._kind_result(spec, tok)
         done = {"done": True, "tokens": toks,
                 "trace_id": spec.get("trace_id"),
                 "tenant": spec.get("tenant") or "default",
                 "ttft_ms": 0.0, "latency_ms": 0.0}
+        done.update(extra)
         return [{"token": t} for t in toks] + [done]
+
+    def _check_kind(self, spec: dict) -> dict | None:
+        """Mirror the engine's admission-time request-kind validation:
+        contradictory combos reject TYPED before any work, so router/QoS
+        tests exercise the same client-visible contract jax-free."""
+        kind = str(spec.get("kind") or "generate")
+        trace_id = spec.get("trace_id")
+        if kind not in ("generate", "sample", "score", "embed"):
+            return {"error": f"unknown request kind {kind!r}",
+                    "code": "bad_request", "trace_id": trace_id}
+        try:
+            max_new = int(spec.get("max_new_tokens") or 0)
+            n = int(spec.get("n") or 1)
+        except (TypeError, ValueError):
+            return {"error": "non-integer max_new_tokens/n",
+                    "code": "bad_request", "trace_id": trace_id}
+        if kind in ("score", "embed") and max_new > 0:
+            return {"error": f"{kind} is prefill-only: max_new_tokens "
+                             "must be 0", "code": "bad_request",
+                    "trace_id": trace_id}
+        if kind == "sample" and n < 2:
+            return {"error": "sample requires n >= 2",
+                    "code": "bad_request", "trace_id": trace_id}
+        if kind != "sample" and n != 1:
+            return {"error": f"n={n} is only valid for kind='sample'",
+                    "code": "bad_request", "trace_id": trace_id}
+        if spec.get("constraint") and kind != "generate":
+            return {"error": "constraint requires kind='generate'",
+                    "code": "bad_request", "trace_id": trace_id}
+        return None
+
+    def _kind_result(self, spec: dict,
+                     tok: int) -> tuple[list[int], dict]:
+        """(streamed tokens, done-record extras) per request kind —
+        shaped exactly like a real engine's done line: sample carries
+        ``completions`` (no streamed tokens), score ``logprobs`` of
+        length ``len(prompt) - 1``, embed a pooled ``embedding``."""
+        kind = str(spec.get("kind") or "generate")
+        self.kind_requests[kind] = self.kind_requests.get(kind, 0) + 1
+        if kind == "sample":
+            n = int(spec.get("n") or 1)
+            return [], {"kind": "sample",
+                        "completions": [[tok] * self.echo_tokens
+                                        for _ in range(n)]}
+        if kind == "score":
+            prompt = spec.get("prompt") or []
+            return [], {"kind": "score",
+                        "logprobs": [0.0] * max(0, len(prompt) - 1)}
+        if kind == "embed":
+            return [], {"kind": "embed", "embedding": [0.0] * 4}
+        return [tok] * self.echo_tokens, {}
 
     async def _pull_kv(self, spec: dict) -> dict:
         """A generation spec naming a KV source: run the REAL
@@ -521,11 +580,17 @@ class EchoServer:
                                       "list", "code": "bad_request",
                              "trace_id": spec.get("trace_id")})
                         continue
+                    err = self._check_kind(spec)
+                    if err is not None:
+                        out += wire.encode_json_frame(
+                            wire.T_ERR, sid, err)
+                        continue
                     kv_info = None
                     if "kv_from" in spec:
                         kv_info = await self._pull_kv(spec)
                     self.requests += 1
-                    toks = [int(prompt[0])] * self.echo_tokens
+                    toks, extra = self._kind_result(spec,
+                                                    int(prompt[0]))
                     if toks:
                         out += wire.encode_token_frame(sid, toks)
                     done = {
@@ -533,6 +598,7 @@ class EchoServer:
                         "trace_id": spec.get("trace_id"),
                         "tenant": spec.get("tenant") or "default",
                         "ttft_ms": 0.0, "latency_ms": 0.0}
+                    done.update(extra)
                     if kv_info is not None:
                         done["kv_migration"] = kv_info
                     out += wire.encode_json_frame(wire.T_DONE, sid, done)

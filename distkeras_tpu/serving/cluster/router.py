@@ -670,10 +670,22 @@ class Router:
         return any(r.role == "prefill"
                    for r in self.supervisor.replicas.values())
 
-    def _pick(self, prompt, exclude: set[str]) -> ReplicaInfo | None:
+    def _pick(self, prompt, exclude: set[str],
+              kind: str = "generate") -> ReplicaInfo | None:
         # Prefill replicas never take generation dispatches — their job
         # is kv_prefill + export; decode replicas (and monolithic ones)
-        # decode.
+        # decode. Scoring/embedding requests are prefill-SHAPED (no
+        # decode phase at all), so they invert the preference: steer
+        # them at prefill/monolithic replicas least-outstanding, keeping
+        # decode replicas' slots for streams — falling back to any READY
+        # replica rather than failing.
+        if kind in ("score", "embed"):
+            ready = [r for r in self.supervisor.replicas.values()
+                     if r.status == READY and r.rid not in exclude]
+            if not ready:
+                return None
+            shaped = [r for r in ready if r.role != "decode"]
+            return min(shaped or ready, key=lambda r: r.outstanding)
         ready = [r for r in self.supervisor.replicas.values()
                  if r.status == READY and r.rid not in exclude
                  and r.role != "prefill"]
@@ -743,13 +755,14 @@ class Router:
             return least
         return preferred
 
-    async def _pick_wait(self, prompt, exclude: set[str]):
+    async def _pick_wait(self, prompt, exclude: set[str],
+                         kind: str = "generate"):
         """Pick a replica, waiting up to ``pick_wait_s`` for one to be
         READY (covers the restart window after a crash and the brief
         all-draining edge of a 1-replica reload)."""
         deadline = time.monotonic() + self.pick_wait_s
         while True:
-            info = self._pick(prompt, exclude)
+            info = self._pick(prompt, exclude, kind)
             if info is not None:
                 return info
             if exclude:
@@ -1180,6 +1193,12 @@ class Router:
         the mux, so only a replica's first request pays the slow path."""
         if not ready:
             return False
+        if wire.request_flags(payload) & wire._F_EXTRAS:
+            # Extras-bearing REQs (kind/n/constraint, kv_from, ...) need
+            # the kind-aware classic path: scoring steers at
+            # prefill-shaped replicas, and the fast path's raw-bytes
+            # pick can't see inside the extras JSON.
+            return False
         info = self._fast_pick(ready, payload)
         mux = self._muxes.get((info.rid, info.port, info.generation))
         if mux is None or mux.dead:
@@ -1254,14 +1273,16 @@ class Router:
             # so disaggregation can only help. A spec that already
             # carries kv_from (a migrating stream pulling from its
             # draining replica) keeps it.
+            kind = str(spec.get("kind") or "generate")
             handoff_src = None
             if (self._roles_enabled() and "kv_from" not in spec
                     and "kv_wait" not in spec
+                    and kind not in ("score", "embed")
                     and isinstance(prompt, (list, tuple))
                     and len(prompt) >= self.min_handoff_tokens):
                 handoff_src = await self._prefill_handoff(spec, trace)
             while True:
-                info = await self._pick_wait(prompt, exclude)
+                info = await self._pick_wait(prompt, exclude, kind)
                 if info is None:
                     if self._c_unavailable is not None:
                         self._c_unavailable.inc()

@@ -48,6 +48,13 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
                  + (" SWAP-PENDING" if dz.get("pending_swap") else ""))
     if dz.get("slo_s") is not None:
         lines.append(f"{indent}slo={dz['slo_s']}s")
+    kinds = dz.get("request_kinds")
+    if isinstance(kinds, dict) and kinds:
+        # Admission census by request kind — the first read when
+        # triaging "what is this replica actually serving" (a scoring
+        # flood shows here before it shows anywhere else).
+        lines.append(f"{indent}request_kinds: " + " ".join(
+            f"{k}={kinds[k]}" for k in sorted(kinds)))
     pl = dz.get("pipeline")
     if isinstance(pl, dict):
         gap = pl.get("host_gap_p50_s")
@@ -99,6 +106,15 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
             # Paged engine: per-slot block-table depth (total blocks the
             # slot addresses / how many are shared prefix blocks).
             cols += [("blocks", "blocks"), ("shared", "shared_blocks")]
+        if any(s.get("kind") not in (None, "generate") for s in slots):
+            # Mixed-kind traffic: which verb holds each slot (fork
+            # children show as 'sample', scorelike work as
+            # 'score'/'embed').
+            cols.insert(2, ("kind", "kind"))
+        if any("automaton_state" in s for s in slots):
+            # Constrained streams: where each one's host-side automaton
+            # sits — a stream wedged mid-grammar shows as a stuck state.
+            cols += [("dfa", "automaton_state")]
         if any("accept_rate" in s for s in slots):
             # Speculating engine: this request's committed-draft ratio —
             # the column that answers "which stream is the draft model

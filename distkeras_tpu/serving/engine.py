@@ -188,7 +188,10 @@ from distkeras_tpu.telemetry import (
     TraceStore,
     span,
 )
+from distkeras_tpu.serving.constraints import TokenDFA
 from distkeras_tpu.serving.scheduler import (
+    REQUEST_KINDS,
+    SCORELIKE_KINDS,
     EngineStopped,
     PoolExhausted,
     Request,
@@ -312,6 +315,95 @@ def _paged_decode_fn(module, top_k, sentinel, params, pools, tokens, temps,
     nxt = sample_rows(logits[:, -1], temps, key, top_k)
     live = (tables[:, 0] != sentinel).astype(positions.dtype)
     return mut["cache"], nxt, positions + live
+
+
+def _paged_decode_masked_fn(module, top_k, sentinel, params, pools, tokens,
+                            temps, positions, tables, mask, key):
+    """Constrained twin of :func:`_paged_decode_fn`: a per-slot additive
+    token mask ``[slots, V]`` (0 allowed, large-negative forbidden —
+    :class:`TokenDFA.mask_row`) lands on the last-position logits BEFORE
+    sampling, so a masked greedy row can only emit automaton-legal
+    tokens. Unconstrained rows carry an all-zero mask row — the add is
+    a no-op for them, which is what lets ONE executable serve mixed
+    constrained/unconstrained batches (the compile-count==1 invariant
+    is the same as the unmasked step's: the mask is a plain operand,
+    re-uploaded host-side only under a dirty flag)."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, tokens[:, None], train=False,
+        mutable=["cache"], positions=positions, block_tables=tables,
+    )
+    nxt = sample_rows(logits[:, -1] + mask, temps, key, top_k)
+    live = (tables[:, 0] != sentinel).astype(positions.dtype)
+    return mut["cache"], nxt, positions + live
+
+
+def _paged_prefill_logits_fn(module, params, pools, padded, start, true_len,
+                             table_row):
+    """Final-chunk prefill that returns the LOGITS row instead of a
+    sampled token: the fork fan-out samples n tokens from it
+    (:func:`_fork_sample_fn`) and constrained admission masks it
+    host-side before picking the first token. KV writes are identical
+    to :func:`_paged_prefill_fn` — only the sampling epilogue moved to
+    the caller."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, padded, train=False,
+        mutable=["cache"],
+        positions=jnp.full((1,), start, jnp.int32),
+        block_tables=table_row[None],
+    )
+    last = jnp.take(logits[0], true_len - 1, axis=0)  # [V]
+    return mut["cache"], last.astype(jnp.float32)
+
+
+def _fork_sample_fn(top_k, logits, temps, key):
+    """Sample ``n`` independent continuations from ONE prefill logits
+    row (the n>1 fork fan-out): the row is broadcast to ``[n, V]`` and
+    :func:`sample_rows` draws each fork's first token — categorical
+    over a batch samples independently per row under a single key, so
+    one dispatch seeds all n forks. Compiles once per distinct n
+    (report-only audit, like the pow2 prefill buckets)."""
+    n = temps.shape[0]
+    rows = jnp.broadcast_to(logits[None, :], (n, logits.shape[0]))
+    return sample_rows(rows, temps, key, top_k)
+
+
+def _score_chunk_fn(module, params, pools, padded, start, true_len,
+                    table_row, targets):
+    """Scoring prefill chunk: same paged KV writes as
+    :func:`_paged_prefill_fn`, but instead of sampling, return each
+    chunk position's log-probability of its NEXT prompt token —
+    ``picked[j] = log_softmax(logits[j])[targets[j]]`` where
+    ``targets[j]`` is the prompt token at global position
+    ``start + j + 1``. The host accumulates per chunk and drops the
+    pad tail and the final position (nothing follows it)."""
+    logits, mut = module.apply(
+        {"params": params, "cache": pools}, padded, train=False,
+        mutable=["cache"],
+        positions=jnp.full((1,), start, jnp.int32),
+        block_tables=table_row[None],
+    )
+    logp = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, targets[:, None], axis=1)[:, 0]
+    return mut["cache"], picked
+
+
+def _embed_chunk_fn(module, params, pools, padded, start, true_len,
+                    table_row):
+    """Embedding prefill chunk: the trunk's raw hidden states
+    (``return_hidden=True`` — pre-head, no extra params) summed over
+    the chunk's TRUE positions (the right-pad tail is masked out). The
+    host accumulates chunk sums and divides by the prompt length at
+    completion — mean pooling without ever materializing ``[P, H]``
+    host-side."""
+    hidden, mut = module.apply(
+        {"params": params, "cache": pools}, padded, train=False,
+        mutable=["cache"], return_hidden=True,
+        positions=jnp.full((1,), start, jnp.int32),
+        block_tables=table_row[None],
+    )
+    valid = (jnp.arange(hidden.shape[1]) < true_len)[:, None]
+    summed = jnp.sum(hidden[0].astype(jnp.float32) * valid, axis=0)
+    return mut["cache"], summed
 
 
 def _kv_gather_fn(cache, ids):
@@ -846,6 +938,36 @@ class _SlotState:
     # trace stamps).
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Request-kind state. Fork rows (kind="sample"): which fork of the
+    # shared request this slot is (None for every other kind), its
+    # PRIVATE token stream (fork tokens are never streamed as events —
+    # the DONE frame carries all n completions), and fork_wait marks a
+    # child slot claimed at admission but not yet fanned out (excluded
+    # from the decodable set until the parent prefill completes).
+    fork_idx: int | None = None
+    fork_tokens: list | None = None
+    fork_wait: bool = False
+    # Constrained decoding: the request's automaton and its current
+    # state (advanced host-side per streamed token).
+    dfa: object | None = None
+    dfa_state: int = 0
+    # Scoring/embedding accumulators (prefill-only kinds).
+    score_acc: list | None = None
+    embed_acc: object | None = None
+
+
+# Sentinel returned by _prefill_step when a prefill-only (score/embed)
+# request's prompt completed — the run loop routes it to
+# _finish_scorelike instead of _finish_admission.
+_SCORELIKE_DONE = object()
+
+
+@dataclasses.dataclass
+class _ForkReady:
+    """Returned by _prefill_step when a fork parent's prompt completed:
+    the n first tokens (one per fork) sampled from the final chunk's
+    logits; the run loop fans the children out from here."""
+    tokens: list
 
 
 class ServingEngine:
@@ -968,6 +1090,7 @@ class ServingEngine:
         tenant_weights: dict | None = None,
         tenant_quotas: dict | None = None,
         quota_burst_s: float = 2.0,
+        constrained: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -1034,6 +1157,20 @@ class ServingEngine:
                     f"data parallelism in serving is N replicas (run.py "
                     f"cluster), not a dp mesh axis inside one engine")
             self._replicated = NamedSharding(mesh, P())
+        # Constrained (structured) decoding: the decode executable takes
+        # a per-slot token-mask operand. Paged-only (the mask hook lives
+        # in the paged decode step) and single-stage only (the mask
+        # lands on the LAST stage's logits; threading it through the pp
+        # chain is future work).
+        self._constrained_mode = bool(constrained)
+        if self._constrained_mode and not self._paged:
+            raise ValueError(
+                "constrained=True requires paged KV (kv_pool_mb / "
+                "kv_pool_blocks): the token-mask hook lives in the paged "
+                "decode step")
+        if self._constrained_mode and self._pp > 1:
+            raise ValueError(
+                "constrained=True is not supported on a pp mesh yet")
         # Micro-batch geometry. pipeline_depth > 1 only buys overlap when
         # ticks flow through >1 stage (a single-stage device serializes
         # them anyway), so it requires a pp mesh; the slot batch is then
@@ -1588,11 +1725,50 @@ class ServingEngine:
             self._admit_jit = _sharded_jit(
                 _paged_admit_fn,
                 (rep, rep, rep, rep, rep), (rep, rep), donate=(0, 1))
-            self._decode_step = _sharded_jit(
-                functools.partial(_paged_decode_fn, self._module, top_k,
-                                  self._sentinel),
-                (psh, csh, rep, rep, rep, rep, rep), (csh, rep, rep),
+            if self._constrained_mode:
+                # The engine's ONE decode executable IS the masked
+                # variant: the mask is a plain [slots, V] operand
+                # (all-zero rows for unconstrained slots), so mixed
+                # batches share it and compile-count==1 holds.
+                self._decode_step = _sharded_jit(
+                    functools.partial(_paged_decode_masked_fn,
+                                      self._module, top_k, self._sentinel),
+                    (psh, csh, rep, rep, rep, rep, rep, rep),
+                    (csh, rep, rep), donate=(1,))
+            else:
+                self._decode_step = _sharded_jit(
+                    functools.partial(_paged_decode_fn, self._module,
+                                      top_k, self._sentinel),
+                    (psh, csh, rep, rep, rep, rep, rep), (csh, rep, rep),
+                    donate=(1,))
+            # Request-kind programs (PR 19). _prefill_logits is the
+            # final-chunk prefill that hands the logits row back (fork
+            # fan-out, constrained first token); the score/embed chunks
+            # reuse the paged prefill's KV writes with a different
+            # epilogue. All are control-path (report-only audit): they
+            # run once per admission, never per tick.
+            self._prefill_logits = _sharded_jit(
+                functools.partial(_paged_prefill_logits_fn, self._module),
+                (psh, csh, rep, rep, rep, rep), (csh, rep), donate=(1,))
+            self._fork_sample = _sharded_jit(
+                functools.partial(_fork_sample_fn, top_k),
+                (rep, rep, rep), rep, donate=())
+            self._score_chunk = _sharded_jit(
+                functools.partial(_score_chunk_fn, self._module),
+                (psh, csh, rep, rep, rep, rep, rep), (csh, rep),
                 donate=(1,))
+            self._embed_chunk = _sharded_jit(
+                functools.partial(_embed_chunk_fn, self._module),
+                (psh, csh, rep, rep, rep, rep), (csh, rep), donate=(1,))
+            # Constrained-decoding mask state: host truth [slots, V]
+            # (zero rows = unconstrained), device copy re-uploaded only
+            # under the dirty flag — the same gating the block tables
+            # use, with the upload timed into mask_upload_seconds.
+            if self._constrained_mode:
+                self._mask_host = np.zeros(
+                    (int(slots), self._cfg.vocab_size), np.float32)
+                self._mask_dev = None
+                self._mask_dirty = True
             # KV block migration (serving/kv_transfer.py): gather rows
             # for an export (output replicated — it is host-fetched
             # immediately, and on a sharded engine the all-gather IS
@@ -1671,6 +1847,16 @@ class ServingEngine:
                     self._kv_gather, "serving_kv_gather")
                 self._kv_scatter = auditor.wrap(
                     self._kv_scatter, "serving_kv_scatter")
+                # Request-kind programs: report-only, like the pow2
+                # prefill buckets — admission-path work, never per-tick.
+                self._prefill_logits = auditor.wrap(
+                    self._prefill_logits, "serving_prefill_logits")
+                self._fork_sample = auditor.wrap(
+                    self._fork_sample, "serving_fork_sample")
+                self._score_chunk = auditor.wrap(
+                    self._score_chunk, "serving_score_chunk")
+                self._embed_chunk = auditor.wrap(
+                    self._embed_chunk, "serving_embed_chunk")
             self._decode_step = auditor.wrap(
                 self._decode_step, "serving_decode")
             if self._spec:
@@ -2221,7 +2407,10 @@ class ServingEngine:
             req = st.request
             entry = {
                 "slot": i,
-                "state": "prefill" if st.prefill is not None else "decode",
+                "state": ("prefill" if st.prefill is not None
+                          else "fork_wait" if st.fork_wait
+                          else "decode"),
+                "kind": req.kind,
                 "trace_id": req.trace_id,
                 "tenant": req.tenant,
                 "depth": len(req.prompt) + len(req.out_tokens),
@@ -2235,6 +2424,11 @@ class ServingEngine:
                 # fixed [L] rows could never show.
                 entry["blocks"] = st.first_block + len(st.blocks)
                 entry["shared_blocks"] = st.first_block
+            if st.dfa is not None:
+                # Automaton column: where this constrained stream's
+                # host-side state machine sits right now — a stream
+                # wedged mid-grammar shows as a stuck state here.
+                entry["automaton_state"] = st.dfa_state
             if self._spec and st.spec_drafted:
                 # Accept-rate column: this request's committed drafts
                 # over its proposed drafts — the per-slot view of how
@@ -2257,6 +2451,7 @@ class ServingEngine:
             "pending_swap": self._pending_swap is not None,
             "decode_compile_count": self.decode_compile_count(),
             "weight_version": self.weight_version,
+            "request_kinds": self.metrics.kind_counters(),
             "pipeline": {
                 "depth": self.pipeline_depth,
                 "inflight": (self._inflight[-1].kind
@@ -2341,10 +2536,16 @@ class ServingEngine:
         speculate: bool = True,
         tenant: str = "default",
         resume_tokens=None,
+        kind: str = "generate",
+        n: int = 1,
+        constraint=None,
     ) -> Request:
         """Validation half of submission: everything that can reject a
         request typed BEFORE it touches the scheduler — shared by
-        :meth:`submit` and the batched :meth:`submit_many`.
+        :meth:`submit` and the batched :meth:`submit_many`. Contradictory
+        kind combinations (score with max_new_tokens, n>1 outside
+        sample, a constraint on an unconstrained engine) reject typed
+        HERE — a bad request must fail at admission, never mid-stream.
 
         ``resume_tokens``: output tokens the client ALREADY received on
         another replica (live slot migration off a draining peer): they
@@ -2356,15 +2557,69 @@ class ServingEngine:
         re-streamed."""
         if self._stopping:
             raise EngineStopped("engine is shutting down; not admitting")
+        kind = str(kind or "generate")
+        n = int(n or 1)
+        if kind not in REQUEST_KINDS:
+            raise ValueError(
+                f"unknown request kind {kind!r}; expected one of "
+                f"{REQUEST_KINDS}")
+        if kind != "generate" and (not self._paged or self._pp > 1):
+            raise ValueError(
+                f"kind={kind!r} requires a paged single-stage engine "
+                f"(kv_pool_mb / kv_pool_blocks, pp=1)")
+        if kind in SCORELIKE_KINDS:
+            if max_new_tokens > 0:
+                raise ValueError(
+                    f"kind={kind!r} is prefill-only: max_new_tokens must "
+                    f"be 0, got {max_new_tokens}")
+            speculate = False
+        if kind == "sample":
+            if n < 2:
+                raise ValueError(
+                    f"kind='sample' requires n >= 2 forks, got {n}")
+            if n > self.slots:
+                raise ValueError(
+                    f"n={n} forks exceed the engine's {self.slots} slots")
+            if self._spec and speculate:
+                raise ValueError(
+                    "n>1 forked sampling does not compose with "
+                    "speculative decoding; pass speculate=False")
+            speculate = False
+        elif n != 1:
+            raise ValueError(f"n={n} requires kind='sample'")
+        dfa = None
+        if constraint is not None:
+            if not self._constrained_mode:
+                raise ValueError(
+                    "this engine was not built with constrained=True; "
+                    "token-mask constraints are unavailable")
+            if kind != "generate":
+                raise ValueError(
+                    f"constraint requires kind='generate', got {kind!r}")
+            dfa = (constraint if isinstance(constraint, TokenDFA)
+                   else TokenDFA.from_spec(constraint))
+            if dfa.max_token() >= self._cfg.vocab_size:
+                raise ValueError(
+                    f"constraint references token {dfa.max_token()} "
+                    f">= vocab_size {self._cfg.vocab_size}")
         prompt_arr = np.asarray(prompt, np.int32)
         if prompt_arr.ndim == 2 and prompt_arr.shape[0] == 1:
             prompt_arr = prompt_arr[0]
         if prompt_arr.ndim != 1 or prompt_arr.size < 1:
             raise ValueError(f"prompt must be a non-empty 1-D token list; "
                              f"got shape {prompt_arr.shape}")
-        _check_context(self.model, self._cfg, prompt_arr[None, :],
-                       max_new_tokens)
-        if prompt_arr.size + max_new_tokens > self.limit:
+        if kind in SCORELIKE_KINDS:
+            # Prefill-only: the whole prompt must fit the context; no
+            # decode budget to bound.
+            if prompt_arr.size > self.limit:
+                raise ValueError(
+                    f"prompt ({prompt_arr.size}) exceeds this engine's "
+                    f"context cap {self.limit}")
+        else:
+            _check_context(self.model, self._cfg, prompt_arr[None, :],
+                           max_new_tokens)
+        if kind not in SCORELIKE_KINDS \
+                and prompt_arr.size + max_new_tokens > self.limit:
             # Tighter than the model's trained context: the engine's
             # max_context cap (dense mode: the pre-reserved per-slot
             # cache length under the byte budget).
@@ -2376,8 +2631,20 @@ class ServingEngine:
             # Resident K/V at completion: every position except the last
             # sampled token's (never fed back). A request that can never
             # fit the pool is a sizing error — reject typed, up front.
-            resident = prompt_arr.size + max_new_tokens - 1
-            need = -(-resident // self.kv_block_tokens)
+            bt = self.kv_block_tokens
+            if kind in SCORELIKE_KINDS:
+                # Scorelike feeds every prompt token, so all of them
+                # are resident at completion.
+                need = -(-prompt_arr.size // bt)
+            elif kind == "sample":
+                # n forks share the prompt's COMPLETE blocks; each owns
+                # the rest (partial tail copy + decode growth) itself.
+                resident = prompt_arr.size + max_new_tokens - 1
+                shared = prompt_arr.size // bt
+                need = shared + n * (-(-resident // bt) - shared)
+            else:
+                resident = prompt_arr.size + max_new_tokens - 1
+                need = -(-resident // bt)
             if need > self.kv_pool.capacity:
                 self.metrics.record_oom_reject()
                 raise PoolExhausted(
@@ -2388,6 +2655,7 @@ class ServingEngine:
             prompt_arr.tolist(), max_new_tokens, temperature=temperature,
             priority=priority, timeout=timeout, trace_id=trace_id,
             speculate=speculate, tenant=tenant,
+            kind=kind, n=n, constraint=dfa,
         )
         if resume_tokens:
             try:
@@ -2406,9 +2674,11 @@ class ServingEngine:
         if self._trace_requests:
             req.trace = TimelineRecord(req.trace_id, "engine",
                                        self.trace_source)
+            req.trace.data["kind"] = req.kind
             req.trace.event("submit", prompt_tokens=len(req.prompt),
                             max_new_tokens=req.max_new_tokens,
-                            priority=req.priority, tenant=req.tenant)
+                            priority=req.priority, tenant=req.tenant,
+                            kind=req.kind)
         return req
 
     def submit(
@@ -2423,6 +2693,9 @@ class ServingEngine:
         speculate: bool = True,
         tenant: str = "default",
         resume_tokens=None,
+        kind: str = "generate",
+        n: int = 1,
+        constraint=None,
     ) -> Request:
         """Validate and enqueue a request; returns the streaming handle.
 
@@ -2436,12 +2709,14 @@ class ServingEngine:
             prompt, max_new_tokens, temperature=temperature,
             priority=priority, timeout=timeout, trace_id=trace_id,
             speculate=speculate, tenant=tenant,
-            resume_tokens=resume_tokens)
+            resume_tokens=resume_tokens, kind=kind, n=n,
+            constraint=constraint)
         try:
             self.scheduler.submit(req)
         except ServingError:
             self.metrics.record_reject()
             raise
+        self.metrics.record_request_kind(req.kind)
         return req
 
     def submit_many(self, specs) -> list:
@@ -2465,6 +2740,9 @@ class ServingEngine:
                     speculate=bool(spec.get("speculate", True)),
                     tenant=str(spec.get("tenant") or "default"),
                     resume_tokens=spec.get("resume_tokens"),
+                    kind=str(spec.get("kind") or "generate"),
+                    n=int(spec.get("n") or 1),
+                    constraint=spec.get("constraint"),
                 ))
             except (ServingError, KeyError, TypeError, ValueError) as e:
                 built.append(e)
@@ -2481,6 +2759,7 @@ class ServingEngine:
                 self.metrics.record_reject()
                 out.append(err)
             else:
+                self.metrics.record_request_kind(r.kind)
                 out.append(r)
         return out
 
@@ -3219,6 +3498,14 @@ class ServingEngine:
                         req = self.scheduler.pop(time.monotonic())
                         if req is None:
                             break
+                        if (req.kind == "sample"
+                                and self.free_slots < req.n):
+                            # Fork fan-out needs all n slots claimed UP
+                            # FRONT (a later admission must not steal a
+                            # child's slot mid-prefill): requeue at the
+                            # class head until n slots are free.
+                            self.scheduler.requeue(req)
+                            break
                         slot = self._slot_state.index(None)
                         paged_job = None
                         if self._paged:
@@ -3270,7 +3557,21 @@ class ServingEngine:
                         if paged_job is not None:
                             (st.prefill, st.blocks, st.first_block,
                              st.match) = paged_job
+                        if req.constraint is not None:
+                            st.dfa = req.constraint
+                            st.dfa_state = req.constraint.start
                         self._slot_state[slot] = st
+                        if req.kind == "sample":
+                            # Claim the n-1 child slots NOW (fork_wait:
+                            # parked out of the decodable set until the
+                            # parent prefill fans out).
+                            st.fork_idx = 0
+                            req.fork_completions = [None] * req.n
+                            for _ in range(req.n - 1):
+                                c = self._slot_state.index(None)
+                                self._slot_state[c] = _SlotState(
+                                    req, st.remaining, now_t,
+                                    t_admit=now_t, fork_wait=True)
                         with span("admit", slot=slot,
                                   trace_id=req.trace_id,
                                   prompt_len=len(req.prompt),
@@ -3293,7 +3594,7 @@ class ServingEngine:
                                 while tok0 is None:
                                     tok0 = await self._in_executor(
                                         loop, self._prefill_step, st, slot)
-                                self._finish_admission(st, slot, tok0)
+                                self._route_admission(st, slot, tok0)
                 # 4b. Chunked prefill: ONE chunk per iteration TOTAL,
                 # round-robin across prefilling slots, interleaved with
                 # the decode tick below — the decode batch never stalls
@@ -3323,7 +3624,7 @@ class ServingEngine:
                             tok0 = await self._in_executor(
                                 loop, self._prefill_step, st, i)
                         if tok0 is not None:
-                            self._finish_admission(st, i, tok0)
+                            self._route_admission(st, i, tok0)
                 # 5. Nothing active? Flush the pipeline (an in-flight
                 # tick whose every row finished leaves active == 0 with
                 # a garbage tick still pending) and wait.
@@ -3487,9 +3788,12 @@ class ServingEngine:
                         for i in decodable))
 
         spec_tick = want_spec()
+        constrained_live = (self._constrained_mode and any(
+            self._slot_state[i].dfa is not None for i in decodable))
         if self._inflight and (
-                spec_tick or any(t.kind == "spec"
-                                 for t in self._inflight)):
+                spec_tick or constrained_live
+                or any(t.kind == "spec"
+                       for t in self._inflight)):
             # Either the NEXT tick needs settled commit state (it is
             # speculative), or an in-flight one is speculative (its
             # commits gate every later dispatch). Harvest, then
@@ -3618,9 +3922,12 @@ class ServingEngine:
                                 self._lens[i] -= 1
                                 later.advanced.discard(i)
                                 self._positions_dirty = True
-                    self._finish_ok(st.request)
-                    self._free_slot_paged(i, st)
-                    self._slot_state[i] = None
+                    if st.fork_idx is not None:
+                        self._finish_fork_row(i, st)
+                    else:
+                        self._finish_ok(st.request)
+                        self._free_slot_paged(i, st)
+                        self._slot_state[i] = None
 
     # -- internals ----------------------------------------------------------
     @staticmethod
@@ -3662,6 +3969,172 @@ class ServingEngine:
             if self.prefix_cache is not None:
                 self.prefix_cache.release(st.prefill.match)
             st.prefill = None
+
+    def _route_admission(self, st: _SlotState, slot: int, tok0) -> None:
+        """Dispatch a completed prefill to its kind's finisher: plain
+        int first token → decode admission; scorelike sentinel →
+        prefill-only completion; fork tokens → fan-out."""
+        if tok0 is _SCORELIKE_DONE:
+            self._finish_scorelike(st, slot)
+        elif isinstance(tok0, _ForkReady):
+            self._finish_fork(st, slot, tok0.tokens)
+        else:
+            self._finish_admission(st, slot, tok0)
+
+    def _scorelike_chunk(self, st: _SlotState, slot: int, padded,
+                         c: int, s0: int) -> None:
+        """One score/embed prefill chunk (executor thread): the same
+        paged KV writes as a prefill chunk with the kind's epilogue
+        accumulated host-side — per-position next-token logprobs for
+        score, the hidden-state sum for embed."""
+        job, req = st.prefill, st.request
+        hg = self.metrics.host_gap
+        table_row = jnp.asarray(self._tables[slot])
+        if req.kind == "score":
+            targets = np.zeros((padded.shape[1],), np.int32)
+            for j in range(c):
+                p = job.pos + j + 1
+                if p < s0:
+                    targets[j] = req.prompt[p]
+            self._cache, picked = self._score_chunk(
+                self._params, self._cache, jnp.asarray(padded),
+                jnp.int32(job.pos), jnp.int32(c), table_row,
+                jnp.asarray(targets))
+            hg.harvest_started()
+            vals = np.asarray(picked)
+            hg.harvest_ended()
+            if st.score_acc is None:
+                st.score_acc = []
+            for j in range(c):
+                if job.pos + j + 1 < s0:
+                    st.score_acc.append(float(vals[j]))
+        else:
+            self._cache, vec = self._embed_chunk(
+                self._params, self._cache, jnp.asarray(padded),
+                jnp.int32(job.pos), jnp.int32(c), table_row)
+            hg.harvest_started()
+            v = np.asarray(vec, dtype=np.float64)
+            hg.harvest_ended()
+            st.embed_acc = (v if st.embed_acc is None
+                            else st.embed_acc + v)
+
+    def _finish_scorelike(self, st: _SlotState, slot: int) -> None:
+        """Complete a prefill-only (score/embed) request: publish its
+        result on the Request, adopt the prompt's KV into the prefix
+        trie (future generates over the same prompt hit it), and free
+        the slot — it never entered the decodable set."""
+        req = st.request
+        t = time.monotonic()
+        req.t_first_token = t
+        self.metrics.record_first_token(t - req.t_submit,
+                                        trace_id=req.trace_id)
+        if req.kind == "score":
+            req.logprobs = list(st.score_acc or [])
+        else:
+            s0 = max(1, len(req.prompt))
+            vec = (st.embed_acc if st.embed_acc is not None
+                   else np.zeros((1,), np.float64))
+            req.embedding = [float(v) / s0 for v in vec]
+        self._finish_ok(req)
+        self._free_slot_paged(slot, st)
+        self._slot_state[slot] = None
+
+    def _finish_fork(self, st: _SlotState, slot: int, toks: list) -> None:
+        """Fan a completed fork-parent prefill out to its n rows (loop
+        thread; async device dispatches only). The prompt's COMPLETE
+        blocks are shared copy-on-write through pool refcounts
+        (:meth:`KVBlockPool.fork`); a partially filled tail block is the
+        one divergent-write site at fork time, so it is eagerly copied
+        per child (gather → scatter, counted as a CoW copy). Each row
+        then owns its table row, sampling state, and private token
+        stream; the DONE frame carries all n completions."""
+        req = st.request
+        pool = self.kv_pool
+        bt = self.kv_block_tokens
+        s0 = len(req.prompt)
+        n = req.n
+        children = [i for i, s in enumerate(self._slot_state)
+                    if s is not None and s.fork_wait and s.request is req]
+        complete = s0 // bt
+        partial = s0 % bt
+        shared = [int(b) for b in self._tables[slot][:complete]]
+        if shared and children:
+            pool.fork(shared, n)
+            self.metrics.record_fork_blocks((n - 1) * len(shared))
+        tail_data = None
+        if partial and children:
+            parent_tail = int(self._tables[slot][complete])
+            tail_data = self._kv_gather(
+                self._cache, jnp.asarray([parent_tail], jnp.int32))
+        t = time.monotonic()
+        req.t_first_token = t
+        self.metrics.record_first_token(t - req.t_submit,
+                                        trace_id=req.trace_id)
+        rows = [(slot, st)] + [(c, self._slot_state[c])
+                               for c in children]
+        dry = False
+        for k, (i, row_st) in enumerate(rows):
+            row_st.fork_idx = k
+            row_st.fork_tokens = [int(toks[k])]
+            row_st.fork_wait = False
+            row_st.last_token_t = t
+            row_st.remaining = req.max_new_tokens - 1
+            if i != slot:
+                table = self._tables[i]
+                table[:] = self._sentinel
+                table[:complete] = shared
+                row_st.blocks = list(shared)
+                if partial:
+                    ids = pool.alloc(1)
+                    if ids is None:
+                        dry = True
+                        break
+                    table[complete] = ids[0]
+                    row_st.blocks.append(int(ids[0]))
+                    self._cache = self._kv_scatter(
+                        self._cache, tail_data,
+                        jnp.asarray([int(ids[0])], jnp.int32))
+                    pool.note_cow_copy()
+                self._lens[i] = s0
+            with span("cache_admit", slot=i):
+                self._tokens, self._temps = self._admit_jit(
+                    self._tokens, self._temps, jnp.int32(i),
+                    jnp.int32(int(toks[k])),
+                    jnp.float32(req.temperature))
+        self._mark_tables_dirty()
+        if dry:
+            # Pool dry mid-fan-out (the admission precheck bounds the
+            # completion footprint, not a racing peer's growth): error
+            # the whole group typed, never a partial fork.
+            self.metrics.record_oom_reject()
+            self._finish_error(req, PoolExhausted(
+                "KV pool exhausted during fork fan-out"))
+            self._teardown_fork(req)
+            return
+        if req.trace is not None:
+            req.trace.event("fork", n=n, shared_blocks=len(shared),
+                            cow_copies=(n - 1) if partial else 0)
+        if req.max_new_tokens <= 1:
+            for i, row_st in rows:
+                self._finish_fork_row(i, row_st)
+
+    def _finish_fork_row(self, i: int, st: _SlotState) -> None:
+        """One fork row finished: bank its completion; the LAST row to
+        finish resolves the shared request (one DONE with all n)."""
+        req = st.request
+        req.fork_completions[st.fork_idx] = list(st.fork_tokens or [])
+        self._free_slot_paged(i, st, adopt=False)
+        self._slot_state[i] = None
+        if all(c is not None for c in req.fork_completions):
+            self._finish_ok(req)
+
+    def _teardown_fork(self, req: Request) -> None:
+        """Free every slot of a fork group (error paths): shared blocks
+        drop one refcount per row, so the pool drains exactly."""
+        for i, s in enumerate(self._slot_state):
+            if s is not None and s.request is req:
+                self._free_slot_paged(i, s, adopt=False)
+                self._slot_state[i] = None
 
     def _finish_admission(self, st: _SlotState, slot: int, tok0: int) -> None:
         """Loop-thread bookkeeping once a slot's prefill completed: stream
@@ -3752,20 +4225,68 @@ class ServingEngine:
         # busy) prefill time as "device idle" in the gap window between
         # a decode harvest and the next decode dispatch.
         hg = self.metrics.host_gap
+        final = job.pos + c >= s0
+        special = None
         with span("prefill", bucket=P, offset=job.pos, prompt_len=s0):
-            if self._paged:
+            if self._paged and req.kind in SCORELIKE_KINDS:
+                # Prefill-only kinds: same KV writes, different epilogue
+                # (per-token logprobs / hidden-state sum) accumulated
+                # host-side per chunk.
+                tok = tok0 = None
+                self._scorelike_chunk(st, slot, padded, c, s0)
+                hg.tick_dispatched()
+            elif self._paged and final and (req.kind == "sample"
+                                            or st.dfa is not None):
+                # The final chunk hands the LOGITS row back instead of a
+                # sampled token: the fork fan-out samples n first tokens
+                # from it; constrained admission masks it first.
+                self._cache, logits = self._prefill_logits(
+                    self._params, self._cache, jnp.asarray(padded),
+                    jnp.int32(job.pos), jnp.int32(c),
+                    jnp.asarray(self._tables[slot]))
+                hg.tick_dispatched()
+                hg.harvest_started()
+                if req.kind == "sample":
+                    self._key, sub = jax.random.split(self._key)
+                    temps_n = jnp.full((req.n,), req.temperature,
+                                       jnp.float32)
+                    forks = self._fork_sample(logits, temps_n, sub)
+                    special = _ForkReady(
+                        [int(t) for t in np.asarray(forks)])
+                    tok = tok0 = None
+                else:
+                    row = (np.asarray(logits)
+                           + st.dfa.mask_row(st.dfa_state,
+                                             self._cfg.vocab_size))
+                    if req.temperature > 0:
+                        z = row.astype(np.float64) / req.temperature
+                        z -= z.max()
+                        p = np.exp(z)
+                        p /= p.sum()
+                        rng = np.random.default_rng(
+                            int(np.asarray(sub)[0]))
+                        tok0 = int(rng.choice(row.shape[0], p=p))
+                    else:
+                        tok0 = int(np.argmax(row))
+                    tok = jnp.int32(tok0)
+                hg.harvest_ended()
+            elif self._paged:
                 self._cache, tok = self._prefill(
                     self._params, self._cache, jnp.asarray(padded),
                     jnp.int32(job.pos), jnp.int32(c),
                     jnp.asarray(self._tables[slot]), temp, sub)
+                hg.tick_dispatched()
+                hg.harvest_started()
+                tok0 = int(tok)  # blocks: honest device time per chunk
+                hg.harvest_ended()
             else:
                 job.cache, tok = self._prefill(
                     self._params, job.cache, jnp.asarray(padded),
                     jnp.int32(job.pos), jnp.int32(c), temp, sub)
-            hg.tick_dispatched()
-            hg.harvest_started()
-            tok0 = int(tok)  # blocks: honest device time per chunk
-            hg.harvest_ended()
+                hg.tick_dispatched()
+                hg.harvest_started()
+                tok0 = int(tok)  # blocks: honest device time per chunk
+                hg.harvest_ended()
         chunk_s = time.monotonic() - t0
         job.device_s += chunk_s
         job.chunks_done += 1
@@ -3780,6 +4301,18 @@ class ServingEngine:
         if job.pos < s0:
             return None
         # Prompt complete.
+        if req.kind in SCORELIKE_KINDS or special is not None:
+            # score/embed never join the decodable set; a fork parent's
+            # per-row admits happen at fan-out on the loop thread.
+            self.metrics.record_prefill(
+                job.device_s, job.chunks_done, job.matched_tokens, s0)
+            if req.trace is not None:
+                req.trace.data.update(
+                    prefill_device_s=round(job.device_s, 9),
+                    prefill_chunks=job.chunks_done,
+                    cache_hit_tokens=job.matched_tokens)
+            st.prefill = None
+            return special if special is not None else _SCORELIKE_DONE
         if self._paged:
             with span("cache_admit", slot=slot):
                 self._tokens, self._temps = self._admit_jit(
@@ -3825,7 +4358,8 @@ class ServingEngine:
         tick output is streamed (everyone else decodes garbage)."""
         return [i for i in range(self.slots)
                 if self._slot_state[i] is not None
-                and self._slot_state[i].prefill is None]
+                and self._slot_state[i].prefill is None
+                and not self._slot_state[i].fork_wait]
 
     def _mark_tables_dirty(self) -> None:
         """A table row (or the decodable set) changed: the next dispatch
@@ -3859,6 +4393,36 @@ class ServingEngine:
             self._tables_dev = jnp.asarray(tables)
             self._tables_dirty = False
         return self._tables_dev
+
+    def _upload_mask(self):
+        """Device view of the per-slot token mask, re-uploaded only when
+        a DFA advanced (or a constrained slot was torn down) since the
+        last tick — the dirty-flag pattern the block tables use, so the
+        steady state re-feeds the cached device array and the masked
+        decode step stays at one executable. The upload is timed into
+        ``mask_upload_seconds``."""
+        if self._mask_dirty or self._mask_dev is None:
+            t0 = time.monotonic()
+            if self.mesh is not None:
+                self._mask_dev = jax.device_put(self._mask_host,
+                                                self._replicated)
+            else:
+                self._mask_dev = jnp.asarray(self._mask_host)
+            self.metrics.record_mask_upload(time.monotonic() - t0)
+            self._mask_dirty = False
+        return self._mask_dev
+
+    def _set_slot_mask(self, i: int, st: _SlotState) -> None:
+        """Refresh slot ``i``'s mask row from its DFA state (no-op rows
+        stay all-zero); clears the row for non-DFA slots."""
+        if not self._constrained_mode:
+            return
+        if st is not None and st.dfa is not None:
+            row = st.dfa.mask_row(st.dfa_state, self._cfg.vocab_size)
+            self._mask_host[i, :] = row
+        else:
+            self._mask_host[i, :] = 0.0
+        self._mask_dirty = True
 
     def _pp_tables(self, mb: int, rows) -> list:
         """Per-STAGE committed device views of micro-batch ``mb``'s
@@ -3928,10 +4492,19 @@ class ServingEngine:
                 else:
                     self._positions_dev = jnp.asarray(positions)
                 self._positions_dirty = False
-            self._cache, self._tokens, self._positions_dev = (
-                self._decode_step(
-                    self._params, self._cache, self._tokens, self._temps,
-                    self._positions_dev, tables_dev, sub))
+            if self._constrained_mode:
+                mask_dev = self._upload_mask()
+                self._cache, self._tokens, self._positions_dev = (
+                    self._decode_step(
+                        self._params, self._cache, self._tokens,
+                        self._temps, self._positions_dev, tables_dev,
+                        mask_dev, sub))
+            else:
+                self._cache, self._tokens, self._positions_dev = (
+                    self._decode_step(
+                        self._params, self._cache, self._tokens,
+                        self._temps, self._positions_dev, tables_dev,
+                        sub))
             # Each decodable row appends exactly one K/V vector (the
             # device advances its own positions copy identically).
             for i in rows:
@@ -4092,6 +4665,29 @@ class ServingEngine:
             caps = np.zeros((self.slots,), np.int32)
             for i in decodable:
                 caps[i] = self._spec_room(i)
+            if self._constrained_mode:
+                # Speculation under masks: forbidden drafts are
+                # rejected BEFORE the verify can commit them — each
+                # constrained greedy row's cap is clamped to the
+                # DFA-valid prefix of its draft window (one host sync
+                # of the drafts, only when a constrained row is live).
+                # Sampled constrained rows cap at 0: their one-token
+                # commit would come from UNMASKED verify logits, so
+                # they are served by masked fallback ticks instead.
+                drafts_host = None
+                for i in decodable:
+                    sti = self._slot_state[i]
+                    if sti.dfa is None:
+                        continue
+                    if not spec_ok[i]:
+                        caps[i] = 0
+                        continue
+                    if drafts_host is None:
+                        drafts_host = np.asarray(drafts)
+                    caps[i] = min(
+                        int(caps[i]),
+                        sti.dfa.valid_prefix(sti.dfa_state,
+                                             drafts_host[i]))
             tables_dev = self._upload_tables(decodable)
             self._cache, self._tokens, out, commit = self._verify_step(
                 self._params, self._cache, self._tokens, drafts,
@@ -4255,15 +4851,24 @@ class ServingEngine:
                 self._readmit_from_tier(tokens, trace_id=req.trace_id)
             finally:
                 self._tier_trace_id = None
-        match = pool.match(tokens)
+        # Scorelike requests skip the prefix match: a matched prefix
+        # skips its chunks' compute, and the whole point of a scoring
+        # prefill is the per-position values that compute produces.
+        match = pool.match(tokens if req.kind not in SCORELIKE_KINDS
+                           else tokens[:0])
         m = match.matched_tokens
         first_block = m // self.kv_block_tokens
         needed = self._blocks_for(m, len(tokens) - 1)
         ids = pool.alloc(needed)
         while ids is None:
+            # Fork and scorelike rows are never preemption victims: a
+            # fork row's private stream cannot resume through the
+            # requeue path, and a scorelike row's accumulator would be
+            # silently truncated by a prefix-matched re-admission.
             victims = [
                 (i, s) for i, s in enumerate(self._slot_state)
-                if s is not None and s.request.priority > req.priority]
+                if s is not None and s.request.priority > req.priority
+                and s.request.kind == "generate"]
             if not victims:
                 pool.release(match)
                 self.scheduler.requeue(req)
@@ -4311,7 +4916,22 @@ class ServingEngine:
         ids = self.kv_pool.alloc(1)
         while ids is None:
             victims = [(j, s) for j, s in enumerate(self._slot_state)
-                       if s is not None]
+                       if s is not None
+                       and s.request.kind == "generate"]
+            if not victims:
+                # Only fork/scorelike rows are resident and the pool is
+                # dry: the wedged row's whole request errors typed (a
+                # fork row tears its group down with it) — there is no
+                # preemptable generate slot to relieve the pressure.
+                self.metrics.record_oom_reject()
+                self._finish_error(st.request, PoolExhausted(
+                    "KV pool exhausted with no preemptible slot"))
+                if st.fork_idx is not None or st.fork_wait:
+                    self._teardown_fork(st.request)
+                else:
+                    self._free_slot_paged(i, st, adopt=False)
+                    self._slot_state[i] = None
+                return False
             j, _ = max(victims,
                        key=lambda v: (v[1].request.priority, v[1].t_admit))
             self._preempt_slot(j)
@@ -4341,6 +4961,7 @@ class ServingEngine:
         st.match = None
         st.prefill = None
         self._tables[i, :] = self._sentinel
+        self._set_slot_mask(i, None)
         self._mark_tables_dirty()
         self._lens[i] = 0
         self._slot_state[i] = None
@@ -4364,7 +4985,14 @@ class ServingEngine:
             self._spec_pos[i] = 0
         if not self._paged:
             return
+        self._set_slot_mask(i, None)
         req = st.request
+        if st.fork_idx is not None or st.fork_wait:
+            # Fork rows never adopt: their decoded tail lives in
+            # st.fork_tokens (not req.out_tokens), so the adoption key
+            # would be wrong — and their shared prompt blocks are
+            # refcounted, freed for real only by the LAST row.
+            adopt = False
         valid = int(self._lens[i])
         if adopt and valid:
             tokens = self._resident_tokens(req)
@@ -4424,25 +5052,58 @@ class ServingEngine:
                                             trace_id=req.trace_id)
             st.remaining -= 1
         st.last_token_t = t
+        if st.dfa is not None:
+            # Advance the automaton host-side; reaching a terminal
+            # state (no outgoing edges) force-finishes the request.
+            nxt_state = st.dfa.step(st.dfa_state, tok)
+            if nxt_state is None:
+                st.remaining = 0
+            else:
+                st.dfa_state = nxt_state
+                if st.dfa.is_terminal(st.dfa_state):
+                    st.remaining = 0
+            self._set_slot_mask(self._slot_state.index(st), st)
+        if st.fork_tokens is not None:
+            # Fork rows keep a private stream; the DONE frame carries
+            # all n completions — nothing is streamed as token events.
+            st.fork_tokens.append(tok)
+            return
         req.out_tokens.append(tok)
         req.events.put_nowait(("token", tok))
 
     def _finish_ok(self, req: Request) -> None:
+        if req.t_done is not None:
+            # A fork group's n rows share one Request: only the first
+            # terminal transition counts.
+            return
         req.t_done = time.monotonic()
         self.scheduler.release_quota(req)
         self.metrics.record_finish(req.t_done - req.t_submit)
-        self.metrics.record_tenant_done(req.tenant, len(req.out_tokens))
+        done_tokens = (sum(len(c) for c in req.fork_completions)
+                       if req.fork_completions is not None
+                       else len(req.out_tokens))
+        self.metrics.record_tenant_done(req.tenant, done_tokens)
         self._finalize_trace(req, "ok")
-        req.events.put_nowait(("done", {
-            "tokens": len(req.out_tokens),
+        done = {
+            "tokens": done_tokens,
             "ttft_s": req.ttft,
             "latency_s": req.t_done - req.t_submit,
             "weight_version": req.weight_version,
             "tenant": req.tenant,
-        }))
+            "kind": req.kind,
+        }
+        if req.fork_completions is not None:
+            done["completions"] = req.fork_completions
+        if req.logprobs is not None:
+            done["logprobs"] = req.logprobs
+        if req.embedding is not None:
+            done["embedding"] = req.embedding
+        req.events.put_nowait(("done", done))
         req.done.set()
 
     def _finish_error(self, req: Request, err: ServingError) -> None:
+        if req.t_done is not None:
+            return
         req.error = err
         req.t_done = time.monotonic()
         # Quota credit on EVERY terminal path: a charged request that

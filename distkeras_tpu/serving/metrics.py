@@ -454,6 +454,22 @@ class ServingMetrics:
         self._tenant_completed: dict[str, int] = {}
         self._tenant_tokens: dict[str, int] = {}
         self._tenant_active_gauges: dict[str, object] = {}
+        # Request kinds (generate / sample / score / embed): per-kind
+        # admission counter (bounded label set — the kind vocabulary is
+        # fixed), CoW fork block-share counter, and the mask-upload
+        # latency of constrained decoding (the host-side cost of every
+        # automaton state change; a regression here shows up as
+        # inter-token jitter on constrained streams).
+        self._kind_counters: dict[str, object] = {}
+        self._c_fork_blocks = reg.counter(
+            "kv_fork_blocks_total",
+            help="extra copy-on-write shares handed out on KV blocks by "
+                 "forked sampling (one per block per extra fork row)")
+        self._h["mask_upload"] = reg.histogram(
+            "mask_upload_seconds",
+            help="host→device upload latency of the constrained-decoding "
+                 "token mask (per dirty-mask decode dispatch)",
+            buckets=_LATENCY_BUCKETS)
 
     # -- counter compatibility surface (pre-registry attribute names) -------
     @property
@@ -591,6 +607,41 @@ class ServingMetrics:
             "serving_tenant_tokens_out_total",
             help="tokens streamed per tenant", tenant=label).inc(
                 int(tokens))
+
+    # -- request kinds ------------------------------------------------------
+    def record_request_kind(self, kind: str) -> None:
+        """One admitted request of ``kind`` — the per-kind traffic
+        counter ``serving_requests_total{kind=}``. The label set is the
+        fixed kind vocabulary, so cardinality is bounded by
+        construction (no labeler needed)."""
+        c = self._kind_counters.get(kind)
+        if c is None:
+            c = self.registry.counter(
+                "serving_requests_total",
+                help="admitted requests per request kind",
+                kind=str(kind))
+            self._kind_counters[kind] = c
+        c.inc()
+
+    def kind_counters(self) -> dict[str, int]:
+        return {k: int(c.value) for k, c in self._kind_counters.items()}
+
+    def record_fork_blocks(self, n: int) -> None:
+        """``n`` extra copy-on-write block shares handed out at a fork
+        (blocks × (n_forks - 1)) — the block-sharing ratio's numerator
+        in serving_bench's fork rows."""
+        self._c_fork_blocks.inc(int(n))
+
+    @property
+    def fork_blocks(self) -> int:
+        return int(self._c_fork_blocks.value)
+
+    def record_mask_upload(self, seconds: float,
+                           trace_id: str | None = None) -> None:
+        """One dirty-mask host→device upload before a constrained decode
+        dispatch; the exemplar names the constrained stream that paid a
+        slow upload."""
+        self._h["mask_upload"].observe(seconds, exemplar=trace_id)
 
     def tenant_counters(self) -> dict[str, dict]:
         return {t: {"completed": self._tenant_completed.get(t, 0),
@@ -808,6 +859,14 @@ class ServingMetrics:
             if self._h["kv_readmit"].count:
                 out["kv_readmit_latency_p99_s"] = (
                     self._h["kv_readmit"].percentile(99))
+        for kind, n in self.kind_counters().items():
+            out[f"requests_kind_{kind}"] = float(n)
+        if self.fork_blocks:
+            out["kv_fork_blocks"] = float(self.fork_blocks)
+        if self._h["mask_upload"].count:
+            out["mask_upload_count"] = float(self._h["mask_upload"].count)
+            out["mask_upload_mean_s"] = float(self._h["mask_upload"].mean)
+            out["mask_upload_p99_s"] = self._h["mask_upload"].percentile(99)
         if self._c_spec_draft.value:
             out["spec_draft_tokens"] = float(self.spec_draft_tokens)
             out["spec_accepted_tokens"] = float(self.spec_accepted_tokens)

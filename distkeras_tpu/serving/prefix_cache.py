@@ -759,6 +759,17 @@ class KVBlockPool(_BlockTrie):
         # High-water mark of blocks in use — what a byte budget must
         # actually cover; serving_bench turns it into tokens-per-byte.
         self.peak_blocks_used = 0
+        # Copy-on-write sharing for forked sampling (kind="sample"):
+        # ``_fork_refs[row] = k`` means k ADDITIONAL owners share the row
+        # beyond the one that will eventually free it last. ``free`` on
+        # such a row decrements instead of returning it to the free list
+        # — the row truly frees only when its last owner lets go. Rows
+        # here are PRIVATE slot rows (complete, never-again-written
+        # prompt blocks shared by n fork rows), distinct from trie
+        # pinning (``_Node.refs``), which protects SHARED trie rows.
+        self._fork_refs: dict[int, int] = {}
+        self.forked_blocks_total = 0  # cumulative extra shares handed out
+        self.fork_cow_copies = 0      # tail blocks copied at fork time
         self._g_pool = None
         if registry is not None:
             self._metrics = _register_trie_metrics(registry)
@@ -796,6 +807,9 @@ class KVBlockPool(_BlockTrie):
             "inserted_blocks": self.inserted_blocks,
             "evicted_blocks": self.evicted_blocks,
             "flushes": self.flushes,
+            "fork_shared_blocks": len(self._fork_refs),
+            "forked_blocks_total": self.forked_blocks_total,
+            "fork_cow_copies": self.fork_cow_copies,
         }
 
     # -- slot allocation ----------------------------------------------------
@@ -827,15 +841,59 @@ class KVBlockPool(_BlockTrie):
             self._note_occupancy()
         return got
 
+    def fork(self, ids, n: int) -> None:
+        """Register ``n - 1`` additional owners for each private row in
+        ``ids`` — the copy-on-write share under forked sampling: one
+        prefill's complete prompt blocks are pointed at by all ``n`` fork
+        rows' block tables, and each fork :meth:`free`\\ s them at its own
+        teardown. Complete blocks are never written again (appends go to
+        fresh private tail blocks), so sharing needs no copy — the only
+        copy-on-write moment is the PARTIAL tail block, which the engine
+        duplicates per fork at fork time (:attr:`fork_cow_copies`)."""
+        extra = max(0, int(n) - 1)
+        if not extra or not len(ids):
+            return
+        for i in ids:
+            self._fork_refs[int(i)] = self._fork_refs.get(int(i), 0) + extra
+        self.forked_blocks_total += extra * len(ids)
+
+    def note_cow_copy(self, n: int = 1) -> None:
+        """Count ``n`` tail blocks physically copied at fork time (the
+        divergent-write half of copy-on-write)."""
+        self.fork_cow_copies += int(n)
+
     def free(self, ids) -> None:
         """Return private rows to the free list. Only rows handed out by
-        :meth:`alloc` and not since adopted may be freed."""
+        :meth:`alloc` and not since adopted may be freed. Rows shared
+        across fork groups (:meth:`fork`) decrement their extra-owner
+        count instead — the row returns to the free list only on its
+        LAST owner's free, which keeps block accounting exact under
+        copy-on-write sampling."""
         if not len(ids):
             return
-        self._free.extend(int(i) for i in ids)
+        released: list[int] = []
+        for i in ids:
+            i = int(i)
+            extra = self._fork_refs.get(i)
+            if extra:
+                if extra == 1:
+                    del self._fork_refs[i]
+                else:
+                    self._fork_refs[i] = extra - 1
+                continue
+            released.append(i)
+        if not released:
+            return
+        self._free.extend(released)
         self.version += 1
         if self._metrics is not None:
             self._note_occupancy()
+
+    def flush(self) -> None:
+        """Pool flush additionally clears fork shares: a flush runs with
+        zero active slots, so no fork group can still own rows."""
+        self._fork_refs.clear()
+        super().flush()
 
     def adopt(self, tokens, ids, first_block: int) -> int:
         """Zero-copy prefix-cache insert: chain the slot's private rows

@@ -44,6 +44,9 @@ __all__ = [
     "TenantOverQuota",
     "TenantQuota",
     "DEFAULT_TENANT",
+    "REQUEST_KINDS",
+    "SCORELIKE_KINDS",
+    "SCORE_CLASS_SUFFIX",
     "Request",
     "Scheduler",
 ]
@@ -166,6 +169,23 @@ class TenantQuota:
         }
 
 
+#: The typed request kinds the serving stack understands. ``generate``
+#: is the classic single-completion stream; ``sample`` forks one prefill
+#: into n decode rows over copy-on-write KV blocks; ``score`` returns
+#: per-token logprobs of the prompt (prefill only); ``embed`` returns a
+#: pooled hidden state (prefill only).
+REQUEST_KINDS = ("generate", "sample", "score", "embed")
+
+#: Kinds that run prefill only and never occupy a decode slot. They are
+#: queued under a SEPARATE QoS identity (``tenant + "#score"``) so bulk
+#: scoring traffic gets its own DRR weight and quota bucket and cannot
+#: starve the same tenant's interactive decode.
+SCORELIKE_KINDS = frozenset({"score", "embed"})
+
+#: Suffix appended to a tenant id to form the scorelike traffic class.
+SCORE_CLASS_SUFFIX = "#score"
+
+
 class Request:
     """One generation request plus its streaming output channel.
 
@@ -186,6 +206,9 @@ class Request:
         trace_id: str | None = None,
         speculate: bool = True,
         tenant: str = DEFAULT_TENANT,
+        kind: str = "generate",
+        n: int = 1,
+        constraint: object = None,
     ):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -203,6 +226,16 @@ class Request:
         # echoed on the done line so per-tenant accounting closes the
         # loop. Cast defensively — it arrives from the wire.
         self.tenant = str(tenant) if tenant else DEFAULT_TENANT
+        # Typed request kind — validated by the ENGINE's _build_request
+        # (the scheduler stays policy-only), defaulting anything unset to
+        # the classic generate stream so pre-kinds callers are untouched.
+        self.kind = str(kind) if kind else "generate"
+        # Fork fan-out for kind="sample": n decode rows share the prompt's
+        # KV blocks copy-on-write; the done frame carries n completions.
+        self.n = int(n) if n else 1
+        # Wire-form constraint table (dict) or a compiled TokenDFA; the
+        # engine compiles/validates at admission.
+        self.constraint = constraint
         # Tokens charged against the tenant's quota at submit; the
         # scheduler credits back the unused part at completion.
         self.quota_charged = 0
@@ -227,6 +260,12 @@ class Request:
         self.cache_overtaken = 0  # times a cache hit was served over us
         self.events: asyncio.Queue = asyncio.Queue()
         self.out_tokens: list[int] = []
+        # Kind-specific results, filled by the engine at completion:
+        # sample -> n token lists; score -> per-token logprobs of the
+        # prompt; embed -> pooled hidden-state vector.
+        self.fork_completions: list[list[int]] | None = None
+        self.logprobs: list[float] | None = None
+        self.embedding: list[float] | None = None
         self.error: ServingError | None = None
         self.done = asyncio.Event()
         self.cancelled = False
@@ -239,6 +278,29 @@ class Request:
         from the queue) at the next loop iteration instead of decoding
         tokens nobody will read."""
         self.cancelled = True
+
+    @property
+    def qos_tenant(self) -> str:
+        """The identity this request is QUEUED under: plain tenant for
+        decode-shaped kinds, ``tenant#score`` for prefill-only scoring/
+        embedding — a distinct traffic class with its own DRR ring slot,
+        weight, and quota bucket, so a scoring flood deepens only its own
+        backlog (ISSUE 19's "bulk scoring can't starve interactive
+        decode")."""
+        if self.kind in SCORELIKE_KINDS:
+            return self.tenant + SCORE_CLASS_SUFFIX
+        return self.tenant
+
+    def consumed_tokens(self) -> int:
+        """Tokens this request actually consumed against its quota
+        charge: decoded tokens for generate, the sum over all forks for
+        sample, and the scored prompt length for score/embed (their cost
+        is prefill compute, metered in prompt tokens)."""
+        if self.kind == "sample" and self.fork_completions is not None:
+            return sum(len(c) for c in self.fork_completions)
+        if self.kind in SCORELIKE_KINDS:
+            return len(self.prompt)
+        return len(self.out_tokens)
 
     @property
     def deadline(self) -> float | None:
@@ -409,10 +471,17 @@ class Scheduler:
 
     @staticmethod
     def _cost(request: Request) -> float:
-        """DRR cost of serving a request: the decode tokens it is still
-        owed (a preempted resume costs only its remainder)."""
-        return float(max(1, request.max_new_tokens
-                         - len(request.out_tokens)))
+        """DRR cost of serving a request, in tokens of compute: the
+        decode tokens still owed (a preempted resume costs only its
+        remainder), times the fork fan-out for ``sample``; prefill-only
+        scoring/embedding costs its prompt length — their work IS the
+        prefill."""
+        if request.kind in SCORELIKE_KINDS:
+            return float(max(1, len(request.prompt)))
+        owed = max(1, request.max_new_tokens - len(request.out_tokens))
+        if request.kind == "sample":
+            owed *= max(1, request.n)
+        return float(owed)
 
     def set_tenant_quota(self, tenant: str, rate: float,
                          burst_s: float = 2.0) -> None:
@@ -428,30 +497,39 @@ class Scheduler:
         if self._n >= self.max_depth:
             raise QueueFullError(
                 f"queue depth {self._n} at max_depth={self.max_depth}")
-        quota = self._quotas.get(request.tenant)
+        qos_tenant = request.qos_tenant
+        quota = self._quotas.get(qos_tenant)
         if quota is not None:
-            need = max(1, request.max_new_tokens)
+            # Worst-case token charge by kind: generate is its decode
+            # budget, sample multiplies by the fork fan-out, scorelike
+            # is metered in prompt (prefill) tokens.
+            if request.kind in SCORELIKE_KINDS:
+                need = max(1, len(request.prompt))
+            elif request.kind == "sample":
+                need = max(1, request.max_new_tokens) * max(1, request.n)
+            else:
+                need = max(1, request.max_new_tokens)
             if not quota.take(need, now):
-                self.over_quota_rejects[request.tenant] += 1
+                self.over_quota_rejects[qos_tenant] += 1
                 if self._registry is not None:
                     self._registry.counter(
                         "scheduler_tenant_over_quota_total",
                         help="requests rejected at submit because the "
                              "tenant's token-rate quota had no room",
-                        tenant=self._tenant_label(request.tenant)).inc()
+                        tenant=self._tenant_label(qos_tenant)).inc()
                 if need > quota.capacity:
                     # Not a transient: no amount of waiting refills past
                     # the burst capacity — the retry advice below would
                     # be a lie (same stance as PoolExhausted's sizing
                     # reject).
                     raise TenantOverQuota(
-                        f"tenant {request.tenant!r}: request needs "
+                        f"tenant {qos_tenant!r}: request needs "
                         f"{need} tokens but the quota's burst capacity "
                         f"is {quota.capacity:g} (rate {quota.rate:g} "
                         f"tok/s) — it can NEVER be admitted; raise the "
                         f"quota/burst or lower max_new_tokens")
                 raise TenantOverQuota(
-                    f"tenant {request.tenant!r} over quota: request needs "
+                    f"tenant {qos_tenant!r} over quota: request needs "
                     f"{need} tokens, bucket has "
                     f"{quota.available:.1f} (rate "
                     f"{quota.rate:g} tok/s) — back off and retry")
@@ -465,9 +543,10 @@ class Scheduler:
         cls = self._classes.get(request.priority)
         if cls is None:
             cls = self._classes[request.priority] = _PrioClass()
-        tq = cls.tenants.get(request.tenant)
+        name = request.qos_tenant
+        tq = cls.tenants.get(name)
         if tq is None:
-            tq = cls.tenants[request.tenant] = _TenantQueue(request.tenant)
+            tq = cls.tenants[name] = _TenantQueue(name)
             cls.ring.append(tq)
         if front:
             tq.q.appendleft((seq, request))
@@ -518,7 +597,7 @@ class Scheduler:
         with enough deficit banked to be served next."""
         self._push(request, next(self._requeue_seq), front=True)
         cls = self._classes[request.priority]
-        tq = cls.tenants[request.tenant]
+        tq = cls.tenants[request.qos_tenant]
         if cls.ring and cls.ring[0] is not tq:
             cls.ring.remove(tq)
             cls.ring.appendleft(tq)
@@ -665,8 +744,8 @@ class Scheduler:
         never charged is a no-op."""
         if not request.quota_charged:
             return
-        quota = self._quotas.get(request.tenant)
-        unused = request.quota_charged - len(request.out_tokens)
+        quota = self._quotas.get(request.qos_tenant)
+        unused = request.quota_charged - request.consumed_tokens()
         request.quota_charged = 0
         if quota is not None and unused > 0:
             quota.credit(unused)
@@ -708,7 +787,7 @@ class Scheduler:
         gauges: a passive registry cannot watch the queue itself)."""
         depth: collections.Counter = collections.Counter()
         for _, req in self._iter_items():
-            depth[req.tenant] += 1
+            depth[req.qos_tenant] += 1
         # Every tenant that EVER had a labeled series is refreshed, so
         # a tenant whose queue drained reads 0 on the next scrape
         # instead of its last nonzero depth forever.
@@ -763,6 +842,8 @@ class Scheduler:
                 "prompt_tokens": len(req.prompt),
                 "max_new_tokens": req.max_new_tokens,
             }
+            if req.kind != "generate":
+                entry["kind"] = req.kind
             if req.deadline is not None:
                 entry["deadline_in_s"] = round(req.deadline - now, 6)
             queued.append(entry)

@@ -144,6 +144,9 @@ class ServingServer:
             speculate=bool(spec.get("speculate", True)),
             tenant=str(spec.get("tenant") or "default"),
             resume_tokens=spec.get("resume_tokens"),
+            kind=str(spec.get("kind") or "generate"),
+            n=int(spec.get("n") or 1),
+            constraint=spec.get("constraint"),
         )
 
     async def _import_from_peer(self, spec: dict) -> dict | None:
@@ -226,6 +229,14 @@ class ServingServer:
             "ttft_ms": round(1e3 * req.ttft, 3),
             "latency_ms": round(1e3 * (req.t_done - req.t_submit), 3),
         }
+        if req.kind != "generate":
+            done["kind"] = req.kind
+        if req.fork_completions is not None:
+            done["completions"] = req.fork_completions
+        if req.logprobs is not None:
+            done["logprobs"] = req.logprobs
+        if req.embedding is not None:
+            done["embedding"] = req.embedding
         if req.weight_version is not None:
             # Provenance: the exact checkpoint (version + content
             # digest) the serving params came from — a bad answer

@@ -104,6 +104,7 @@ __all__ = [
     "decode_json",
     "encode_request",
     "decode_request",
+    "request_flags",
     "encode_token_frame",
     "decode_tokens",
     "pack_token_frames",
@@ -166,7 +167,14 @@ _F_SPECULATE = 1
 # are only ever produced inside a roles-enabled fleet, whose replicas
 # all speak them.
 _F_EXTRAS = 2
-_EXTRA_KEYS = ("kv_from", "kv_wait", "resume_tokens")
+# Whitelist of spec keys that ride the extras blob. Keys NOT listed here
+# are silently dropped by encode_request (PR 15's lesson) — every new
+# request field MUST be added here or a bin1 hop loses it. The request-
+# kinds fields are truthiness-safe by construction: clients set ``kind``
+# only when != "generate", ``n`` only when > 1, ``constraint`` only when
+# present, so ordinary generate frames stay byte-identical.
+_EXTRA_KEYS = ("kv_from", "kv_wait", "resume_tokens",
+               "kind", "n", "constraint")
 
 
 class WireError(ValueError):
@@ -427,6 +435,20 @@ def affinity_prefix(payload, k: int) -> bytes:
     (prompt_len,) = struct.unpack_from("<I", buf, _REQ.size - 4)
     n = min(int(prompt_len), k)
     return buf[_REQ.size:_REQ.size + 4 * n]
+
+
+def request_flags(payload) -> int:
+    """The flags byte of a T_REQ payload without decoding the spec —
+    the router's fast path peeks this to detect extras-bearing requests
+    (request kinds, disaggregation hints) that need the full kind-aware
+    dispatch instead of the zero-copy forward. Returns 0 on a malformed
+    payload (the forwarding replica will reject it typed)."""
+    buf = bytes(payload)
+    if len(buf) < _REQ.size:
+        return 0
+    # flags u8 sits after max_new u32 + temperature f32 + priority i32
+    # + timeout f64 in the packed (unaligned) header.
+    return buf[20]
 
 
 def encode_token_frame(stream_id: int, tokens) -> bytes:

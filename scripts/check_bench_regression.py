@@ -100,7 +100,15 @@ _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     # collapse — it regresses UP; the per-depth goodput
                     # and speedup_x rows regress DOWN (higher-is-better
                     # by default).
-                    "bubble_fraction")
+                    "bubble_fraction",
+                    # Request-kind rows (serving/kinds_*): the mask
+                    # upload is host->device copy time the dirty-flag
+                    # pattern keeps off the decode path, and the fork
+                    # overhead is the extra latency an n-way sample
+                    # pays over a plain generate of the same shape —
+                    # both regress UP; the per-kind goodput rows
+                    # regress DOWN (higher-is-better by default).
+                    "mask_upload", "fork_overhead")
 
 
 def lower_is_better(key: str) -> bool:

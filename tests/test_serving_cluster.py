@@ -263,10 +263,17 @@ def test_replica_death_retries_zero_streamed_and_restarts(lm, rng):
             await asyncio.gather(*tasks)
 
             # Supervisor notices (router feedback or health probe),
-            # restarts, and the replica rejoins.
+            # restarts, and the replica rejoins. Wait for the restart
+            # ITSELF before waiting on ready_count: when every in-flight
+            # request drains before the ~0.1 s probe window closes,
+            # ready_count still reads a stale 2 off the not-yet-probed
+            # corpse and the restart assertion would race the probe.
+            await _wait_until(
+                lambda: cluster.replicas["r0"].restarts >= 1,
+                what="supervisor restart of r0")
             await _wait_until(
                 lambda: cluster.supervisor.ready_count == 2,
-                what="replica restart")
+                what="replica rejoin")
             assert cluster.replicas["r0"].restarts >= 1
 
             # The restarted replica serves traffic again: flood enough
