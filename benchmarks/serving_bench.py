@@ -1766,6 +1766,60 @@ def _record_slo_history(args, report):
     bench.write_history(path, hist)
 
 
+def _queryz_probe(args, engine, report):
+    """Measure the wide-event plane's two costs on the LIVE engine after
+    the load phases:
+
+    1. **append overhead** — the store self-times every append with one
+       ``perf_counter_ns`` pair, so total append ns over the serving
+       wall clock is the plane's real done-time tax. Asserted < 1%:
+       wide events must stay effectively free next to decode.
+    2. **query latency** — the ring is padded to CAPACITY with synthetic
+       rows (the worst-case full scan) and a representative two-column
+       group-by with count/p99/mean aggregates is timed repeatedly; the
+       median is the number an operator's queryz actually costs a live
+       engine.
+
+    Runs after the compile-count assertion, so the probe also certifies
+    that emission added no retrace."""
+    import statistics
+
+    store = engine.wide_events
+    stats = store.stats()
+    wall = sum((report.get(m) or {}).get("wall_s", 0.0)
+               for m in ("closed", "open"))
+    overhead_pct = (100.0 * stats["append_ns_total"] / (wall * 1e9)
+                    if wall > 0 else None)
+    rows_from_run = stats["rows"]
+    i = 0
+    while len(store) < store.capacity:
+        store.append({"trace_id": f"pad{i}", "tenant": f"t{i % 8}",
+                      "kind": "sample", "status": "ok",
+                      "ttft_s": 0.001 * (i % 97 + 1),
+                      "latency_s": 0.01 * (i % 53 + 1)})
+        i += 1
+    lat = []
+    res = None
+    for _ in range(15):
+        t0 = time.perf_counter()
+        res = store.query(group_by=["tenant", "kind"],
+                          aggs=["count", "p99:ttft_s", "mean:latency_s"])
+        lat.append(time.perf_counter() - t0)
+    report["queryz_probe"] = {
+        "rows_from_run": rows_from_run,
+        "rows_padded_to": len(store),
+        "append_ns_mean": round(stats["append_ns_mean"], 1),
+        "append_overhead_pct": (round(overhead_pct, 5)
+                                if overhead_pct is not None else None),
+        "query_groups": len(res["groups"]),
+        "query_latency_p50_s": round(statistics.median(lat), 6),
+    }
+    if overhead_pct is not None:
+        assert overhead_pct < 1.0, (
+            f"wide-event append cost {overhead_pct:.3f}% of serving "
+            f"wall — the done-time plane must stay under 1%")
+
+
 def _record_history(args, report):
     """Append this run's headline numbers to ``bench_history.json`` under
     ``serving/...`` keys, via ``bench.py``'s shared ``history_entry`` /
@@ -1841,6 +1895,20 @@ def _record_history(args, report):
                 continue
             key = f"{base}/sweep/{metric}"
             hist[key] = bench.history_entry(hist.get(key), float(v), when)
+    probe = report.get("queryz_probe")
+    if isinstance(probe, dict):
+        # serving/widevents_* rows: the wide-event plane's own series
+        # (append tax, full-ring query latency), lower-is-better by
+        # name, same strict --only serving/ CI gate as everything else.
+        wbase = f"serving/widevents_{model_tag}/slots{args.slots}"
+        for metric in ("append_overhead_pct", "append_ns_mean",
+                       "query_latency_p50_s"):
+            v = probe.get(metric)
+            if (isinstance(v, (int, float)) and not isinstance(v, bool)
+                    and v > 0):
+                key = f"{wbase}/{metric}"
+                hist[key] = bench.history_entry(hist.get(key), float(v),
+                                                when)
     bench.write_history(path, hist)
 
 
@@ -2207,6 +2275,13 @@ def main():
                     help="--workload-mix: share of the generate slice "
                          "to run as constrained (token-masked) "
                          "streams; 0 disables the mask path")
+    ap.add_argument("--queryz-probe", action="store_true",
+                    help="measure the wide-event plane on the live "
+                         "engine: append overhead as a fraction of the "
+                         "serving wall clock (asserted < 1%%) and the "
+                         "median full-ring query latency; with "
+                         "--record-history, writes serving/widevents_* "
+                         "rows")
     ap.add_argument("--record-history", action="store_true",
                     help="append serving/* rows to bench_history.json for "
                          "scripts/check_bench_regression.py")
@@ -2566,6 +2641,8 @@ def main():
         assert compiles in (1, -1), (
             f"continuous batching retraced the decode step: {compiles} "
             "compiled executables (expected exactly 1)")
+        if args.queryz_probe and engine.wide_events is not None:
+            _queryz_probe(args, engine, report)
         if engine.auditor is not None and _speculating(args):
             # Speculative run: the armed auditor stayed silent (or we
             # would not be here) — record and assert the per-callable

@@ -344,6 +344,11 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
                     help="write the request-timeline store as Chrome-"
                          "trace JSON (one lane per request) on shutdown; "
                          "implies --request-trace")
+    ap.add_argument("--wide-events", type=int, default=4096, metavar="N",
+                    help="per-request wide-event ring capacity (one flat "
+                         "~40-column record per finished request, "
+                         "queryable via the queryz verb / `run.py "
+                         "queryz`); 0 disables")
     ap.add_argument("--flight-recorder", type=int, default=None,
                     metavar="N",
                     help="> 0: arm the flight recorder with an N-event "
@@ -407,13 +412,19 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         registry=registry)
     auditor = (RecompileAuditor(registry=registry)
                if args.audit_recompiles else None)
-    from distkeras_tpu.telemetry import FlightRecorder, TraceStore
+    from distkeras_tpu.telemetry import (
+        FlightRecorder, TailRetention, TraceStore,
+    )
 
     # None = unset (flag defaults apply); an EXPLICIT 0 always disables.
     trace_cap = args.request_trace
     if trace_cap is None and args.request_trace_out:
         trace_cap = 512
-    trace_store = TraceStore(trace_cap) if trace_cap else None
+    # Tail-based retention rides every armed trace store: errors, SLO
+    # breaches, per-kind latency tails, rare tenants, and a 1/N
+    # baseline survive the sliding window in a keeper reservoir.
+    trace_store = (TraceStore(trace_cap, retention=TailRetention())
+                   if trace_cap else None)
     recorder_cap = args.flight_recorder
     if recorder_cap is None and args.flight_dump:
         recorder_cap = 256
@@ -485,6 +496,7 @@ def serve_main(argv=None, prog="serve", default_replicas=1) -> int:
         spec_k=args.spec_k, mesh=mesh,
         pipeline_depth=args.pipeline_depth,
         trace_store=trace_store, flight_recorder=recorder,
+        wide_events=args.wide_events,
         slo_s=args.slo_ms / 1e3 if args.slo_ms else None,
         weight_version=weight_version,
         tenant_quotas=_parse_tenant_rates(args.tenant_quota,
@@ -644,6 +656,8 @@ def _serving_config_flags(args) -> list[str]:
         extra += ["--tenant-quota", str(item)]
     for item in getattr(args, "tenant_weight", None) or []:
         extra += ["--tenant-weight", str(item)]
+    if getattr(args, "wide_events", None) is not None:
+        extra += ["--wide-events", str(args.wide_events)]
     return extra
 
 
@@ -1129,6 +1143,68 @@ def debugz_main(argv=None) -> int:
     return 0
 
 
+def queryz_main(argv=None) -> int:
+    """``queryz`` subcommand: filter / group / aggregate the wide-event
+    per-request store of a live server — or a whole fleet through its
+    router, where percentile aggregates merge bucket-exactly. E.g.::
+
+        run.py queryz --where kind=sample --group-by tenant \\
+            --agg count --agg p99:ttft_s
+
+    ``--json`` prints the raw payload (including the mergeable
+    histogram states) for scripts."""
+    import asyncio
+
+    ap = argparse.ArgumentParser(prog="distkeras_tpu.run queryz")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8500,
+                    help="a serving server's port, or a cluster router's "
+                         "front port (fleet-merged result)")
+    ap.add_argument("--where", action="append", default=[],
+                    metavar="COL<OP>VALUE",
+                    help="filter term like kind=sample or ttft_s>0.25 "
+                         "(repeatable; ops = != >= <= > <)")
+    ap.add_argument("--group-by", action="append", default=[],
+                    metavar="COL",
+                    help="group-by column, up to 2 (repeatable, or one "
+                         "comma-separated list)")
+    ap.add_argument("--agg", action="append", default=[], metavar="SPEC",
+                    help="aggregate spec: count, sum:COL, mean:COL, or "
+                         "pX:COL like p99:ttft_s (repeatable; default "
+                         "count)")
+    ap.add_argument("--max-groups", type=int, default=None,
+                    help="distinct group keys beyond this fold into "
+                         "__other__ (server default 64)")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON payload instead of the pretty table")
+    args = ap.parse_args(argv)
+    group_by = [c for chunk in args.group_by
+                for c in chunk.split(",") if c]
+
+    from distkeras_tpu.serving import ServingClient, ServingError
+    from distkeras_tpu.serving.debugz import format_queryz
+
+    async def go():
+        async with ServingClient(args.host, args.port,
+                                 max_retries=0) as client:
+            return await client.queryz(
+                where=args.where or None, group_by=group_by or None,
+                aggs=args.agg or None, max_groups=args.max_groups)
+
+    try:
+        payload = asyncio.run(go())
+    except (OSError, ConnectionError) as e:
+        print(f"queryz: cannot reach {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 1
+    except ServingError as e:
+        print(f"queryz: server refused: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(payload, indent=1) if args.json
+          else format_queryz(payload))
+    return 0
+
+
 def _write_statusz(trainer, path: str) -> bool:
     """One atomic statusz snapshot (tmp + replace, same contract as the
     weight publisher: a concurrent reader sees old or new, never torn).
@@ -1190,6 +1266,8 @@ def main(argv=None) -> int:
         return serve_main(argv[1:], prog="cluster", default_replicas=2)
     if argv and argv[0] == "debugz":
         return debugz_main(argv[1:])
+    if argv and argv[0] == "queryz":
+        return queryz_main(argv[1:])
     if argv and argv[0] == "deploy":
         return deploy_main(argv[1:])
     if argv and argv[0] == "deployz":

@@ -587,6 +587,33 @@ class ServingClient:
             spec["trace_id"] = str(trace_id)
         return (await self._control(spec, retry=True))["tracez"]
 
+    async def queryz(self, where=None, group_by=None, aggs=None,
+                     max_groups: int | None = None) -> dict:
+        """Wide-event analytics over the server's columnar per-request
+        store (fleet-merged with bucket-exact percentiles when pointed
+        at a router). ``where``: term strings like ``"kind=sample"`` /
+        ``"ttft_s>0.25"``; ``group_by``: ≤2 column names; ``aggs``:
+        specs like ``"count"`` / ``"mean:latency_s"`` / ``"p99:ttft_s"``.
+        Reconnects with backoff (idempotent)."""
+        spec: dict = {"cmd": "queryz"}
+        if where:
+            spec["where"] = [str(t) for t in where]
+        if group_by:
+            spec["group_by"] = [str(c) for c in group_by]
+        if aggs:
+            spec["aggs"] = [str(a) for a in aggs]
+        if max_groups is not None:
+            spec["max_groups"] = int(max_groups)
+        return (await self._control(spec, retry=True))["queryz"]
+
+    async def pin_traces(self, trace_ids) -> dict:
+        """Pin trace ids never-evictable in the target's trace store
+        (fans out fleet-wide through a router)."""
+        ids = [str(t) for t in ([trace_ids] if isinstance(trace_ids, str)
+                                else trace_ids)]
+        return (await self._control({"cmd": "tracez", "pin": ids},
+                                    retry=True))["tracez"]
+
     async def deployz(self) -> dict:
         """Continuous-deployment state (current / last-good / candidate
         versions, deploy history ring, quarantine records) from a router

@@ -34,6 +34,7 @@ import asyncio
 import dataclasses
 import json
 import sys
+import time
 
 __all__ = [
     "STARTING",
@@ -272,6 +273,17 @@ class EchoServer:
         self.wire_mode = wire_mode
         self.kv_fail = bool(kv_fail)
         self.kv_block_tokens = int(kv_block_tokens)
+        # Real (jax-free) observability stores, so router queryz
+        # fan-out/merge and fleet-wide trace pinning are testable
+        # against an echo fleet: one wide event per echoed request
+        # with DETERMINISTIC synthetic latencies (a pure function of
+        # prompt length, never a clock read), and a genuine TraceStore
+        # answering tracez pins. Deferred imports keep this module's
+        # import graph flat for the bench's many-replica startups.
+        from distkeras_tpu.telemetry.request_trace import TraceStore
+        from distkeras_tpu.telemetry.wide_events import WideEventStore
+        self.wide_events = WideEventStore(capacity=1024)
+        self.trace_store = TraceStore(capacity=256)
         self.requests = 0
         self.kind_requests: dict[str, int] = {}
         self.kv_prefills = 0
@@ -330,6 +342,30 @@ class EchoServer:
                     "prompt_tokens": len(prompt),
                     "blocks": len(prompt) // self.kv_block_tokens,
                     "trace_id": spec.get("trace_id")}}]
+            if cmd == "queryz":
+                try:
+                    result = self.wide_events.query(
+                        where=spec.get("where"),
+                        group_by=spec.get("group_by"),
+                        aggs=spec.get("aggs"),
+                        max_groups=int(spec.get("max_groups", 64)))
+                except (TypeError, ValueError) as e:
+                    return [{"error": f"bad queryz spec: {e}",
+                             "code": "bad_request"}]
+                result["stats"] = self.wide_events.stats()
+                return [{"queryz": result}]
+            if cmd == "tracez":
+                pins = spec.get("pin")
+                if pins:
+                    if isinstance(pins, str):
+                        pins = [pins]
+                    pinned = [str(t) for t in pins
+                              if self.trace_store.pin(str(t))]
+                    return [{"tracez": {
+                        "pinned": pinned,
+                        "stats": self.trace_store.stats()}}]
+                return [{"tracez": {"recent": [],
+                                    "stats": self.trace_store.stats()}}]
             return [{"error": f"unknown cmd {cmd!r}",
                      "code": "bad_request"}]
         prompt = spec.get("prompt") or []
@@ -348,12 +384,42 @@ class EchoServer:
             return [err]
         self.requests += 1
         toks, extra = self._kind_result(spec, tok)
+        self._emit_wide(spec, toks, extra)
         done = {"done": True, "tokens": toks,
                 "trace_id": spec.get("trace_id"),
                 "tenant": spec.get("tenant") or "default",
                 "ttft_ms": 0.0, "latency_ms": 0.0}
         done.update(extra)
         return [{"token": t} for t in toks] + [done]
+
+    def _emit_wide(self, spec: dict, toks: list, extra: dict) -> None:
+        """One wide event per echoed request. Latency columns are a
+        PURE FUNCTION of the prompt (1 ms per prompt token, 1 ms ttft)
+        so a test can recompute the expected fleet percentiles offline
+        from the prompts it sent — clock reads would make the router-
+        merge assertions flaky."""
+        prompt = spec.get("prompt") or []
+        comps = extra.get("completions")
+        self.wide_events.append({
+            "trace_id": spec.get("trace_id"),
+            "t_done": time.time(),
+            "tenant": str(spec.get("tenant") or "default"),
+            "kind": str(spec.get("kind") or "generate"),
+            "replica": "echo",
+            "role": "echo",
+            "prompt_tokens": len(prompt),
+            "output_tokens": (sum(len(c) for c in comps) if comps
+                              else len(toks)),
+            "max_new_tokens": int(spec.get("max_new_tokens") or 0),
+            "forks": len(comps) if comps else 0,
+            "n": int(spec.get("n") or 1),
+            "queue_wait_s": 0.0,
+            "ttft_s": 0.001,
+            "latency_s": 0.001 * len(prompt),
+            "status": "ok",
+            "slo_verdict": "ok",
+            "stream": int(bool(toks)),
+        })
 
     def _check_kind(self, spec: dict) -> dict | None:
         """Mirror the engine's admission-time request-kind validation:
@@ -591,6 +657,7 @@ class EchoServer:
                     self.requests += 1
                     toks, extra = self._kind_result(spec,
                                                     int(prompt[0]))
+                    self._emit_wide(spec, toks, extra)
                     if toks:
                         out += wire.encode_token_frame(sid, toks)
                     done = {

@@ -75,12 +75,14 @@ from distkeras_tpu.telemetry.timeseries import (
     TimeSeriesStore,
 )
 from distkeras_tpu.telemetry.request_trace import (
+    TailRetention,
     TimelineRecord,
     TraceStore,
     merge_trace,
     new_trace_id,
     sanitize_trace_id,
 )
+from distkeras_tpu.telemetry.wide_events import merge_query_results
 
 __all__ = ["Router", "ServingCluster"]
 
@@ -463,8 +465,15 @@ class Router:
         # everything to the original protocol (the rollback knob).
         self.wire_mode = wire_mode
         self.flush_interval_s = float(flush_interval_s)
-        self.trace_store = (TraceStore(trace_capacity)
+        # Tail-based retention on the routing hops too: a dispatch that
+        # ended in replica_lost/error is exactly the record a post-
+        # mortem wants, and it must outlive the sliding window.
+        self.trace_store = (TraceStore(trace_capacity,
+                                       retention=TailRetention())
                             if trace_capacity else None)
+        # SLO page exemplars already pinned fleet-wide (dedup so each
+        # burn-rate transition's trace ids are pushed exactly once).
+        self._slo_pinned: set[str] = set()
         # A DeployController (distkeras_tpu.deploy) registers itself
         # here; the router then answers the ``deployz`` verb with its
         # state page. None = verb replies bad_request.
@@ -920,6 +929,12 @@ class Router:
                     return_exceptions=True)
                 try:
                     self.slo.evaluate()
+                    # New page transitions pin their exemplar trace ids
+                    # fleet-wide immediately — waiting for an operator's
+                    # sloz call would race the trace windows rolling.
+                    await self._pin_slo_exemplars()
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     pass  # one bad evaluation must not kill the plane
                 await asyncio.sleep(self.telemetry_interval_s)
@@ -1957,8 +1972,14 @@ class Router:
                 self.slo.evaluate()
             except Exception:
                 pass
-            return {"sloz": {**self.slo.snapshot(),
-                             "aggregation": self.telemetry_stats()}}
+            await self._pin_slo_exemplars()
+            out = {**self.slo.snapshot(),
+                   "aggregation": self.telemetry_stats()}
+            if self._slo_pinned:
+                out["pinned_exemplars"] = sorted(self._slo_pinned)
+            return {"sloz": out}
+        if cmd == "queryz":
+            return await self._queryz(spec)
         if cmd == "tracez":
             return await self._tracez(spec)
         if cmd == "reload":
@@ -1971,6 +1992,79 @@ class Router:
             return {"deployz": self.deploy_controller.deployz()}
         return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
 
+    async def _queryz(self, spec: dict) -> dict:
+        """Fleet wide-event analytics: fan one query out to every
+        routable replica's columnar store and merge the group rows.
+        Counts and sums add exactly; every percentile aggregate carries
+        its histogram state on the shared bucket layout, so the fleet
+        p99 is folded bucket-exactly through ``merge_hist_states`` —
+        the same merge the telemetry push plane trusts — never an
+        average of per-replica p99s."""
+        extra = {k: spec[k] for k in
+                 ("where", "group_by", "aggs", "max_groups") if k in spec}
+        infos = list(self.supervisor.replicas.items())
+        fetched = await asyncio.gather(*(
+            self._fetch_verb(info, "queryz", extra) for _, info in infos))
+        replicas: dict[str, dict] = {}
+        mergeable = []
+        for (rid, _info), sub in zip(infos, fetched):
+            if not isinstance(sub, dict):
+                continue
+            if "matched" in sub:
+                entry = {"matched": sub.get("matched"),
+                         "scanned": sub.get("scanned")}
+                stats = sub.get("stats")
+                if isinstance(stats, dict):
+                    entry["appended"] = stats.get("appended")
+                replicas[rid] = entry
+                mergeable.append(sub)
+            else:
+                # Unreachable / bad_request from one replica: reported
+                # per-replica, never sinking the whole fleet page.
+                replicas[rid] = sub
+        if not mergeable:
+            for sub in replicas.values():
+                if sub.get("code") == "bad_request":
+                    # A typed query error is deterministic — every
+                    # replica rejected it the same way; surface one.
+                    return {"error": sub.get("error", "bad request"),
+                            "code": "bad_request"}
+            return {"error": "no replica returned wide-event results "
+                             "(fleet empty, unreachable, or started "
+                             "without --wide-events)",
+                    "code": "unavailable", "replicas": replicas}
+        merged = merge_query_results(mergeable)
+        merged["replicas"] = replicas
+        return {"queryz": merged}
+
+    async def _pin_slo_exemplars(self) -> list[str]:
+        """Pin every SLO page-event exemplar trace id fleet-wide: into
+        the router's own store AND every routable replica's (a
+        ``tracez`` pin fan-out), so the traces a page alert references
+        stay retrievable no matter how much traffic rolls the sliding
+        windows afterwards. Idempotent per id; replica-side pins are
+        best-effort (a replica restarted later lost the engine record
+        anyway — the router's routing hop survives here)."""
+        fresh: list[str] = []
+        for ev in list(self.slo.events):
+            if ev.get("to") != "page":
+                continue
+            for tid in ev.get("exemplars") or ():
+                tid = sanitize_trace_id(tid)
+                if tid and tid not in self._slo_pinned:
+                    self._slo_pinned.add(tid)
+                    fresh.append(tid)
+        if not fresh:
+            return []
+        if self.trace_store is not None:
+            for tid in fresh:
+                self.trace_store.pin(tid)
+        infos = list(self.supervisor.replicas.items())
+        await asyncio.gather(*(
+            self._fetch_verb(info, "tracez", {"pin": fresh})
+            for _, info in infos))
+        return fresh
+
     async def _tracez(self, spec: dict) -> dict:
         """Cross-process trace assembly: the router's own routing record
         for ``trace_id`` merged with every live replica's engine
@@ -1981,6 +2075,21 @@ class Router:
         if self.trace_store is None:
             return {"error": "request tracing is not enabled on this "
                              "router", "code": "bad_request"}
+        pins = spec.get("pin")
+        if pins:
+            if isinstance(pins, str):
+                pins = [pins]
+            pinned = [t for t in (sanitize_trace_id(p) for p in pins) if t]
+            for t in pinned:
+                self.trace_store.pin(t)
+            # Forward fleet-wide: an operator pinning through the front
+            # port means "keep this everywhere its hops live".
+            infos = list(self.supervisor.replicas.items())
+            await asyncio.gather(*(
+                self._fetch_verb(info, "tracez", {"pin": pinned})
+                for _, info in infos))
+            return {"tracez": {"pinned": pinned,
+                               "stats": self.trace_store.stats()}}
         tid = spec.get("trace_id")
         if not tid:
             try:

@@ -19,7 +19,7 @@ from __future__ import annotations
 import time
 
 __all__ = ["format_debugz", "format_tracez", "format_statusz",
-           "format_deployz"]
+           "format_deployz", "format_queryz"]
 
 
 def _table(rows: list[dict], columns: list[tuple[str, str]]) -> list[str]:
@@ -228,9 +228,16 @@ def _engine_section(dz: dict, indent: str = "") -> list[str]:
             + (f" -> {fr['dump_path']}" if fr.get("dump_path") else ""))
     ts = dz.get("trace_store")
     if ts:
-        lines.append(f"{indent}trace_store: {ts.get('records')}/"
-                     f"{ts.get('capacity')} records "
-                     f"({ts.get('evicted')} evicted)")
+        ln = (f"{indent}trace_store: {ts.get('records')}/"
+              f"{ts.get('capacity')} records "
+              f"({ts.get('evicted')} evicted)")
+        if ts.get("keepers") is not None:
+            # Tail retention armed: the reservoir of records scored
+            # worth keeping past the sliding window, and the pins that
+            # can never leave it.
+            ln += (f", {ts['keepers']}/{ts.get('keeper_capacity')} keepers"
+                   f" ({ts.get('pinned', 0)} pinned)")
+        lines.append(ln)
     return lines
 
 
@@ -426,6 +433,58 @@ def format_deployz(payload: dict) -> str:
         for q in quarantined:
             lines.append(f"  v{q.get('version')}: {q.get('reason')} -> "
                          f"{q.get('quarantined_to', q.get('path'))}")
+    return "\n".join(lines)
+
+
+def _agg_cell(payload) -> str:
+    """One aggregate's display value: '-' for no data, 6 significant
+    digits otherwise (these are seconds/tokens/counts, not currency)."""
+    v = payload.get("value") if isinstance(payload, dict) else payload
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def format_queryz(payload: dict) -> str:
+    """Pretty-print a ``queryz`` result (one server's, or the router's
+    fleet-merged page): header with match counts, one fixed-width row
+    per group with its group-by key and aggregate values, then folded-
+    group and per-replica reachability notes."""
+    lines: list[str] = []
+    head = (f"queryz: matched {payload.get('matched', 0)} of "
+            f"{payload.get('scanned', 0)} events")
+    if payload.get("merged_from"):
+        head += f" (merged from {payload['merged_from']} replica(s))"
+    lines.append(head)
+    group_by = list(payload.get("group_by") or ())
+    aggs = list(payload.get("aggs") or ())
+    rows = []
+    for g in payload.get("groups", ()):
+        row = {c: g.get("key", {}).get(c, "") for c in group_by}
+        row["count"] = g.get("count")
+        for spec in aggs:
+            row[spec] = _agg_cell(g.get("aggs", {}).get(spec))
+        rows.append(row)
+    cols = ([(c, c) for c in group_by] + [("count", "count")]
+            + [(s, s) for s in aggs if s != "count"])
+    if rows:
+        for ln in _table(rows, cols):
+            lines.append(f"  {ln}")
+    else:
+        lines.append("  (no matching events)")
+    if payload.get("folded_groups"):
+        lines.append(f"  ... {payload['folded_groups']} group key(s) "
+                     f"folded into __other__ (raise --max-groups)")
+    reps = payload.get("replicas")
+    if isinstance(reps, dict):
+        bad = {rid: sub for rid, sub in reps.items()
+               if isinstance(sub, dict) and "matched" not in sub}
+        for rid in sorted(bad):
+            sub = bad[rid]
+            why = sub.get("unreachable") or sub.get("error") or "no data"
+            lines.append(f"  replica {rid}: NOT MERGED — {why}")
     return "\n".join(lines)
 
 

@@ -186,6 +186,7 @@ from distkeras_tpu.telemetry import (
     RecompileAuditor,
     TimelineRecord,
     TraceStore,
+    WideEventStore,
     span,
 )
 from distkeras_tpu.serving.constraints import TokenDFA
@@ -1085,6 +1086,7 @@ class ServingEngine:
         pipeline_depth: int = 1,
         trace_store: TraceStore | None = None,
         flight_recorder: FlightRecorder | None = None,
+        wide_events: "WideEventStore | int | None" = 4096,
         slo_s: float | None = None,
         weight_version: dict | None = None,
         tenant_weights: dict | None = None,
@@ -1880,9 +1882,28 @@ class ServingEngine:
         self.trace_source = (flight_recorder.source
                              if flight_recorder is not None
                              else f"pid:{os.getpid()}")
+        # Fleet role for wide-event attribution ("monolithic" unless a
+        # disaggregated launcher overwrites it, like trace_source).
+        self.serve_role = "monolithic"
         self.slo_s = None if slo_s is None else float(slo_s)
         self._trace_requests = (trace_store is not None
                                 or flight_recorder is not None)
+        # Wide-event analytics: one flat record per FINISHED request
+        # into a bounded columnar ring — default ON (unlike timelines)
+        # because the whole cost is one append at done-time, never
+        # per-token. An int is a capacity; 0/None disables.
+        if isinstance(wide_events, WideEventStore):
+            self.wide_events: WideEventStore | None = wide_events
+        elif wide_events:
+            self.wide_events = WideEventStore(int(wide_events))
+        else:
+            self.wide_events = None
+        if (flight_recorder is not None
+                and getattr(flight_recorder, "wide_events", None) is None):
+            # Crash dumps carry the wide-event ring tail: the requests
+            # the process served right before it died, even when no
+            # timeline store was armed.
+            flight_recorder.wide_events = self.wide_events
         if self.slo_s is not None:
             self.metrics.set_slo(self.slo_s)
 
@@ -3524,6 +3545,13 @@ class ServingEngine:
                         # queueing delay from prefill cost.
                         wait = time.monotonic() - req.t_submit
                         self.metrics.record_admit(wait)
+                        # Wide-event columns (unconditional: the done-
+                        # time record needs them with tracing off). The
+                        # FIRST admission's wait is the queue wait; a
+                        # re-admission after preemption keeps it.
+                        if req.queue_wait_s is None:
+                            req.queue_wait_s = wait
+                            req.admit_iteration = self.metrics.iterations
                         # Provenance stamp, FIRST admission only: swaps
                         # run at zero active slots and never while a
                         # preempted resume is queued, so the first stamp
@@ -4306,6 +4334,9 @@ class ServingEngine:
             # per-row admits happen at fan-out on the loop thread.
             self.metrics.record_prefill(
                 job.device_s, job.chunks_done, job.matched_tokens, s0)
+            req.prefill_device_s += job.device_s
+            req.prefill_chunks += job.chunks_done
+            req.prefix_hit_tokens = int(job.matched_tokens or 0)
             if req.trace is not None:
                 req.trace.data.update(
                     prefill_device_s=round(job.device_s, 9),
@@ -4345,6 +4376,9 @@ class ServingEngine:
             with span("draft_prefill", slot=slot, prompt_len=s0):
                 self._draft_prefill_slot(slot, tokens)
             self._spec_pos[slot] = s0
+        req.prefill_device_s += job.device_s
+        req.prefill_chunks += job.chunks_done
+        req.prefix_hit_tokens = int(job.matched_tokens or 0)
         if req.trace is not None:
             req.trace.data.update(
                 prefill_device_s=round(job.device_s, 9),
@@ -4420,6 +4454,9 @@ class ServingEngine:
         if st is not None and st.dfa is not None:
             row = st.dfa.mask_row(st.dfa_state, self._cfg.vocab_size)
             self._mask_host[i, :] = row
+            # Mask-upload attribution: this request's DFA advance is
+            # what forces the next tick's re-upload.
+            st.request.mask_uploads += 1
         else:
             self._mask_host[i, :] = 0.0
         self._mask_dirty = True
@@ -4966,6 +5003,7 @@ class ServingEngine:
         self._lens[i] = 0
         self._slot_state[i] = None
         self.metrics.record_preemption()
+        req.preemptions += 1
         if req.trace is not None:
             req.trace.event("preempt", slot=i, resident_tokens=valid,
                             streamed=len(req.out_tokens))
@@ -4993,6 +5031,9 @@ class ServingEngine:
             # would be wrong — and their shared prompt blocks are
             # refcounted, freed for real only by the LAST row.
             adopt = False
+        # Peak KV footprint for the wide event, captured before the
+        # block list is cleared (fork rows accumulate across the group).
+        req.kv_blocks = max(req.kv_blocks, len(st.blocks))
         valid = int(self._lens[i])
         if adopt and valid:
             tokens = self._resident_tokens(req)
@@ -5029,6 +5070,8 @@ class ServingEngine:
             if usable > 0:
                 st.spec_drafted += usable
                 st.spec_accepted += commit
+                req.spec_drafted += usable
+                req.spec_accepted += commit
                 self.metrics.record_spec(usable, commit,
                                          trace_id=req.trace_id)
                 if req.trace is not None:
@@ -5117,8 +5160,9 @@ class ServingEngine:
     def _finalize_trace(self, req: Request, status: str,
                         message: str | None = None) -> None:
         """Terminal bookkeeping for one request: SLO verdict (counter
-        even with tracing off) and timeline finalization into the trace
-        store / flight recorder. Cheap no-op when nothing is armed."""
+        even with tracing off), the wide-event append, and timeline
+        finalization into the trace store / flight recorder. Cheap
+        no-op when nothing is armed."""
         latency = (req.t_done - req.t_submit
                    if req.t_done is not None and req.t_submit is not None
                    else None)
@@ -5126,6 +5170,11 @@ class ServingEngine:
                 and latency > self.slo_s)
         if slow:
             self.metrics.record_slo_violation()
+        # The wide event is emitted BEFORE the trace-gated return: one
+        # flat record per finished request regardless of whether
+        # timelines are armed.
+        if self.wide_events is not None:
+            self._emit_wide_event(req, status, latency, slow)
         rec = req.trace
         if rec is None:
             return
@@ -5137,6 +5186,7 @@ class ServingEngine:
                       message=(message or "")[:200] or None)
         d = rec.data
         d["status"] = status
+        d["tenant"] = req.tenant
         d["tokens_out"] = len(req.out_tokens)
         d["prompt_tokens"] = len(req.prompt)
         if latency is not None:
@@ -5155,3 +5205,78 @@ class ServingEngine:
             self.trace_store.put(recd)
         if self.flight_recorder is not None:
             self.flight_recorder.record_timeline(recd, slow=slow)
+
+    def _emit_wide_event(self, req: Request, status: str,
+                         latency: float | None, slow: bool) -> None:
+        """Assemble and append the one canonical flat record for a
+        finished request — every column from state the engine already
+        holds (no new per-token work anywhere feeds this; the counters
+        are plain attribute writes at per-request events). Called once
+        per request from the terminal path."""
+        prov = req.weight_version or self.weight_version or {}
+        forks = 0
+        out_tokens = len(req.out_tokens)
+        if req.fork_completions is not None:
+            done_forks = [c for c in req.fork_completions if c is not None]
+            forks = len(done_forks)
+            out_tokens = sum(len(c) for c in done_forks)
+        migration = ""
+        kv_info = getattr(req, "kv_migration", None)
+        if isinstance(kv_info, dict):
+            migration = ("fallback" if kv_info.get("fallback")
+                         else "imported")
+        err_kind = ""
+        if status != "ok":
+            err_kind = (type(req.error).__name__
+                        if req.error is not None else status)
+        mesh_desc = ""
+        if self.mesh is not None:
+            mesh_desc = ",".join(f"{a}={int(s)}"
+                                 for a, s in self.mesh.shape.items())
+        record = {
+            "trace_id": req.trace_id,
+            "t_done": time.time(),
+            "tenant": req.tenant,
+            "kind": req.kind,
+            "priority": req.priority,
+            "replica": self.trace_source,
+            "role": self.serve_role,
+            "mesh": mesh_desc,
+            "pp_depth": self._pp,
+            "pp_stage": None,  # filled by per-stage launchers
+            "weight_version": prov.get("version"),
+            "weight_digest": prov.get("digest") or "",
+            "prompt_tokens": len(req.prompt),
+            "output_tokens": out_tokens,
+            "max_new_tokens": req.max_new_tokens,
+            "prefix_hit_tokens": req.prefix_hit_tokens,
+            "kv_blocks": req.kv_blocks,
+            "forks": forks,
+            "n": req.n,
+            "preemptions": req.preemptions,
+            "migration": migration,
+            "queue_wait_s": req.queue_wait_s,
+            "prefill_device_s": (req.prefill_device_s
+                                 if req.prefill_chunks else None),
+            "prefill_chunks": req.prefill_chunks,
+            "ttft_s": req.ttft,
+            "latency_s": latency,
+            "decode_iterations": (
+                self.metrics.iterations - req.admit_iteration
+                if req.admit_iteration is not None else None),
+            "spec_drafted": req.spec_drafted,
+            "spec_accepted": req.spec_accepted,
+            "spec_accept_rate": (req.spec_accepted / req.spec_drafted
+                                 if req.spec_drafted else None),
+            "mask_uploads": req.mask_uploads,
+            "constrained": int(req.constraint is not None),
+            "cache_overtaken": int(req.cache_overtaken),
+            "speculate": int(req.speculate),
+            "temperature": req.temperature,
+            "status": status,
+            "error_kind": err_kind,
+            "slo_verdict": "slow" if slow else "ok",
+            "timeout_s": req.timeout,
+            "stream": int(req.kind == "generate"),
+        }
+        self.wide_events.append(record)

@@ -272,6 +272,21 @@ class Request:
         self.t_submit: float | None = None
         self.t_first_token: float | None = None
         self.t_done: float | None = None
+        # Wide-event counters: populated UNCONDITIONALLY by the engine
+        # (plain attribute writes, never per-token) so the done-time
+        # wide event is complete even with tracing disabled — the
+        # timeline's `data` dict was trace-gated, which is exactly why
+        # these live here instead.
+        self.queue_wait_s: float | None = None
+        self.admit_iteration: int | None = None
+        self.prefill_device_s: float = 0.0
+        self.prefill_chunks: int = 0
+        self.prefix_hit_tokens: int = 0
+        self.kv_blocks: int = 0
+        self.preemptions: int = 0
+        self.spec_drafted: int = 0
+        self.spec_accepted: int = 0
+        self.mask_uploads: int = 0
 
     def cancel(self) -> None:
         """Abandon the request: the engine frees its slot (or drops it

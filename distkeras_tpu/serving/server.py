@@ -815,6 +815,8 @@ class ServingServer:
             return {"debugz": self.engine.debugz()}
         if cmd == "tracez":
             return self._tracez(spec)
+        if cmd == "queryz":
+            return self._queryz(spec)
         if cmd == "metricsz":
             registry = self.engine.metrics.registry
             # Memory and tenant gauges are refreshed per scrape (a
@@ -898,8 +900,37 @@ class ServingServer:
                 health["slo_violations"] = engine.metrics.slo_violations
             if engine.flight_recorder is not None:
                 health["flight_recorder"] = engine.flight_recorder.stats()
+            if engine.wide_events is not None:
+                health["wide_events"] = engine.wide_events.stats()
+            if engine.trace_store is not None:
+                health["trace_store"] = engine.trace_store.stats()
             return {"healthz": health}
         return {"error": f"unknown cmd {cmd!r}", "code": "bad_request"}
+
+    def _queryz(self, spec: dict) -> dict:
+        """``{"cmd": "queryz", "where": [...], "group_by": [...],
+        "aggs": [...]}``: run one filter/group/aggregate pass over this
+        replica's wide-event ring. The reply's percentile payloads
+        carry mergeable histogram states — the router fans this verb
+        out and folds the group rows bucket-exactly. A parse error
+        (unknown column, bad op, >2 group columns) comes back as a
+        typed ``bad_request``, never a silent empty result."""
+        store = self.engine.wide_events
+        if store is None:
+            return {"error": "wide-event analytics is disabled on this "
+                             "server (wide_events=0)",
+                    "code": "bad_request"}
+        try:
+            kw = {}
+            if spec.get("max_groups") is not None:
+                kw["max_groups"] = int(spec["max_groups"])
+            result = store.query(where=spec.get("where"),
+                                 group_by=spec.get("group_by"),
+                                 aggs=spec.get("aggs"), **kw)
+        except (TypeError, ValueError) as e:
+            return {"error": str(e), "code": "bad_request"}
+        result["stats"] = store.stats()
+        return {"queryz": result}
 
     def _tracez(self, spec: dict) -> dict:
         """``{"cmd": "tracez", "trace_id": ...}``: this engine's timeline
@@ -911,6 +942,17 @@ class ServingServer:
             return {"error": "request tracing is not enabled on this "
                              "server (no trace store)",
                     "code": "bad_request"}
+        if spec.get("pin"):
+            # SLO page-event exemplar protection: mark ids never-
+            # evictable (present or not — pin-before-arrival covers
+            # requests another hop finishes later).
+            pins = spec["pin"]
+            if not isinstance(pins, (list, tuple)):
+                pins = [pins]
+            pinned = [str(t) for t in pins if store.pin(str(t))]
+            # "stats" nests the store counters: its own "pinned" COUNT
+            # must not clobber the list of ids just pinned.
+            return {"tracez": {"pinned": pinned, "stats": store.stats()}}
         tid = spec.get("trace_id")
         if tid:
             return {"tracez": {"trace_id": str(tid),

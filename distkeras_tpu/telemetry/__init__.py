@@ -28,6 +28,10 @@ layer both sides publish into. Four pillars:
   propagated across the serving cluster's processes, per-hop timeline
   records, bounded stores behind the ``tracez`` control verb, and
   one-lane-per-request Chrome export;
+- **wide events** (:mod:`.wide_events`) — one canonical flat record
+  per finished request in a bounded columnar ring, with a filter /
+  group-by / aggregate query engine behind the ``queryz`` verb whose
+  percentile aggregates merge bucket-exactly across the fleet;
 - **flight recorder** (:mod:`.flight_recorder`) — bounded overwrite
   rings of recent state transitions + request timelines, dumped as a
   replica's "last words" on crash and mined for slow-request exemplars;
@@ -77,11 +81,16 @@ from distkeras_tpu.telemetry.exposition import (
     write_snapshot_jsonl,
 )
 from distkeras_tpu.telemetry.request_trace import (
+    TailRetention,
     TimelineRecord,
     TraceStore,
     chrome_trace,
     merge_trace,
     new_trace_id,
+)
+from distkeras_tpu.telemetry.wide_events import (
+    WideEventStore,
+    merge_query_results,
 )
 from distkeras_tpu.telemetry.flight_recorder import (
     FlightRecorder,
@@ -127,7 +136,10 @@ __all__ = [
     "write_snapshot_jsonl",
     "new_trace_id",
     "TimelineRecord",
+    "TailRetention",
     "TraceStore",
+    "WideEventStore",
+    "merge_query_results",
     "merge_trace",
     "chrome_trace",
     "FlightRecorder",

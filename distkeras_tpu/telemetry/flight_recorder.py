@@ -75,11 +75,17 @@ class FlightRecorder:
     with no explicit path — the replica's "last words" location the
     supervisor knows to look at.
     ``source``: process identity stamped into dumps (replica id, pid).
+    ``wide_events``: optional
+    :class:`~distkeras_tpu.telemetry.wide_events.WideEventStore` whose
+    ring TAIL rides along in every dump — the flat per-request facts of
+    the last requests served before death, available even when no
+    timeline store was armed (the engine attaches its store here).
     """
 
     def __init__(self, capacity: int = 256, *, timeline_capacity: int = 128,
                  slow_capacity: int = 32, dump_path: str | None = None,
-                 source: str = ""):
+                 source: str = "", wide_events=None,
+                 wide_tail: int = 64):
         self._lock = threading.Lock()
         self._events = _Ring(capacity)
         self._timelines = _Ring(timeline_capacity)
@@ -87,6 +93,8 @@ class FlightRecorder:
         self.dump_path = dump_path
         self.source = source or f"pid:{os.getpid()}"
         self.dumps_written = 0
+        self.wide_events = wide_events
+        self.wide_tail = int(wide_tail)
 
     # -- recording -----------------------------------------------------------
     def record_event(self, kind: str, **fields) -> None:
@@ -124,7 +132,7 @@ class FlightRecorder:
 
     def dump_dict(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "source": self.source,
                 "dumped_at": time.time(),
                 "events": [
@@ -137,6 +145,18 @@ class FlightRecorder:
                 "events_recorded": self._events.count,
                 "timelines_recorded": self._timelines.count,
             }
+        # Outside the recorder lock: the store has its own (and a
+        # wedged store must not deadlock a crash dump against an
+        # appending engine thread).
+        if self.wide_events is not None:
+            try:
+                out["wide_events_tail"] = self.wide_events.tail(
+                    self.wide_tail)
+                out["wide_events_stats"] = self.wide_events.stats()
+            except Exception:
+                # Last-words writes are best-effort end to end.
+                pass
+        return out
 
     # -- dumping -------------------------------------------------------------
     def dump(self, path: str | None = None) -> str:

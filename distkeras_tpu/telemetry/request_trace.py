@@ -53,6 +53,7 @@ __all__ = [
     "new_trace_id",
     "sanitize_trace_id",
     "TimelineRecord",
+    "TailRetention",
     "TraceStore",
     "merge_trace",
     "chrome_trace",
@@ -117,6 +118,108 @@ class TimelineRecord:
         }
 
 
+class TailRetention:
+    """Done-time keep/discard scorer for finished timelines.
+
+    A bounded trace ring under hot traffic evicts exactly the records an
+    operator wants: the errors, the SLO breaches, the latency tail. This
+    scorer decides AT COMPLETION — when a request's whole story is known
+    — whether its timeline is worth holding past the sliding window,
+    returning a keep reason or ``None`` (bulk discard, dropped early):
+
+    - ``"error"`` — terminal status other than ok: always kept;
+    - ``"slo"`` — the engine's SLO verdict said slow: always kept;
+    - ``"tail"`` — latency at/above the running ``tail_q`` percentile
+      of ITS KIND (per-kind, so a batch scoring job's normal minutes
+      don't drown interactive sampling's abnormal seconds), after a
+      ``warmup`` of samples for that kind;
+    - ``"rare"`` — one of the first ``rare_below`` completions for its
+      (tenant, kind) pair: a new tenant's first requests are kept even
+      when perfectly healthy, because "what did it look like when it
+      started" is exactly what gets asked later;
+    - ``"baseline"`` — a deterministic 1-in-``baseline_every`` counter
+      sample of healthy traffic (a counter, not an RNG, so tests and
+      replays see the same keeps).
+
+    Reasons are priority-ordered (:data:`REASON_PRIORITY`, lower keeps
+    longer) for the keeper reservoir's eviction; latency tracking uses
+    the same fixed histogram layout the wide-event store queries with,
+    so "the tail" here and a ``queryz`` p-tail agree bucket-for-bucket.
+    """
+
+    #: Eviction order within a full keeper reservoir: higher numbers
+    #: evict first. ``pinned`` is assigned by the store, never here.
+    REASON_PRIORITY = {"pinned": 0, "error": 1, "slo": 2, "tail": 3,
+                       "rare": 4, "baseline": 5}
+
+    def __init__(self, tail_q: float = 90.0, warmup: int = 20,
+                 rare_below: int = 3, baseline_every: int = 32):
+        if not 0.0 < tail_q < 100.0:
+            raise ValueError(f"tail_q must be in (0, 100), got {tail_q}")
+        self.tail_q = float(tail_q)
+        self.warmup = max(1, int(warmup))
+        self.rare_below = max(0, int(rare_below))
+        self.baseline_every = max(1, int(baseline_every))
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._pair_counts: dict[tuple, int] = {}
+        self._kind_hists: dict[str, object] = {}
+
+    def _kind_hist(self, kind: str):
+        h = self._kind_hists.get(kind)
+        if h is None:
+            # Deferred import: registry is dependency-free but this
+            # module is imported by crash tooling that wants the
+            # cheapest possible import graph.
+            from distkeras_tpu.telemetry.registry import Histogram
+            from distkeras_tpu.telemetry.wide_events import (
+                WIDE_HIST_BUCKETS)
+            h = Histogram("trace_retention_latency",
+                          buckets=WIDE_HIST_BUCKETS, labels={"kind": kind})
+            self._kind_hists[kind] = h
+        return h
+
+    def score(self, rec: dict) -> str | None:
+        """Keep reason for one finished record dict (reads its ``data``
+        summary: status / slo_violation / latency_s / tenant / kind),
+        or None. Also feeds the running per-kind latency and rarity
+        state — call exactly once per finished record."""
+        data = rec.get("data") or {}
+        kind = str(data.get("kind", ""))
+        tenant = str(data.get("tenant", ""))
+        latency = data.get("latency_s")
+        with self._lock:
+            self._seen += 1
+            baseline = (self._seen % self.baseline_every) == 0
+            pair = (tenant, kind)
+            pair_n = self._pair_counts.get(pair, 0) + 1
+            self._pair_counts[pair] = pair_n
+            tail = False
+            if latency is not None:
+                h = self._kind_hist(kind)
+                if h.count >= self.warmup:
+                    tail = float(latency) >= h.percentile(self.tail_q)
+                h.observe(float(latency),
+                          exemplar=rec.get("trace_id"))
+        if str(data.get("status", "ok")) != "ok":
+            return "error"
+        if data.get("slo_violation"):
+            return "slo"
+        if tail:
+            return "tail"
+        if pair_n <= self.rare_below:
+            return "rare"
+        if baseline:
+            return "baseline"
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"seen": self._seen,
+                    "tenant_kind_pairs": len(self._pair_counts),
+                    "kinds": sorted(self._kind_hists)}
+
+
 class TraceStore:
     """Bounded per-process store of finished timeline records.
 
@@ -126,15 +229,38 @@ class TraceStore:
     ``max_events`` bounds against). Stores plain dicts so ``get`` replies
     are JSON-ready for the ``tracez`` verb. Thread-safe: the engine loop
     finalizes records while control handlers read them.
+
+    With a :class:`TailRetention` attached, blind overwrite stops being
+    the only policy: every finished record is scored at put-time, and
+    keepers (errors, SLO breaches, latency tail, rare tenants/kinds, a
+    1/N baseline) survive in a separate bounded reservoir after the
+    sliding window has rolled past them — evicted keeper-priority-then-
+    oldest when the reservoir fills. :meth:`pin` marks trace ids (SLO
+    page-event exemplars) that must NEVER be evicted: a page alert's
+    linked traces stay retrievable for as long as the process lives,
+    regardless of traffic volume.
     """
 
-    def __init__(self, capacity: int = 512):
+    def __init__(self, capacity: int = 512,
+                 retention: TailRetention | None = None,
+                 keeper_capacity: int = 256):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if keeper_capacity < 1:
+            raise ValueError(
+                f"keeper_capacity must be >= 1, got {keeper_capacity}")
         self.capacity = int(capacity)
+        self.retention = retention
+        self.keeper_capacity = int(keeper_capacity)
         self._lock = threading.Lock()
         self._records: OrderedDict[str, dict] = OrderedDict()
+        # key -> (record, reason); insertion-ordered so eviction can
+        # take "oldest of the worst reason" deterministically.
+        self._keepers: OrderedDict[str, tuple] = OrderedDict()
+        self._pinned: set[str] = set()
         self.evicted = 0
+        self.kept = 0
+        self.keeper_evicted = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -145,19 +271,76 @@ class TraceStore:
         tid = rec.get("trace_id")
         if not tid:
             return
+        reason = (self.retention.score(rec)
+                  if self.retention is not None else None)
         with self._lock:
             # A retried request revisits one trace_id on a second hop of
             # the SAME store only in single-process (LocalReplica) tests;
             # keep hops distinguishable by source-suffixing duplicates.
             key = tid
             n = 1
-            while key in self._records:
+            while key in self._records or key in self._keepers:
                 key = f"{tid}#{n}"
                 n += 1
+            if tid in self._pinned:
+                reason = "pinned"
+            if reason is not None:
+                self._keepers[key] = (rec, reason)
+                self.kept += 1
+                self._evict_keepers_locked()
             self._records[key] = rec
             while len(self._records) > self.capacity:
                 self._records.popitem(last=False)
                 self.evicted += 1
+
+    def _evict_keepers_locked(self) -> None:
+        """Shrink the keeper reservoir to capacity: pinned entries are
+        exempt; among the rest, drop the oldest record of the WORST
+        (highest-numbered) reason present."""
+        prio = TailRetention.REASON_PRIORITY
+        while True:
+            unpinned = [(key, reason)
+                        for key, (rec, reason) in self._keepers.items()
+                        if reason != "pinned"]
+            if len(unpinned) <= self.keeper_capacity:
+                return
+            worst = max(prio.get(r, 99) for _, r in unpinned)
+            for key, reason in unpinned:  # insertion order = oldest first
+                if prio.get(reason, 99) == worst:
+                    del self._keepers[key]
+                    self.keeper_evicted += 1
+                    break
+
+    def pin(self, trace_id: str) -> bool:
+        """Mark ``trace_id`` never-evictable (SLO page exemplars). Any
+        hop records currently in the sliding window are promoted into
+        the keeper reservoir immediately — pinning after the fact would
+        otherwise race the window rolling past them. Future puts of the
+        id are kept as pinned too. True when the id is now pinned (it
+        need not be present yet: pin-before-arrival is how the router
+        protects exemplars of requests other replicas served)."""
+        tid = sanitize_trace_id(trace_id)
+        if not tid:
+            return False
+        with self._lock:
+            self._pinned.add(tid)
+            for key, rec in self._records.items():
+                if key == tid or key.startswith(f"{tid}#"):
+                    cur = self._keepers.get(key)
+                    self._keepers[key] = (rec, "pinned")
+                    if cur is None:
+                        self.kept += 1
+            # A record already held as a keeper (e.g. as "tail") but
+            # rolled out of the window upgrades in place.
+            for key, (rec, reason) in list(self._keepers.items()):
+                if reason != "pinned" and (
+                        key == tid or key.startswith(f"{tid}#")):
+                    self._keepers[key] = (rec, "pinned")
+        return True
+
+    def pinned(self) -> list[str]:
+        with self._lock:
+            return sorted(self._pinned)
 
     def get(self, trace_id: str) -> dict | None:
         """The record for ``trace_id`` (the FIRST hop when duplicated);
@@ -167,8 +350,17 @@ class TraceStore:
 
     def get_all(self, trace_id: str) -> list[dict]:
         with self._lock:
-            return [rec for key, rec in self._records.items()
-                    if key == trace_id or key.startswith(f"{trace_id}#")]
+            out, seen = [], set()
+            for key, rec in self._records.items():
+                if key == trace_id or key.startswith(f"{trace_id}#"):
+                    out.append(rec)
+                    seen.add(key)
+            for key, (rec, _reason) in self._keepers.items():
+                if key in seen:
+                    continue
+                if key == trace_id or key.startswith(f"{trace_id}#"):
+                    out.append(rec)
+            return out
 
     def recent(self, n: int = 20) -> list[dict]:
         n = int(n)
@@ -178,10 +370,37 @@ class TraceStore:
             recs = list(self._records.values())
         return recs[-n:]
 
+    def keepers(self, n: int | None = None, reason: str | None = None) \
+            -> list[dict]:
+        """Keeper-reservoir records (newest last), each annotated with
+        its ``keep_reason``; optionally only one reason class."""
+        with self._lock:
+            out = []
+            for rec, r in self._keepers.values():
+                if reason is not None and r != reason:
+                    continue
+                annotated = dict(rec)
+                annotated["keep_reason"] = r
+                out.append(annotated)
+        return out[-int(n):] if n else out
+
     def stats(self) -> dict:
         with self._lock:
-            return {"records": len(self._records),
-                    "capacity": self.capacity, "evicted": self.evicted}
+            by_reason: dict[str, int] = {}
+            for _rec, r in self._keepers.values():
+                by_reason[r] = by_reason.get(r, 0) + 1
+            out = {"records": len(self._records),
+                   "capacity": self.capacity, "evicted": self.evicted}
+            if self.retention is not None or self._keepers or self._pinned:
+                out.update({
+                    "keepers": len(self._keepers),
+                    "keeper_capacity": self.keeper_capacity,
+                    "keeper_evicted": self.keeper_evicted,
+                    "kept": self.kept,
+                    "pinned": len(self._pinned),
+                    "keep_reasons": by_reason,
+                })
+            return out
 
     def export_chrome_trace(self, path: str, n: int | None = None) -> str:
         """Write the store's (most recent ``n``) records as Chrome-trace

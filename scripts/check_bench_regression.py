@@ -108,7 +108,12 @@ _LOWER_IS_BETTER = ("ttft", "inter_token", "itl", "prefill_device",
                     # pays over a plain generate of the same shape —
                     # both regress UP; the per-kind goodput rows
                     # regress DOWN (higher-is-better by default).
-                    "mask_upload", "fork_overhead")
+                    "mask_upload", "fork_overhead",
+                    # Wide-event rows (serving/widevents_*): the
+                    # done-time append tax (as ns/event and as % of the
+                    # serving wall) and the full-ring queryz scan
+                    # latency all regress UP.
+                    "append_overhead", "append_ns", "query_latency")
 
 
 def lower_is_better(key: str) -> bool:

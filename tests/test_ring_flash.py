@@ -195,6 +195,20 @@ def test_striped_jnp_ring_matches_dense_causal(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-3, rtol=2e-3)
 
+def test_ring_attention_direct_call_rejects_noncausal_stripe():
+    """The shard_map-level ring_attention (ops/attention.py) validates
+    stripe=True + causal=False at function entry — before any mesh-axis
+    lookup — so a direct SPMD caller gets a loud contract error instead
+    of contiguous causal semantics silently applied to striped inputs.
+    Callable with plain arrays precisely because the check fires before
+    lax.axis_index would demand a real named axis."""
+    from distkeras_tpu.ops.attention import ring_attention
+
+    q = k = v = np.zeros((1, 4, 1, 4), np.float32)
+    with pytest.raises(ValueError, match="causal"):
+        ring_attention(q, k, v, axis_name="sp", causal=False, stripe=True)
+
+
 def test_ring_stripe_rejections():
     """Loud failures for the striped layout's contract edges: non-causal
     stripe, and sequence parallelism inside the pipelined trunk (where the

@@ -1,0 +1,673 @@
+"""Wide-event request analytics: columnar ring, queryz, tail retention.
+
+The observability tentpole under test, layer by layer:
+
+- **store**: one flat ~40-column record per finished request in a
+  columnar overwrite ring — typed null sentinels, interned strings,
+  unknown columns rejected loudly, oldest-first overwrite;
+- **query engine**: one-scan filter / group_by (<=2, cardinality
+  capped into ``__other__``) / aggs (count - sum - mean - pX) whose
+  results match an offline recompute, and whose pX payloads carry
+  mergeable histogram states on the ONE shared bucket layout;
+- **fleet merge**: ``merge_query_results`` over per-replica results
+  equals ONE pooled store holding every event — counts and sums exact,
+  percentiles bucket-exact — and the router's ``queryz`` fan-out over a
+  jax-free Echo fleet reproduces that equality over real TCP (Echo
+  latencies are a pure function of the prompt, so the expected fleet
+  percentiles are recomputable offline);
+- **tail retention**: an overwrite-pressure flood keeps 100% of error
+  records and SLO-page-exemplar pins retrievable (the acceptance
+  criterion), pin-before-arrival protects ids the router learns about
+  before the replica finishes, and the router pins page-event
+  exemplars fleet-wide;
+- **engine**: every finished request emits exactly one wide event at
+  done-time, the ``queryz`` verb answers over the wire, and the ARMED
+  RecompileAuditor proves the analytics plane never touches the
+  compiled decode step;
+- **surfaces**: flight-recorder dumps embed the ring tail;
+  ``format_queryz`` / ``run.py queryz`` render the fleet page.
+"""
+
+import asyncio
+import bisect
+import contextlib
+import io
+import json
+import threading
+
+import pytest
+
+from distkeras_tpu.telemetry.request_trace import (
+    TailRetention,
+    TraceStore,
+    new_trace_id,
+)
+from distkeras_tpu.telemetry.wide_events import (
+    WIDE_HIST_BUCKETS,
+    WideEventStore,
+    merge_query_results,
+    parse_aggs,
+    parse_where,
+)
+
+SUP = dict(health_interval_s=0.05, health_timeout_s=2.0, fail_after=2,
+           base_delay_s=0.05, max_delay_s=1.0, stable_after_s=0.5)
+
+
+def _bucket_width_ok(value: float, truth: float) -> bool:
+    """True when ``value`` is within one WIDE_HIST_BUCKETS bucket of
+    ``truth`` — the documented fleet-percentile error bound."""
+    i = bisect.bisect_left(WIDE_HIST_BUCKETS, truth)
+    lo = WIDE_HIST_BUCKETS[max(0, i - 1)]
+    hi = WIDE_HIST_BUCKETS[min(len(WIDE_HIST_BUCKETS) - 1, i + 1)]
+    return lo <= value <= hi
+
+
+def _percentile(values, q: float) -> float:
+    """Nearest-rank percentile — the offline ground truth."""
+    vals = sorted(values)
+    idx = max(0, min(len(vals) - 1,
+                     int(round(q / 100.0 * (len(vals) - 1)))))
+    return vals[idx]
+
+
+# -- columnar store -----------------------------------------------------------
+
+def test_ring_overwrite_null_sentinels_and_unknown_column():
+    store = WideEventStore(capacity=4)
+    for i in range(6):
+        store.append({"trace_id": f"t{i}", "tenant": f"ten{i}",
+                      "prompt_tokens": i, "latency_s": 0.1 * (i + 1)})
+    assert len(store) == 4
+    st = store.stats()
+    assert st["appended"] == 6 and st["rows"] == 4
+    assert st["overwritten"] == 2
+    assert st["append_ns_total"] > 0 and st["append_ns_mean"] > 0
+
+    tail = store.tail(10)
+    # Oldest two rows were overwritten; newest last.
+    assert [r["trace_id"] for r in tail] == ["t2", "t3", "t4", "t5"]
+    # Null sentinels: unset columns are OMITTED from the export, not
+    # emitted as None/-1/NaN/"".
+    row = tail[-1]
+    assert row["prompt_tokens"] == 5
+    assert "output_tokens" not in row and "ttft_s" not in row
+    assert "kind" not in row
+
+    with pytest.raises(ValueError, match="unknown wide-event col"):
+        store.append({"trace_id": "x", "latency_ms": 5})
+    with pytest.raises(ValueError, match="capacity"):
+        WideEventStore(capacity=0)
+
+
+def test_query_matches_offline_recompute():
+    store = WideEventStore(capacity=256)
+    rows = []
+    for i in range(60):
+        row = {"trace_id": f"t{i}",
+               "tenant": "alpha" if i % 3 else "beta",
+               "kind": "sample" if i % 2 else "score",
+               "prompt_tokens": i,
+               "ttft_s": 0.002 * (i + 1),
+               "latency_s": 0.01 * (i + 1)}
+        rows.append(row)
+        store.append(row)
+
+    out = store.query(where=["kind=sample", "prompt_tokens>=10"],
+                      group_by=["tenant"],
+                      aggs=["count", "sum:prompt_tokens",
+                            "mean:latency_s", "p50:ttft_s"])
+    want = [r for r in rows
+            if r["kind"] == "sample" and r["prompt_tokens"] >= 10]
+    assert out["matched"] == len(want) and out["scanned"] == 60
+    assert out["aggs"] == ["count", "sum:prompt_tokens",
+                           "mean:latency_s", "p50:ttft_s"]
+    by_tenant = {g["key"]["tenant"]: g for g in out["groups"]}
+    assert set(by_tenant) == {"alpha", "beta"}
+    for tenant, g in by_tenant.items():
+        sub = [r for r in want if r["tenant"] == tenant]
+        assert g["count"] == len(sub)
+        assert g["aggs"]["count"]["value"] == len(sub)
+        assert g["aggs"]["sum:prompt_tokens"]["value"] == pytest.approx(
+            sum(r["prompt_tokens"] for r in sub))
+        assert g["aggs"]["mean:latency_s"]["value"] == pytest.approx(
+            sum(r["latency_s"] for r in sub) / len(sub))
+        p50 = g["aggs"]["p50:ttft_s"]
+        truth = _percentile([r["ttft_s"] for r in sub], 50)
+        assert _bucket_width_ok(p50["value"], truth), (p50["value"], truth)
+        # The mergeable part rides along: a histogram state on the
+        # shared layout, with the quantile it answers.
+        assert p50["q"] == 50 and p50["state"]["count"] == len(sub)
+
+    # No group_by: one ALL group; default agg is count.
+    allq = store.query(where=["tenant=beta"])
+    assert allq["groups"][0]["key"] == {}
+    assert allq["groups"][0]["count"] == sum(
+        1 for r in rows if r["tenant"] == "beta")
+
+
+def test_query_cardinality_cap_folds_other():
+    store = WideEventStore(capacity=256)
+    for i in range(40):
+        store.append({"trace_id": f"t{i}", "tenant": f"ten{i % 10}",
+                      "latency_s": 0.1})
+    out = store.query(group_by=["tenant"], aggs=["count"], max_groups=4)
+    keys = [g["key"]["tenant"] for g in out["groups"]]
+    assert "__other__" in keys
+    assert len(keys) == 5  # 4 real + the fold bucket
+    assert out["folded_groups"] == 6
+    # Nothing dropped: counts are conserved across the fold.
+    assert sum(g["count"] for g in out["groups"]) == 40
+    assert out["matched"] == 40
+
+
+def test_query_and_parse_typed_errors():
+    store = WideEventStore(capacity=8)
+    store.append({"trace_id": "t", "tenant": "a", "latency_s": 0.1})
+    with pytest.raises(ValueError, match="capped at 2"):
+        store.query(group_by=["tenant", "kind", "replica"])
+    with pytest.raises(ValueError, match="unknown column"):
+        store.query(group_by=["tennant"])
+    with pytest.raises(ValueError, match="float column"):
+        store.query(group_by=["latency_s"])
+    with pytest.raises(ValueError, match="numeric column"):
+        store.query(aggs=["p99:tenant"])
+    with pytest.raises(ValueError, match="unknown aggregate"):
+        store.query(aggs=["median:latency_s"])
+    with pytest.raises(ValueError, match="percentile out of range"):
+        parse_aggs(["p105:latency_s"])
+    with pytest.raises(ValueError, match="malformed where"):
+        parse_where(["tenant"])
+    with pytest.raises(ValueError, match="unknown column"):
+        parse_where(["nope=1"])
+    with pytest.raises(ValueError, match="needs a numeric column"):
+        parse_where(["tenant>5"])
+    with pytest.raises(ValueError, match="numeric"):
+        parse_where(["latency_s=fast"])
+    with pytest.raises(ValueError, match="max_groups"):
+        store.query(max_groups=0)
+
+
+# -- fleet merge --------------------------------------------------------------
+
+def _synthetic_rows(n, replica):
+    return [{"trace_id": f"{replica}-{i}", "tenant": f"ten{i % 3}",
+             "kind": "sample", "replica": replica,
+             "prompt_tokens": 3 + i,
+             "ttft_s": 0.001 * (i + 1) * (2 if replica == "r1" else 1),
+             "latency_s": 0.005 * (i + 1)}
+            for i in range(n)]
+
+
+def test_merge_equals_pooled_single_store():
+    """THE fleet invariant: merging per-replica query results equals one
+    store holding every replica's events — counts/sums exact, pX
+    payloads bucket-exact (identical, both live on WIDE_HIST_BUCKETS)."""
+    spec = dict(where=["kind=sample"], group_by=["tenant"],
+                aggs=["count", "sum:prompt_tokens", "mean:latency_s",
+                      "p99:ttft_s"])
+    pooled = WideEventStore(capacity=512)
+    results = []
+    for replica, n in (("r0", 17), ("r1", 29), ("r2", 5)):
+        store = WideEventStore(capacity=64)
+        for row in _synthetic_rows(n, replica):
+            store.append(row)
+            pooled.append(row)
+        results.append(store.query(**spec))
+
+    merged = merge_query_results(results)
+    truth = pooled.query(**spec)
+    assert merged["merged_from"] == 3
+    assert merged["matched"] == truth["matched"] == 17 + 29 + 5
+    t_groups = {g["key"]["tenant"]: g for g in truth["groups"]}
+    m_groups = {g["key"]["tenant"]: g for g in merged["groups"]}
+    assert set(m_groups) == set(t_groups)
+    for tenant, tg in t_groups.items():
+        mg = m_groups[tenant]
+        assert mg["count"] == tg["count"]
+        assert mg["aggs"]["count"]["value"] == tg["aggs"]["count"]["value"]
+        assert mg["aggs"]["sum:prompt_tokens"]["value"] == pytest.approx(
+            tg["aggs"]["sum:prompt_tokens"]["value"])
+        assert mg["aggs"]["mean:latency_s"]["value"] == pytest.approx(
+            tg["aggs"]["mean:latency_s"]["value"])
+        # Bucket-exact: the merged histogram state IS the pooled state,
+        # so the recomputed percentile is equal, not just close.
+        assert mg["aggs"]["p99:ttft_s"]["value"] == pytest.approx(
+            tg["aggs"]["p99:ttft_s"]["value"])
+        assert (mg["aggs"]["p99:ttft_s"]["state"]["counts"]
+                == tg["aggs"]["p99:ttft_s"]["state"]["counts"])
+    # Merging never mutates the inputs (the router logs them too).
+    assert results[0]["groups"][0]["count"] != merged["groups"][0]["count"]
+
+
+def test_merge_shape_mismatch_and_empty_raise():
+    store = WideEventStore(capacity=8)
+    store.append({"trace_id": "t", "tenant": "a", "ttft_s": 0.1})
+    a = store.query(group_by=["tenant"], aggs=["count"])
+    b = store.query(group_by=["kind"], aggs=["count"])
+    with pytest.raises(ValueError, match="different shape"):
+        merge_query_results([a, b])
+    with pytest.raises(ValueError, match="zero"):
+        merge_query_results([])
+    # None entries (unreachable replicas) are skipped, not fatal.
+    m = merge_query_results([a, None, a])
+    assert m["merged_from"] == 2 and m["matched"] == 2
+
+
+# -- tail-based retention -----------------------------------------------------
+
+def _finished(tid, status="ok", latency=0.01, tenant="bulk",
+              kind="generate", slo=False):
+    data = {"status": status, "latency_s": latency, "tenant": tenant,
+            "kind": kind}
+    if slo:
+        data["slo_violation"] = True
+    return {"trace_id": tid, "role": "engine", "source": "r0",
+            "t_start": 0.0, "events": [], "data": data}
+
+
+def test_flood_keeps_all_errors_and_pinned_exemplars():
+    """The acceptance criterion: a tiny window under a 50x overwrite
+    flood keeps EVERY error record and EVERY SLO-page-exemplar pin
+    retrievable, while bulk-healthy traffic is (mostly) discarded."""
+    store = TraceStore(capacity=16, retention=TailRetention(warmup=10),
+                       keeper_capacity=64)
+    errors = [new_trace_id() for _ in range(8)]
+    exemplars = [new_trace_id() for _ in range(3)]
+    for tid in exemplars:
+        store.put(_finished(tid, slo=True))
+        store.pin(tid)
+    flood = 0
+    for i in range(800):
+        store.put(_finished(f"bulk{i}"))
+        flood += 1
+        if i % 100 == 50:
+            store.put(_finished(errors[i // 100], status="error",
+                                latency=0.5))
+    # Window long gone: 800 healthy puts through a 16-slot ring.
+    assert store.evicted > 700
+    for tid in errors:
+        hops = store.get_all(tid)
+        assert hops, f"error trace {tid} lost under flood"
+        assert hops[0]["data"]["status"] == "error"
+    for tid in exemplars:
+        assert store.get_all(tid), f"pinned exemplar {tid} lost"
+    st = store.stats()
+    assert st["pinned"] == 3
+    assert st["keep_reasons"]["pinned"] == 3
+    assert st["keep_reasons"]["error"] == 8
+    # The keeper reservoir stayed bounded while doing it.
+    assert st["keepers"] <= 64 + 3
+    got = {r["trace_id"] for r in store.keepers(reason="error")}
+    assert got == set(errors)
+
+
+def test_pin_before_arrival_and_keeper_upgrade():
+    store = TraceStore(capacity=4, retention=TailRetention(warmup=5),
+                       keeper_capacity=8)
+    # Pin-before-arrival: the router pins an exemplar id for a request
+    # some replica is still serving.
+    assert store.pin("feedbeef00000001")
+    store.put(_finished("feedbeef00000001"))
+    for i in range(20):
+        store.put(_finished(f"x{i}"))
+    hops = store.get_all("feedbeef00000001")
+    assert hops and store.stats()["keep_reasons"]["pinned"] >= 1
+
+    # Upgrade-in-place: a record already kept (as an error) becomes
+    # pinned, and survives keeper eviction pressure afterwards.
+    store2 = TraceStore(capacity=4, retention=TailRetention(warmup=5),
+                        keeper_capacity=2)
+    store2.put(_finished("err1", status="error"))
+    store2.pin("err1")
+    for i in range(30):
+        store2.put(_finished(f"e{i}", status="error"))
+    assert store2.get_all("err1"), "pinned upgrade evicted"
+    assert store2.stats()["keep_reasons"]["pinned"] == 1
+    # Bad ids don't pin.
+    assert not store2.pin("")
+    assert not store2.pin(None)
+
+
+def test_retention_scoring_reasons():
+    ret = TailRetention(tail_q=90.0, warmup=10, rare_below=2,
+                        baseline_every=7)
+    assert ret.score(_finished("a", status="timeout")) == "error"
+    assert ret.score(_finished("b", slo=True)) == "slo"
+    # First completions of a NEW (tenant, kind) pair are rare-kept.
+    assert ret.score(_finished("c", tenant="newbie")) == "rare"
+    assert ret.score(_finished("d", tenant="newbie")) == "rare"
+    assert ret.score(_finished("e", tenant="newbie")) is None
+    # Warm the per-kind latency histogram with healthy 10ms traffic,
+    # then a 10x outlier scores as tail.
+    for i in range(20):
+        ret.score(_finished(f"w{i}", tenant="bulk2", latency=0.01))
+    assert ret.score(_finished("slow", tenant="bulk2",
+                               latency=0.5)) == "tail"
+    # The deterministic 1-in-N counter baseline fires eventually.
+    # Latency-free records (score/embed style) cannot score as tail, so
+    # a fresh pair's keeps are exactly rare x2 then the 1-in-7 counter.
+    reasons = [ret.score(_finished(f"h{i}", tenant="bulkz",
+                                   latency=None)) for i in range(14)]
+    assert "baseline" in reasons
+    assert ret.stats()["seen"] > 30
+    with pytest.raises(ValueError, match="tail_q"):
+        TailRetention(tail_q=100.0)
+
+
+def test_flight_dump_carries_wide_event_tail(tmp_path):
+    from distkeras_tpu.telemetry import FlightRecorder, load_flight_dump
+
+    store = WideEventStore(capacity=8)
+    for i in range(3):
+        store.append({"trace_id": f"t{i}", "tenant": "a",
+                      "latency_s": 0.01})
+    fr = FlightRecorder(capacity=4, wide_events=store,
+                        dump_path=str(tmp_path / "box.json"), source="r9")
+    fr.record_event("boot")
+    dump = load_flight_dump(fr.dump())
+    assert [r["trace_id"] for r in dump["wide_events_tail"]] \
+        == ["t0", "t1", "t2"]
+    assert dump["wide_events_stats"]["appended"] == 3
+    # No store attached -> no wide keys, and dumping still works.
+    fr2 = FlightRecorder(capacity=4,
+                         dump_path=str(tmp_path / "box2.json"))
+    fr2.record_event("boot")
+    assert "wide_events_tail" not in load_flight_dump(fr2.dump())
+
+
+# -- engine + server (jax lane) ----------------------------------------------
+
+def test_engine_emits_wide_events_queryz_auditor_silent(rng, artifact_dir):
+    """Every finished request = exactly one wide event; the queryz verb
+    answers over the wire with mergeable payloads; and the ARMED auditor
+    proves the analytics plane adds zero recompiles — with the snapshot
+    dumped into the CI failure-artifact dir."""
+    from distkeras_tpu.models.bert import gpt_tiny
+    from distkeras_tpu.serving import ServingClient, ServingEngine
+    from distkeras_tpu.serving.client import ServerError
+    from distkeras_tpu.serving.server import ServingServer
+    from distkeras_tpu.telemetry import RecompileAuditor
+
+    model = gpt_tiny(seq_len=32, vocab_size=64)
+    engine = ServingEngine(
+        model, model.init(0), slots=2, max_queue=8,
+        auditor=RecompileAuditor(), arm_auditor_after_warmup=True)
+    assert engine.wide_events is not None  # default ON
+
+    def prompt(n):
+        return rng.integers(0, 64, size=(n,)).tolist()
+
+    async def go():
+        server = ServingServer(engine, port=0)
+        await server.start()
+        try:
+            async with ServingClient("127.0.0.1", server.port) as c:
+                for i in range(4):
+                    await c.generate(prompt(4 + i), 3,
+                                     tenant="a" if i % 2 else "b")
+                out = await c.queryz(group_by=["tenant"],
+                                     aggs=["count", "p50:latency_s",
+                                           "mean:output_tokens"])
+                health = await c.healthz()
+                with pytest.raises(ServerError, match="unknown column"):
+                    await c.queryz(where=["bogus=1"])
+            return out, health
+        finally:
+            await server.stop(drain=True)
+
+    out, health = asyncio.run(go())
+    assert out["matched"] == 4 and out["stats"]["appended"] == 4
+    by_tenant = {g["key"]["tenant"]: g for g in out["groups"]}
+    assert by_tenant["a"]["count"] == 2 and by_tenant["b"]["count"] == 2
+    for g in by_tenant.values():
+        assert g["aggs"]["mean:output_tokens"]["value"] == pytest.approx(3)
+        assert g["aggs"]["p50:latency_s"]["value"] > 0
+        assert g["aggs"]["p50:latency_s"]["state"]["count"] == g["count"]
+    assert health["wide_events"]["appended"] == 4
+
+    # Ring rows carry the engine's identity + per-request story.
+    tail = engine.wide_events.tail(4)
+    assert all(r["status"] == "ok" and r["kind"] == "generate"
+               and r["output_tokens"] == 3 and r["latency_s"] > 0
+               for r in tail)
+    assert {r["tenant"] for r in tail} == {"a", "b"}
+
+    # THE invariant: analytics on, decode compiled exactly once.
+    assert engine.auditor.compiles("serving_decode") == 1
+    assert engine.auditor.report()["serving_decode"]["armed"]
+    with open(artifact_dir / "queryz-snapshot.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+# -- router fan-out over a jax-free Echo fleet --------------------------------
+
+def test_router_queryz_fans_out_and_merges_echo_fleet():
+    """Fleet queryz over real TCP: 2 Echo replicas, deterministic
+    synthetic latencies (1 ms x prompt length), group-by percentiles
+    recomputed offline from the prompts sent must match the merged
+    fleet result within one histogram bucket width."""
+    from distkeras_tpu.serving import ServingClient, ServingCluster
+    from distkeras_tpu.serving.cluster.replicas import EchoReplica
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    prompts = {"a": [list(range(5, 5 + 3 + i)) for i in range(8)],
+               "b": [list(range(2, 2 + 6 + 2 * i)) for i in range(5)]}
+
+    async def go():
+        cluster = ServingCluster(lambda i: EchoReplica(), 2,
+                                 supervisor_kwargs=SUP,
+                                 registry=MetricsRegistry())
+        async with cluster:
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                for tenant, plist in prompts.items():
+                    for p in plist:
+                        await c.generate(p, 1, tenant=tenant)
+                merged = await c.queryz(
+                    group_by=["tenant"],
+                    aggs=["count", "p99:latency_s", "mean:latency_s",
+                          "sum:prompt_tokens"])
+                pinned = await c.pin_traces(["abc123", "def456"])
+        return merged, pinned, cluster
+
+    merged, pinned, cluster = asyncio.run(go())
+    assert merged["merged_from"] == 2
+    assert set(merged["replicas"]) == {"r0", "r1"}
+    assert all("matched" in sub for sub in merged["replicas"].values())
+    n_total = sum(len(v) for v in prompts.values())
+    assert merged["matched"] == n_total
+
+    by_tenant = {g["key"]["tenant"]: g for g in merged["groups"]}
+    for tenant, plist in prompts.items():
+        g = by_tenant[tenant]
+        assert g["count"] == len(plist)
+        # Echo latency is exactly 0.001 * len(prompt): recompute the
+        # fleet aggregate offline from what we sent.
+        lats = [0.001 * len(p) for p in plist]
+        assert g["aggs"]["mean:latency_s"]["value"] == pytest.approx(
+            sum(lats) / len(lats))
+        assert g["aggs"]["sum:prompt_tokens"]["value"] == pytest.approx(
+            sum(len(p) for p in plist))
+        p99 = g["aggs"]["p99:latency_s"]["value"]
+        truth = _percentile(lats, 99)
+        assert _bucket_width_ok(p99, truth), (tenant, p99, truth)
+
+    # The front-port pin fanned out to every Echo's real TraceStore.
+    assert pinned["pinned"] == ["abc123", "def456"]
+
+
+def test_router_queryz_bad_request_and_pretty_print():
+    """A typo'd spec comes back TYPED through the fan-out (every replica
+    rejected it the same way), and format_queryz renders the merged
+    page with group rows + replica notes."""
+    from distkeras_tpu.serving import ServingClient, ServingCluster
+    from distkeras_tpu.serving.client import ServerError
+    from distkeras_tpu.serving.cluster.replicas import EchoReplica
+    from distkeras_tpu.serving.debugz import format_queryz
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    async def go():
+        cluster = ServingCluster(lambda i: EchoReplica(), 2,
+                                 supervisor_kwargs=SUP,
+                                 registry=MetricsRegistry())
+        async with cluster:
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                for i in range(3):
+                    await c.generate([7, 8, 9], 1, tenant="t")
+                merged = await c.queryz(group_by=["tenant"],
+                                        aggs=["count", "p50:latency_s"])
+                with pytest.raises(ServerError, match="unknown column"):
+                    await c.queryz(where=["no_such_col=1"])
+                with pytest.raises(ServerError, match="capped at 2"):
+                    await c.queryz(group_by=["tenant", "kind", "replica"])
+        return merged
+
+    merged = asyncio.run(go())
+    page = format_queryz(merged)
+    assert "queryz: matched 3 of 3 events (merged from 2 replica(s))" \
+        in page
+    assert "tenant" in page and "p50:latency_s" in page
+    # A page with an unreachable replica names it.
+    merged["replicas"]["r9"] = {"unreachable": "connection refused"}
+    assert "replica r9: NOT MERGED — connection refused" \
+        in format_queryz(merged)
+    # Empty result renders, too.
+    empty = format_queryz({"matched": 0, "scanned": 0, "groups": []})
+    assert "(no matching events)" in empty
+
+
+def test_router_pins_slo_page_exemplars_fleet_wide():
+    """An SLO page event's exemplar trace ids get pinned into the
+    router's own store AND fanned out to every replica's — idempotent
+    across re-evaluations — and sloz reports them."""
+    from distkeras_tpu.serving import ServingClient, ServingCluster
+    from distkeras_tpu.serving.cluster.replicas import EchoReplica
+    from distkeras_tpu.telemetry import MetricsRegistry
+
+    async def go():
+        cluster = ServingCluster(lambda i: EchoReplica(), 2,
+                                 supervisor_kwargs=SUP,
+                                 registry=MetricsRegistry())
+        async with cluster:
+            router = cluster.router
+            # Inject a page transition the way the burn engine records
+            # one (evaluate() appends the same shape).
+            router.slo.events.append(
+                {"t": 1.0, "objective": "ttft", "from": "warn",
+                 "to": "page", "fast_burn": 20.0, "slow_burn": 8.0,
+                 "exemplars": ["feedf00d00000001", "feedf00d00000002"]})
+            fresh = await router._pin_slo_exemplars()
+            again = await router._pin_slo_exemplars()  # idempotent
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                slo = await c._control({"cmd": "sloz"}, retry=True)
+            echo_stats = [
+                cluster.replicas[rid].handle.server.trace_store.stats()
+                for rid in ("r0", "r1")]
+        return fresh, again, slo["sloz"], echo_stats, router
+
+    fresh, again, sloz, echo_stats, router = asyncio.run(go())
+    assert sorted(fresh) == ["feedf00d00000001", "feedf00d00000002"]
+    assert again == []
+    assert router.trace_store.pinned() == sorted(fresh)
+    assert sloz["pinned_exemplars"] == sorted(fresh)
+    # Every Echo replica's REAL TraceStore holds the pins.
+    for st in echo_stats:
+        assert st["pinned"] == 2
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_queryz_cli_json_and_pretty():
+    """`run.py queryz` against a live (jax-free Echo) server: --json
+    prints the payload, the default prints the table, a typo'd --where
+    comes back as a nonzero exit with the typed message."""
+    from distkeras_tpu.run import queryz_main
+    from distkeras_tpu.serving.cluster.replicas import EchoServer
+
+    started = threading.Event()
+    holder: dict = {}
+
+    def serve_forever():
+        async def go():
+            server = EchoServer()
+            await server.start()
+            for i in range(5):
+                server._reply({"prompt": [3] * (i + 2), "max_new_tokens": 1,
+                               "tenant": "cli", "trace_id": f"c{i}"})
+            holder["port"] = server.port
+            holder["stop"] = asyncio.Event()
+            started.set()
+            await holder["stop"].wait()
+            await server.stop()
+
+        holder["loop"] = asyncio.new_event_loop()
+        holder["loop"].run_until_complete(go())
+
+    t = threading.Thread(target=serve_forever, daemon=True)
+    t.start()
+    assert started.wait(30)
+    try:
+        args = ["--host", "127.0.0.1", "--port", str(holder["port"])]
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = queryz_main(args + ["--group-by", "tenant,kind",
+                                     "--agg", "count",
+                                     "--agg", "p99:latency_s", "--json"])
+        assert rc == 0
+        payload = json.loads(buf.getvalue())
+        assert payload["matched"] == 5
+        assert payload["group_by"] == ["tenant", "kind"]
+        assert payload["groups"][0]["key"] == {"tenant": "cli",
+                                               "kind": "generate"}
+
+        buf2 = io.StringIO()
+        with contextlib.redirect_stdout(buf2):
+            assert queryz_main(args + ["--where", "kind=generate",
+                                       "--group-by", "tenant"]) == 0
+        assert "queryz: matched 5 of 5 events" in buf2.getvalue()
+        assert "cli" in buf2.getvalue()
+
+        err = io.StringIO()
+        with contextlib.redirect_stderr(err):
+            assert queryz_main(args + ["--where", "bogus=1"]) == 1
+        assert "unknown column" in err.getvalue()
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        t.join(timeout=30)
+
+
+# -- slow lane: real child processes ------------------------------------------
+
+@pytest.mark.slow
+def test_process_cluster_queryz_end_to_end(rng):
+    """Fleet analytics on the real deployment shape: `run.py serve`
+    children behind the router, wide events emitted by real engines,
+    queryz merged across processes over the wire."""
+    from distkeras_tpu.serving import ServingClient, ServingCluster
+    from distkeras_tpu.serving.cluster import ProcessReplica
+
+    prompts = [rng.integers(0, 64, size=(4 + i % 3,)).tolist()
+               for i in range(6)]
+
+    async def go():
+        extra = ["--model", "gpt_tiny",
+                 "--model-args", '{"seq_len": 32, "vocab_size": 64}',
+                 "--slots", "2", "--seed", "0"]
+        cluster = ServingCluster(lambda i: ProcessReplica(extra), 2,
+                                 supervisor_kwargs=dict(
+                                     health_interval_s=0.5))
+        async with cluster:
+            async with ServingClient("127.0.0.1", cluster.port) as c:
+                for i, p in enumerate(prompts):
+                    await c.generate(p, 2, tenant=f"t{i % 2}")
+                merged = await c.queryz(
+                    where=["status=ok"], group_by=["tenant"],
+                    aggs=["count", "p50:latency_s"])
+        return merged
+
+    merged = asyncio.run(go())
+    assert merged["merged_from"] == 2
+    assert merged["matched"] == len(prompts)
+    by_tenant = {g["key"]["tenant"]: g["count"]
+                 for g in merged["groups"]}
+    assert by_tenant == {"t0": 3, "t1": 3}
